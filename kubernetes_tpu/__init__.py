@@ -1,0 +1,31 @@
+"""kubernetes_tpu — a TPU-native cluster-orchestration framework.
+
+A ground-up rebuild of the capabilities of early Kubernetes (reference:
+smarterclayton/kubernetes, surveyed in SURVEY.md): a declarative object model
+(pods / replication controllers / services / nodes / ...) over a versioned,
+watchable store; level-triggered control loops; a pluggable admission/auth
+pipeline; a node agent; a service proxy; and a CLI.
+
+The defining departure from the reference is the scheduler: instead of the
+serial per-pod predicate/priority loop
+(reference: pkg/scheduler/generic_scheduler.go:54-128), the Filter and Score
+phases are vmapped boolean-mask and score kernels over a dense
+(pending_pods x nodes) tensor solved in one JAX/XLA call on TPU
+(kubernetes_tpu.models.batch_solver), behind the same pluggable
+predicate/priority registry and Binding write path, so the serial Python
+implementation (kubernetes_tpu.scheduler.generic) remains a bit-identical
+oracle.
+
+Layer map (mirrors SURVEY.md section 1):
+  L0 storage/        versioned KV + CAS + watch        (ref: pkg/tools)
+  L1 api/, runtime/  object model, codecs, selectors   (ref: pkg/api, pkg/runtime)
+  L2 registry/       per-resource storage logic        (ref: pkg/registry)
+  L3 apiserver/      REST + watch + admission + auth   (ref: pkg/apiserver, pkg/master)
+  L4 client/         typed client + list-watch caches  (ref: pkg/client)
+  L5 scheduler/, controllers/  control loops           (ref: plugin/pkg/scheduler, pkg/controller)
+  L6 kubelet/, proxy/ node agent + data plane          (ref: pkg/kubelet, pkg/proxy)
+  L7 kubectl/        CLI                               (ref: pkg/kubectl)
+  -- models/, ops/, parallel/  the TPU compute path (JAX/pallas/pjit)
+"""
+
+__version__ = "0.1.0"

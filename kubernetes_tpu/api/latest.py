@@ -1,0 +1,93 @@
+"""Default scheme wiring — registered versions and conversions.
+
+ref: pkg/api/latest/latest.go — declares the supported external versions
+("v1" current, "v1beta1" legacy) and registers every kind plus conversion
+functions. The v1beta1 conversions exercise the same seam the reference uses
+for its hand-written v1beta1/v1beta2 conversions
+(ref: pkg/api/v1beta1/conversion.go): metadata fields are flattened to the
+top level and ``name`` is spelled ``id``.
+"""
+
+from __future__ import annotations
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.runtime.scheme import Scheme
+
+__all__ = ["scheme", "VERSIONS", "LATEST_VERSION", "new_scheme"]
+
+LATEST_VERSION = "v1"
+OLDEST_VERSION = "v1beta1"
+VERSIONS = ("v1", "v1beta1")
+
+_ALL_KINDS = (
+    api.Pod, api.PodList,
+    api.ReplicationController, api.ReplicationControllerList,
+    api.Service, api.ServiceList,
+    api.Endpoints, api.EndpointsList,
+    api.Node, api.NodeList,
+    api.Namespace, api.NamespaceList,
+    api.Binding,
+    api.Event, api.EventList,
+    api.Secret, api.SecretList,
+    api.LimitRange, api.LimitRangeList,
+    api.ResourceQuota, api.ResourceQuotaList,
+    api.Status,
+    api.DeleteOptions,
+)
+
+# Metadata fields flattened to top level in v1beta1 (name is spelled "id").
+_META_FLAT = (
+    ("name", "id"),
+    ("namespace", "namespace"),
+    ("uid", "uid"),
+    ("resourceVersion", "resourceVersion"),
+    ("creationTimestamp", "creationTimestamp"),
+    ("deletionTimestamp", "deletionTimestamp"),
+    ("selfLink", "selfLink"),
+    ("labels", "labels"),
+    ("annotations", "annotations"),
+    ("generateName", "generateName"),
+)
+
+
+def _v1beta1_encode(wire: dict) -> dict:
+    """internal wire -> v1beta1 wire: flatten metadata (ref: v1beta1/conversion.go)."""
+    wire = dict(wire)
+    meta = wire.pop("metadata", None)
+    if isinstance(meta, dict):
+        for internal_name, beta_name in _META_FLAT:
+            if internal_name in meta:
+                wire[beta_name] = meta[internal_name]
+    items = wire.get("items")
+    if isinstance(items, list):
+        wire["items"] = [_v1beta1_encode(i) if isinstance(i, dict) else i for i in items]
+    return wire
+
+
+def _v1beta1_decode(wire: dict) -> dict:
+    """v1beta1 wire -> internal wire: nest metadata back."""
+    wire = dict(wire)
+    meta = {}
+    for internal_name, beta_name in _META_FLAT:
+        if beta_name in wire:
+            meta[internal_name] = wire.pop(beta_name)
+    if meta:
+        wire["metadata"] = meta
+    items = wire.get("items")
+    if isinstance(items, list):
+        wire["items"] = [_v1beta1_decode(i) if isinstance(i, dict) else i for i in items]
+    return wire
+
+
+def new_scheme() -> Scheme:
+    s = Scheme(default_version=LATEST_VERSION)
+    s.add_known_types("v1", *_ALL_KINDS)
+    s.add_known_types("v1beta1", *_ALL_KINDS)
+    for t in _ALL_KINDS:
+        kind = getattr(t, "kind", t.__name__) or t.__name__
+        s.add_conversion("v1beta1", kind, _v1beta1_encode, _v1beta1_decode)
+    return s
+
+
+# The shared default scheme (ref: api.Scheme package variable).
+scheme = new_scheme()

@@ -1,0 +1,148 @@
+"""Generic dataclass <-> wire-dict serialization.
+
+The reference generates its wire format from Go struct tags and a
+reflection-based conversion engine (ref: pkg/conversion/converter.go,
+pkg/runtime/scheme.go). Here the equivalent seam is a pair of functions that
+walk dataclass type hints:
+
+- ``to_wire(obj)``   -> JSON-able dict, snake_case fields become camelCase,
+  None and empty collections are omitted (like ``omitempty``), Quantity and
+  datetimes get canonical string encodings.
+- ``from_wire(cls, data)`` -> instance; unknown fields are ignored (forward
+  compatibility), camelCase is mapped back to snake_case.
+
+Per-field name overrides use dataclass ``metadata={"wire": "name"}``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import typing
+from typing import Any, Dict, Optional, Type, get_args, get_origin, get_type_hints
+
+from kubernetes_tpu.api.quantity import Quantity
+
+__all__ = ["to_wire", "from_wire", "camel", "snake", "now_rfc3339"]
+
+_HINTS_CACHE: Dict[type, Dict[str, Any]] = {}
+
+
+def camel(name: str) -> str:
+    parts = name.split("_")
+    return parts[0] + "".join(p.title() for p in parts[1:])
+
+
+def snake(name: str) -> str:
+    out = []
+    for c in name:
+        if c.isupper():
+            out.append("_")
+            out.append(c.lower())
+        else:
+            out.append(c)
+    return "".join(out)
+
+
+def now_rfc3339() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+def _wire_name(f: dataclasses.Field) -> str:
+    return f.metadata.get("wire", camel(f.name))
+
+
+def to_wire(obj: Any) -> Any:
+    """Encode an API object (dataclass tree) into a JSON-able structure."""
+    if obj is None:
+        return None
+    if isinstance(obj, Quantity):
+        return str(obj)
+    if isinstance(obj, datetime.datetime):
+        if obj.tzinfo is not None:
+            obj = obj.astimezone(datetime.timezone.utc)
+        base = obj.strftime("%Y-%m-%dT%H:%M:%S")
+        if obj.microsecond:
+            base += f".{obj.microsecond:06d}".rstrip("0")
+        return base + "Z"
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        out = {}
+        for f in dataclasses.fields(obj):
+            v = getattr(obj, f.name)
+            if v is None:
+                continue
+            # omitempty: skip fields still at their default value — decoding
+            # restores the same default, so round-trips are exact.
+            if f.default is not dataclasses.MISSING and v == f.default and not f.metadata.get("keep_empty"):
+                continue
+            if isinstance(v, (list, dict)) and not v and not f.metadata.get("keep_empty"):
+                continue
+            out[_wire_name(f)] = to_wire(v)
+        return out
+    if isinstance(obj, dict):
+        return {k: to_wire(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [to_wire(v) for v in obj]
+    if isinstance(obj, (str, int, float, bool)):
+        return obj
+    raise TypeError(f"cannot serialize {type(obj)!r}")
+
+
+def _hints(cls: type) -> Dict[str, Any]:
+    h = _HINTS_CACHE.get(cls)
+    if h is None:
+        h = get_type_hints(cls)
+        _HINTS_CACHE[cls] = h
+    return h
+
+
+def _strip_optional(t: Any) -> Any:
+    if get_origin(t) is typing.Union:
+        args = [a for a in get_args(t) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return t
+
+
+def from_wire(cls: Any, data: Any) -> Any:
+    """Decode a JSON-able structure into ``cls`` (a dataclass or builtin)."""
+    cls = _strip_optional(cls)
+    if data is None:
+        return None
+    if cls is Any:
+        return data
+    if cls is Quantity:
+        return Quantity(data)
+    if cls is datetime.datetime:
+        if isinstance(data, datetime.datetime):
+            return data
+        # RFC3339 in all common shapes: fractional seconds, 'Z' or numeric offset.
+        s = data[:-1] + "+00:00" if data.endswith("Z") else data
+        dt = datetime.datetime.fromisoformat(s)
+        if dt.tzinfo is None:
+            dt = dt.replace(tzinfo=datetime.timezone.utc)
+        return dt.astimezone(datetime.timezone.utc)
+    origin = get_origin(cls)
+    if origin in (list, tuple):
+        (item_t,) = get_args(cls) or (Any,)
+        return [from_wire(item_t, v) for v in data]
+    if origin is dict:
+        args = get_args(cls)
+        val_t = args[1] if len(args) == 2 else Any
+        return {k: from_wire(val_t, v) for k, v in data.items()}
+    if dataclasses.is_dataclass(cls):
+        if not isinstance(data, dict):
+            raise TypeError(f"expected object for {cls.__name__}, got {type(data).__name__}")
+        hints = _hints(cls)
+        kwargs = {}
+        by_wire = { _wire_name(f): f for f in dataclasses.fields(cls) }
+        for k, v in data.items():
+            f = by_wire.get(k)
+            if f is None:
+                continue  # unknown field: ignore (forward compatibility)
+            kwargs[f.name] = from_wire(hints[f.name], v)
+        return cls(**kwargs)
+    if cls in (str, int, float, bool):
+        return cls(data) if not isinstance(data, cls) else data
+    # Unparameterized builtin containers or unknown hints: pass through.
+    return data

@@ -1,0 +1,14 @@
+"""Test configuration.
+
+Tests run on CPU with 8 virtual XLA devices so multi-chip sharding
+(kubernetes_tpu.parallel) is exercised without TPU hardware, mirroring how the
+reference tests "multi-node" behavior in one process with fakes
+(ref: cmd/integration/integration.go:67-117).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,234 @@
+"""Scheme/codec/validation/fields tests.
+
+Mirrors the reference's serialization round-trip fuzzing
+(ref: pkg/api/serialization_test.go) and validation tables
+(ref: pkg/api/validation/validation_test.go).
+"""
+
+import random
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api import validation
+from kubernetes_tpu.api.fields import parse_field_selector
+from kubernetes_tpu.api.latest import scheme
+from kubernetes_tpu.api.meta import accessor, default_rest_mapper
+from kubernetes_tpu.api.quantity import Quantity
+
+
+def _fuzz_pod(rng: random.Random) -> api.Pod:
+    return api.Pod(
+        metadata=api.ObjectMeta(
+            name=f"pod-{rng.randrange(1000)}",
+            namespace=rng.choice(["default", "kube-system", "test"]),
+            uid=str(rng.randrange(10**9)),
+            resource_version=str(rng.randrange(100)),
+            labels={f"k{i}": f"v{rng.randrange(5)}" for i in range(rng.randrange(3))},
+            annotations={"note": "x"} if rng.random() < 0.5 else {},
+        ),
+        spec=api.PodSpec(
+            containers=[
+                api.Container(
+                    name=f"c{i}",
+                    image=f"img:{rng.randrange(9)}",
+                    ports=[
+                        api.ContainerPort(container_port=8000 + i, host_port=rng.choice([0, 9000 + i]))
+                    ],
+                    resources=api.ResourceRequirements(
+                        limits={
+                            "cpu": Quantity(f"{rng.randrange(1, 4000)}m"),
+                            "memory": Quantity(f"{rng.randrange(1, 4096)}Mi"),
+                        }
+                    ),
+                )
+                for i in range(1 + rng.randrange(2))
+            ],
+            restart_policy=rng.choice([api.RestartPolicyAlways, api.RestartPolicyNever]),
+            node_selector={"disk": "ssd"} if rng.random() < 0.3 else {},
+            host=rng.choice(["", "node-1"]),
+        ),
+        status=api.PodStatus(phase=rng.choice(["", api.PodPending, api.PodRunning])),
+    )
+
+
+def test_round_trip_fuzz_all_versions():
+    rng = random.Random(42)
+    for _ in range(50):
+        pod = _fuzz_pod(rng)
+        for version in scheme.versions():
+            data = scheme.encode(pod, version)
+            back = scheme.decode(data)
+            assert back == pod, f"round-trip failed for version {version}"
+
+
+def test_round_trip_other_kinds():
+    objs = [
+        api.Service(metadata=api.ObjectMeta(name="s", namespace="default"),
+                    spec=api.ServiceSpec(port=80, selector={"a": "b"}, portal_ip="10.0.0.1")),
+        api.ReplicationController(
+            metadata=api.ObjectMeta(name="rc", namespace="default"),
+            spec=api.ReplicationControllerSpec(
+                replicas=3, selector={"a": "b"},
+                template=api.PodTemplateSpec(
+                    metadata=api.ObjectMeta(labels={"a": "b"}),
+                    spec=api.PodSpec(containers=[api.Container(name="c", image="i")]),
+                ),
+            ),
+        ),
+        api.Node(metadata=api.ObjectMeta(name="n1"),
+                 spec=api.NodeSpec(capacity={"cpu": Quantity("4"), "memory": Quantity("8Gi")})),
+        api.Namespace(metadata=api.ObjectMeta(name="space")),
+        api.Event(metadata=api.ObjectMeta(name="e", namespace="default"),
+                  involved_object=api.ObjectReference(kind="Pod", name="p", namespace="default"),
+                  reason="scheduled", count=2),
+        api.Binding(metadata=api.ObjectMeta(name="p", namespace="default"),
+                    pod_name="p", host="node-1"),
+        api.Status(status=api.StatusFailure, reason=api.ReasonNotFound, code=404),
+        api.Endpoints(metadata=api.ObjectMeta(name="s", namespace="default"),
+                      endpoints=[api.Endpoint(ip="10.1.2.3", port=8080)]),
+    ]
+    for obj in objs:
+        for version in scheme.versions():
+            assert scheme.decode(scheme.encode(obj, version)) == obj
+
+
+def test_v1beta1_flattens_metadata():
+    import json
+
+    pod = api.Pod(metadata=api.ObjectMeta(name="x", namespace="default"))
+    wire = json.loads(scheme.encode(pod, "v1beta1"))
+    assert wire["id"] == "x"
+    assert "metadata" not in wire
+    v1 = json.loads(scheme.encode(pod, "v1"))
+    assert v1["metadata"]["name"] == "x"
+
+
+def test_convert_wire_between_versions():
+    pod = api.Pod(metadata=api.ObjectMeta(name="x", namespace="default"))
+    import json
+    beta = json.loads(scheme.encode(pod, "v1beta1"))
+    v1 = scheme.convert_wire(beta, "v1beta1", "v1")
+    assert v1["metadata"]["name"] == "x"
+    assert v1["apiVersion"] == "v1"
+
+
+def test_list_round_trip():
+    pl = api.PodList(items=[_fuzz_pod(random.Random(7)) for _ in range(3)])
+    for version in scheme.versions():
+        assert scheme.decode(scheme.encode(pl, version)) == pl
+
+
+def test_accessor():
+    pod = api.Pod(metadata=api.ObjectMeta(name="x", namespace="ns", resource_version="5"))
+    assert accessor.name(pod) == "x"
+    assert accessor.namespace(pod) == "ns"
+    assert accessor.resource_version(pod) == "5"
+    accessor.set_resource_version(pod, "6")
+    assert pod.metadata.resource_version == "6"
+    assert accessor.kind(pod) == "Pod"
+
+
+def test_rest_mapper():
+    m = default_rest_mapper()
+    assert m.kind_for("pods") == "Pod"
+    assert m.kind_for("po") == "Pod"
+    assert m.resource_for("Service") == "services"
+    assert m.is_namespaced("pods") is True
+    assert m.is_namespaced("nodes") is False
+    assert m.type_for("rc") is api.ReplicationController
+
+
+# -- validation tables ------------------------------------------------------
+
+def _valid_pod():
+    return api.Pod(
+        metadata=api.ObjectMeta(name="abc", namespace="default"),
+        spec=api.PodSpec(containers=[api.Container(name="ctr", image="image")]),
+    )
+
+
+def test_validate_pod_success():
+    assert validation.validate_pod(_valid_pod()) == []
+
+
+@pytest.mark.parametrize(
+    "mutate",
+    [
+        lambda p: setattr(p.metadata, "name", ""),
+        lambda p: setattr(p.metadata, "name", "Not_Valid!"),
+        lambda p: setattr(p.metadata, "namespace", ""),
+        lambda p: setattr(p.spec, "containers", []),
+        lambda p: setattr(p.spec.containers[0], "name", ""),
+        lambda p: setattr(p.spec.containers[0], "image", ""),
+        lambda p: setattr(p.spec, "restart_policy", "Sometimes"),
+        lambda p: p.spec.containers[0].ports.append(api.ContainerPort(container_port=0)),
+        lambda p: p.spec.containers[0].volume_mounts.append(
+            api.VolumeMount(name="nope", mount_path="/x")),
+    ],
+)
+def test_validate_pod_failures(mutate):
+    pod = _valid_pod()
+    mutate(pod)
+    assert validation.validate_pod(pod) != []
+
+
+def test_validate_host_port_conflict():
+    pod = _valid_pod()
+    pod.spec.containers = [
+        api.Container(name="a", image="i",
+                      ports=[api.ContainerPort(container_port=80, host_port=8080)]),
+        api.Container(name="b", image="i",
+                      ports=[api.ContainerPort(container_port=81, host_port=8080)]),
+    ]
+    errs = validation.validate_pod(pod)
+    assert any(e.type == "duplicate value" for e in errs)
+
+
+def test_validate_rc():
+    rc = api.ReplicationController(
+        metadata=api.ObjectMeta(name="rc", namespace="default"),
+        spec=api.ReplicationControllerSpec(
+            replicas=2, selector={"a": "b"},
+            template=api.PodTemplateSpec(
+                metadata=api.ObjectMeta(labels={"a": "b"}),
+                spec=api.PodSpec(containers=[api.Container(name="c", image="i")]),
+            ),
+        ),
+    )
+    assert validation.validate_replication_controller(rc) == []
+    rc.spec.template.metadata.labels = {"a": "MISMATCH"}
+    assert validation.validate_replication_controller(rc) != []
+    rc.spec.template.metadata.labels = {"a": "b"}
+    rc.spec.replicas = -1
+    assert validation.validate_replication_controller(rc) != []
+
+
+def test_validate_service():
+    svc = api.Service(metadata=api.ObjectMeta(name="abc", namespace="default"),
+                      spec=api.ServiceSpec(port=80))
+    assert validation.validate_service(svc) == []
+    svc.spec.port = 0
+    assert validation.validate_service(svc) != []
+
+
+def test_validate_pod_update_immutable_spec():
+    old = _valid_pod()
+    new = _valid_pod()
+    new.spec.containers[0].image = "image:v2"
+    assert validation.validate_pod_update(new, old) == []  # image change OK
+    new2 = _valid_pod()
+    new2.spec.containers[0].command = ["changed"]
+    assert validation.validate_pod_update(new2, old) != []
+
+
+# -- field selectors --------------------------------------------------------
+
+def test_field_selector():
+    sel = parse_field_selector("spec.host=")
+    assert sel.matches({"spec.host": ""})
+    assert not sel.matches({"spec.host": "node-1"})
+    sel2 = parse_field_selector("status.phase!=Running,spec.host=n1")
+    assert sel2.matches({"status.phase": "Pending", "spec.host": "n1"})
+    assert not sel2.matches({"status.phase": "Running", "spec.host": "n1"})
+    assert parse_field_selector("").matches({"anything": "x"})

@@ -1,0 +1,87 @@
+"""Label selector tests (ref: pkg/labels/selector_test.go, table-driven)."""
+
+import pytest
+
+from kubernetes_tpu.api.labels import (
+    Requirement,
+    Selector,
+    everything,
+    format_labels,
+    nothing,
+    parse_labels,
+    parse_selector,
+    selector_from_set,
+)
+
+
+LABELS = {"env": "prod", "tier": "frontend", "partition": "us-east"}
+
+
+@pytest.mark.parametrize(
+    "expr,expected",
+    [
+        ("", True),
+        ("env=prod", True),
+        ("env==prod", True),
+        ("env=dev", False),
+        ("env!=dev", True),
+        ("env!=prod", False),
+        ("env in (prod,dev)", True),
+        ("env in (dev,test)", False),
+        ("env notin (dev)", True),
+        ("env notin (prod)", False),
+        ("partition", True),
+        ("missing", False),
+        ("!missing", True),
+        ("!env", False),
+        ("env=prod,tier=frontend", True),
+        ("env=prod,tier=backend", False),
+        ("env in (prod), !missing, tier != backend", True),
+    ],
+)
+def test_parse_and_match(expr, expected):
+    assert parse_selector(expr).matches(LABELS) is expected
+
+
+def test_match_nil_and_empty():
+    assert everything().matches({}) is True
+    assert everything().matches(None) is True
+    assert nothing().matches({}) is False
+    assert parse_selector("x=y").matches(None) is False
+
+
+def test_selector_from_set():
+    sel = selector_from_set({"a": "b", "c": "d"})
+    assert sel.matches({"a": "b", "c": "d", "e": "f"})
+    assert not sel.matches({"a": "b"})
+    assert selector_from_set(None).matches({"anything": "goes"})
+    assert sel.exact_match_labels() == {"a": "b", "c": "d"}
+
+
+def test_parse_errors():
+    for bad in ["env in", "env in (", "in (a)", "env notin ()", "=v", "&&"]:
+        with pytest.raises(ValueError):
+            sel = parse_selector(bad)
+            # empty-value forms like "env in ()" must fail at Requirement
+            if not sel.requirements:
+                raise ValueError(bad)
+
+
+def test_requirement_validation():
+    with pytest.raises(ValueError):
+        Requirement("k", "in", [])
+    with pytest.raises(ValueError):
+        Requirement("k", "exists", ["v"])
+
+
+def test_string_round_trip():
+    for expr in ["env=prod", "env!=dev", "env in (a,b)", "tier notin (x)", "key", "!key"]:
+        sel = parse_selector(expr)
+        again = parse_selector(str(sel))
+        assert again == sel, expr
+
+
+def test_format_parse_labels():
+    s = format_labels({"b": "2", "a": "1"})
+    assert s == "a=1,b=2"
+    assert parse_labels(s) == {"a": "1", "b": "2"}

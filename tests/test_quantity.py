@@ -1,0 +1,74 @@
+"""Quantity parse/format/arithmetic tests (ref: pkg/api/resource/quantity_test.go)."""
+
+import pytest
+
+from kubernetes_tpu.api.quantity import Quantity, QuantityError
+
+
+@pytest.mark.parametrize(
+    "s,milli",
+    [
+        ("0", 0),
+        ("100m", 100),
+        ("1", 1000),
+        ("1.5", 1500),
+        ("2k", 2_000_000),
+        ("1Ki", 1024 * 1000),
+        ("1Mi", 1024 * 1024 * 1000),
+        ("1.5Gi", int(1.5 * 2**30) * 1000),
+        ("3e2", 300_000),
+        ("-100m", -100),
+        ("1u", 1),  # rounds up to 1 milli
+    ],
+)
+def test_parse_milli_value(s, milli):
+    assert Quantity(s).milli_value() == milli
+
+
+@pytest.mark.parametrize(
+    "s,canonical",
+    [
+        ("100m", "100m"),
+        ("1000m", "1"),
+        ("1024", "1024"),  # decimal format preserved
+        ("1Ki", "1Ki"),
+        ("2048Ki", "2Mi"),
+        ("0.5Gi", "512Mi"),
+        ("1.5Gi", "1536Mi"),
+        ("12e3", "12e3"),
+        ("1000k", "1M"),
+        ("0.001", "1m"),
+        ("0", "0"),
+    ],
+)
+def test_canonical_format(s, canonical):
+    assert str(Quantity(s)) == canonical
+
+
+def test_round_trip_stable():
+    for s in ["100m", "250Mi", "4", "3e6", "2.5", "1Ti"]:
+        q = Quantity(s)
+        assert Quantity(str(q)) == q
+
+
+def test_arithmetic():
+    assert Quantity("100m") + Quantity("900m") == Quantity("1")
+    assert Quantity("1Gi") - Quantity("512Mi") == Quantity("512Mi")
+    assert Quantity("1") > Quantity("999m")
+    assert Quantity("1Ki") == Quantity("1024")
+    total = Quantity("0")
+    for _ in range(10):
+        total = total + Quantity("0.1")
+    assert total == Quantity("1")  # exact rational arithmetic
+
+
+def test_int_value_rounds_up():
+    assert Quantity("1.5").int_value() == 2
+    assert Quantity("100m").int_value() == 1
+    assert Quantity("2").int_value() == 2
+
+
+@pytest.mark.parametrize("bad", ["", "abc", "1.5.3", "100mm", "1 Gi", "e3"])
+def test_parse_errors(bad):
+    with pytest.raises(QuantityError):
+        Quantity(bad)
