@@ -114,6 +114,16 @@ def new_internal_error(message: str) -> StatusError:
     return _status(500, api.ReasonInternalError, message)
 
 
+def new_expired(message: str) -> StatusError:
+    """410 Gone — the requested resourceVersion fell out of the watch window
+    (ref: errors.go NewResourceExpired); clients respond by relisting."""
+    return _status(410, api.ReasonExpired, message)
+
+
+def is_resource_expired(e: BaseException) -> bool:
+    return isinstance(e, StatusError) and e.reason == api.ReasonExpired
+
+
 def from_status(status: api.Status) -> StatusError:
     return StatusError(status)
 

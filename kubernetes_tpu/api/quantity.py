@@ -190,12 +190,18 @@ def _format(v: Fraction, fmt: str) -> str:
         fmt = DECIMAL_SI
     if fmt == DECIMAL_EXPONENT:
         # mantissa * 10^exp with integral mantissa; exponent a multiple of 3
-        # (ref: suffix.go decimalExponent formats via e3/e6/...).
+        # (ref: suffix.go decimalExponent formats via e3/e6/...). Rationals
+        # whose denominator is not 2^a*5^b (e.g. 1/3) have no finite decimal
+        # form — round those up at nano precision like the DecimalSI fallback.
         exp = 0
         val = v
-        while val.denominator != 1:
+        for _ in range(30):
+            if val.denominator == 1:
+                break
             val *= 10
             exp -= 1
+        if val.denominator != 1:
+            val = Fraction(-(-val.numerator // val.denominator))
         mant = val.numerator
         while mant % 10 == 0 and mant != 0:
             mant //= 10

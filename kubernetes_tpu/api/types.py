@@ -103,6 +103,7 @@ ReasonForbidden = "Forbidden"
 ReasonUnauthorized = "Unauthorized"
 ReasonMethodNotAllowed = "MethodNotAllowed"
 ReasonInternalError = "InternalError"
+ReasonExpired = "Expired"
 
 # Session affinity
 AffinityNone = "None"

@@ -1,0 +1,303 @@
+"""Client-side list-watch caches (ref: pkg/client/cache/).
+
+- ``Store``: thread-safe keyed object store (store.go)
+- ``FIFO``: Store-shaped producer/consumer queue with blocking Pop (fifo.go)
+- ``Reflector``: list+watch a resource into a Store, resuming from
+  resourceVersion and relisting when the watch expires (reflector.go:43-91)
+- ``Poller``: periodic list -> Store.replace (poller.go)
+- ``ListWatch``: the pluggable list/watch source (listwatch.go)
+- Typed listers over a Store (listers.go)
+
+Every control loop (scheduler, controllers, kubelet apiserver-source) runs on
+these primitives, exactly as in the reference.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from kubernetes_tpu import watch as watchpkg
+from kubernetes_tpu.api import errors
+from kubernetes_tpu.api import labels as labels_pkg
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.meta import accessor
+
+__all__ = ["meta_namespace_key_func", "Store", "FIFO", "ListWatch", "Reflector",
+           "Poller", "StorePodLister", "StoreNodeLister", "StoreServiceLister"]
+
+
+def meta_namespace_key_func(obj: Any) -> str:
+    """<namespace>/<name> key (ref: store.go MetaNamespaceKeyFunc)."""
+    m = obj.metadata
+    return f"{m.namespace}/{m.name}" if m.namespace else m.name
+
+
+class Store:
+    """Threadsafe keyed store (ref: cache.Store)."""
+
+    def __init__(self, key_func: Callable[[Any], str] = meta_namespace_key_func):
+        self._lock = threading.RLock()
+        self._items: Dict[str, Any] = {}
+        self.key_func = key_func
+
+    def add(self, obj: Any) -> None:
+        with self._lock:
+            self._items[self.key_func(obj)] = obj
+
+    def update(self, obj: Any) -> None:
+        with self._lock:
+            self._items[self.key_func(obj)] = obj
+
+    def delete(self, obj: Any) -> None:
+        with self._lock:
+            self._items.pop(self.key_func(obj), None)
+
+    def get(self, obj: Any) -> Optional[Any]:
+        return self.get_by_key(self.key_func(obj))
+
+    def get_by_key(self, key: str) -> Optional[Any]:
+        with self._lock:
+            return self._items.get(key)
+
+    def list(self) -> List[Any]:
+        with self._lock:
+            return list(self._items.values())
+
+    def list_keys(self) -> List[str]:
+        with self._lock:
+            return list(self._items.keys())
+
+    def replace(self, objs: List[Any]) -> None:
+        """Atomically reset contents (ref: store.go Replace — used by relist)."""
+        with self._lock:
+            self._items = {self.key_func(o): o for o in objs}
+
+    def __len__(self):
+        with self._lock:
+            return len(self._items)
+
+
+class FIFO:
+    """Producer/consumer queue keyed like a Store (ref: fifo.go).
+
+    Items added while present are coalesced (update-in-place keeps queue
+    position); Pop blocks until an item is available.
+    """
+
+    def __init__(self, key_func: Callable[[Any], str] = meta_namespace_key_func):
+        self._cond = threading.Condition()
+        self._items: Dict[str, Any] = {}
+        self._queue: List[str] = []
+        self.key_func = key_func
+
+    def add(self, obj: Any) -> None:
+        with self._cond:
+            key = self.key_func(obj)
+            if key not in self._items:
+                self._queue.append(key)
+            self._items[key] = obj
+            self._cond.notify()
+
+    update = add
+
+    def delete(self, obj: Any) -> None:
+        with self._cond:
+            key = self.key_func(obj)
+            self._items.pop(key, None)
+            # key stays in _queue; Pop skips missing items (ref: fifo.go Pop)
+
+    def get_by_key(self, key: str) -> Optional[Any]:
+        with self._cond:
+            return self._items.get(key)
+
+    def list(self) -> List[Any]:
+        with self._cond:
+            return list(self._items.values())
+
+    def replace(self, objs: List[Any]) -> None:
+        with self._cond:
+            self._items = {self.key_func(o): o for o in objs}
+            self._queue = list(self._items.keys())
+            self._cond.notify_all()
+
+    def pop(self, timeout: Optional[float] = None) -> Any:
+        """Blocking pop of the oldest item (ref: fifo.go Pop)."""
+        deadline = time.monotonic() + timeout if timeout is not None else None
+        with self._cond:
+            while True:
+                while self._queue:
+                    key = self._queue.pop(0)
+                    if key in self._items:
+                        return self._items.pop(key)
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError("FIFO.pop timed out")
+                self._cond.wait(timeout=remaining)
+
+    def __len__(self):
+        with self._cond:
+            return len(self._items)
+
+
+class ListWatch:
+    """Pluggable list+watch source (ref: listwatch.go).
+
+    ``list_fn()`` returns a list object (items + metadata.resource_version);
+    ``watch_fn(resource_version)`` returns a watch.Watcher.
+    """
+
+    def __init__(self, list_fn, watch_fn):
+        self.list_fn = list_fn
+        self.watch_fn = watch_fn
+
+
+class Reflector:
+    """Mirrors a resource into a Store via list+watch (ref: reflector.go:43-91).
+
+    list -> Store.replace -> watch(rv) -> apply events, tracking the last seen
+    resourceVersion; when the watch ends or the version window expires
+    (ErrIndexOutdated / 410 Gone), relist and resume. Crash-only: any error
+    sleeps briefly and starts over (ref: util.Forever usage, reflector.go:84).
+    """
+
+    def __init__(self, listwatch: ListWatch, store, resync_period: float = 0.0,
+                 name: str = "reflector"):
+        self.lw = listwatch
+        self.store = store
+        self.resync_period = resync_period
+        self.name = name
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.last_sync_resource_version = ""
+
+    def run(self) -> "Reflector":
+        self._thread = threading.Thread(target=self._run_loop, daemon=True, name=self.name)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _run_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._list_and_watch()
+            except Exception:
+                if self._stop.is_set():
+                    return
+                time.sleep(0.05)
+
+    def _list_and_watch(self) -> None:
+        lst = self.lw.list_fn()
+        rv = lst.metadata.resource_version
+        self.store.replace(lst.items)
+        self.last_sync_resource_version = rv
+        resync_deadline = (time.monotonic() + self.resync_period
+                           if self.resync_period else None)
+        while not self._stop.is_set():
+            try:
+                w = self.lw.watch_fn(rv)
+            except errors.StatusError as e:
+                if errors.is_resource_expired(e):
+                    return  # 410 Gone: relist
+                raise
+            try:
+                while not self._stop.is_set():
+                    if resync_deadline and time.monotonic() >= resync_deadline:
+                        return  # periodic full relist
+                    try:
+                        ev = w.next_event(timeout=0.2)
+                    except Exception:
+                        continue
+                    if ev is None:
+                        return  # stream closed: relist
+                    if ev.type == watchpkg.ERROR:
+                        return
+                    obj = ev.object
+                    if ev.type == watchpkg.ADDED:
+                        self.store.add(obj)
+                    elif ev.type == watchpkg.MODIFIED:
+                        self.store.update(obj)
+                    elif ev.type == watchpkg.DELETED:
+                        self.store.delete(obj)
+                    new_rv = accessor.resource_version(obj)
+                    if new_rv:
+                        rv = new_rv
+                        self.last_sync_resource_version = rv
+            finally:
+                w.stop()
+
+
+class Poller:
+    """Periodic list -> Store.replace (ref: poller.go — the node source in the
+    scheduler factory uses this, factory.go:139)."""
+
+    def __init__(self, list_fn, period: float, store):
+        self.list_fn = list_fn
+        self.period = period
+        self.store = store
+        self._stop = threading.Event()
+
+    def run(self) -> "Poller":
+        self._run_once()
+        t = threading.Thread(target=self._loop, daemon=True, name="poller")
+        t.start()
+        return self
+
+    def _run_once(self):
+        try:
+            lst = self.list_fn()
+            self.store.replace(lst.items)
+        except Exception:
+            pass
+
+    def _loop(self):
+        while not self._stop.wait(self.period):
+            self._run_once()
+
+    def stop(self):
+        self._stop.set()
+
+
+# -- typed listers (ref: listers.go) ---------------------------------------
+
+
+class StorePodLister:
+    def __init__(self, store: Store):
+        self.store = store
+
+    def list(self, selector: Optional[labels_pkg.Selector] = None) -> List[api.Pod]:
+        pods = self.store.list()
+        if selector is None:
+            return pods
+        return [p for p in pods if selector.matches(p.metadata.labels)]
+
+
+class StoreNodeLister:
+    def __init__(self, store: Store):
+        self.store = store
+
+    def list(self) -> api.NodeList:
+        return api.NodeList(items=self.store.list())
+
+
+class StoreServiceLister:
+    def __init__(self, store: Store):
+        self.store = store
+
+    def get_pod_services(self, pod: api.Pod) -> List[api.Service]:
+        """Services whose selector matches the pod (ref: listers.go
+        StoreToServiceLister.GetPodServices)."""
+        out = []
+        for svc in self.store.list():
+            if svc.metadata.namespace != pod.metadata.namespace:
+                continue
+            if not svc.spec.selector:
+                continue
+            if labels_pkg.selector_from_set(svc.spec.selector).matches(pod.metadata.labels):
+                out.append(svc)
+        return out

@@ -1,0 +1,202 @@
+"""StoreHelper — typed CRUD over the versioned KV.
+
+Rebuild of the reference's EtcdHelper (ref: pkg/tools/etcd_helper.go:36-345 +
+etcd_helper_watch.go:64-95): encodes/decodes API objects with the runtime
+Scheme, maps the store's modified_index to ObjectMeta.resource_version, and
+provides the read-modify-CAS ``atomic_update`` loop every registry and
+controller relies on for optimistic concurrency.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Optional, Type
+
+from kubernetes_tpu import watch as watchpkg
+from kubernetes_tpu.api import errors
+from kubernetes_tpu.api.meta import accessor
+from kubernetes_tpu.storage.memstore import (
+    ErrCASConflict,
+    ErrIndexOutdated,
+    ErrKeyExists,
+    ErrKeyNotFound,
+    MemStore,
+)
+
+__all__ = ["StoreHelper", "parse_watch_resource_version"]
+
+
+def parse_watch_resource_version(rv: str) -> int:
+    """ref: pkg/tools/etcd_helper_watch.go:47-57 ParseWatchResourceVersion —
+    '' or '0' means "from now"; otherwise watch resumes after rv."""
+    if not rv or rv == "0":
+        return 0
+    try:
+        return int(rv)
+    except ValueError:
+        raise errors.new_invalid("", rv, [ValueError(f"invalid resourceVersion {rv!r}")])
+
+
+class StoreHelper:
+    def __init__(self, store: MemStore, scheme):
+        self.store = store
+        self.scheme = scheme
+
+    # -- encode/decode ------------------------------------------------------
+    def _decode(self, kv) -> Any:
+        obj = self.scheme.decode(kv.value)
+        accessor.set_resource_version(obj, str(kv.modified_index))
+        return obj
+
+    def _encode(self, obj) -> str:
+        # resourceVersion is storage metadata, not payload: clear before
+        # encoding, like the reference (etcd_helper.go:236 Versioner).
+        rv = accessor.resource_version(obj)
+        accessor.set_resource_version(obj, "")
+        try:
+            return self.scheme.encode(obj)
+        finally:
+            accessor.set_resource_version(obj, rv)
+
+    # -- CRUD ---------------------------------------------------------------
+    def create_obj(self, key: str, obj: Any, ttl: Optional[float] = None) -> Any:
+        """ref: etcd_helper.go:205 CreateObj."""
+        try:
+            kv = self.store.create(key, self._encode(obj), ttl=ttl)
+        except ErrKeyExists:
+            raise errors.new_already_exists(accessor.kind(obj), accessor.name(obj))
+        out = self.scheme.deep_copy(obj)
+        accessor.set_resource_version(out, str(kv.modified_index))
+        return out
+
+    def set_obj(self, key: str, obj: Any, ttl: Optional[float] = None) -> Any:
+        """Write; CAS on the object's resourceVersion when set
+        (ref: etcd_helper.go:236 SetObj)."""
+        rv = accessor.resource_version(obj)
+        try:
+            if rv:
+                kv = self.store.compare_and_swap(key, self._encode(obj), int(rv), ttl=ttl)
+            else:
+                kv = self.store.set(key, self._encode(obj), ttl=ttl)
+        except ErrCASConflict:
+            raise errors.new_conflict(accessor.kind(obj), accessor.name(obj))
+        except ErrKeyNotFound:
+            raise errors.new_not_found(accessor.kind(obj), accessor.name(obj))
+        out = self.scheme.deep_copy(obj)
+        accessor.set_resource_version(out, str(kv.modified_index))
+        return out
+
+    def extract_obj(self, key: str, kind: str = "", name: str = "") -> Any:
+        """ref: etcd_helper.go:144 ExtractObj."""
+        try:
+            kv = self.store.get(key)
+        except ErrKeyNotFound:
+            raise errors.new_not_found(kind or "resource", name or key)
+        return self._decode(kv)
+
+    def extract_to_list(self, prefix: str, list_type: Type) -> Any:
+        """ref: etcd_helper.go:78 ExtractToList — items + list resourceVersion."""
+        kvs, index = self.store.list(prefix)
+        lst = list_type()
+        lst.items = [self._decode(kv) for kv in kvs]
+        lst.metadata.resource_version = str(index)
+        return lst
+
+    def delete_obj(self, key: str, kind: str = "", name: str = "") -> Any:
+        try:
+            prev = self.store.delete(key)
+        except ErrKeyNotFound:
+            raise errors.new_not_found(kind or "resource", name or key)
+        return self._decode(prev)
+
+    def atomic_update(self, key: str, obj_type: Type,
+                      update_fn: Callable[[Any], Any],
+                      ignore_not_found: bool = False,
+                      ttl: Optional[float] = None,
+                      max_retries: int = 100) -> Any:
+        """Read-modify-CAS loop (ref: etcd_helper.go:311-345 AtomicUpdate).
+
+        ``update_fn`` receives the current object (or a fresh ``obj_type()``
+        when absent and ignore_not_found) and returns the desired object; on
+        CAS conflict the loop re-reads and retries. This is THE concurrency
+        primitive: the scheduler's bind path, status updates, and quota
+        decrements all go through it.
+        """
+        for _ in range(max_retries):
+            try:
+                kv = self.store.get(key)
+                current = self._decode(kv)
+                prev_index: Optional[int] = kv.modified_index
+            except ErrKeyNotFound:
+                if not ignore_not_found:
+                    raise errors.new_not_found(obj_type.__name__, key)
+                current = obj_type()
+                prev_index = None
+            desired = update_fn(current)
+            encoded = self._encode(desired)
+            try:
+                if prev_index is None:
+                    kv = self.store.create(key, encoded, ttl=ttl)
+                else:
+                    kv = self.store.compare_and_swap(key, encoded, prev_index, ttl=ttl)
+            except (ErrCASConflict, ErrKeyExists, ErrKeyNotFound):
+                continue  # re-read and retry
+            out = self.scheme.deep_copy(desired)
+            accessor.set_resource_version(out, str(kv.modified_index))
+            return out
+        raise errors.new_conflict(obj_type.__name__, key, "too many CAS retries")
+
+    # -- watch --------------------------------------------------------------
+    def watch(self, prefix: str, resource_version: str = "",
+              filter_fn: Optional[Callable[[Any], bool]] = None,
+              recursive: bool = True) -> watchpkg.Watcher:
+        """Decoded object watch (ref: etcd_helper_watch.go:64-95 WatchList).
+
+        Store events become ADDED/MODIFIED/DELETED watch.Events carrying API
+        objects. ``filter_fn`` implements label/field selection; like the
+        reference's etcdWatcher filter, an object transitioning out of the
+        filter emits DELETED and into it emits ADDED.
+        """
+        from_index = parse_watch_resource_version(resource_version)
+        try:
+            src = self.store.watch(prefix, from_index=from_index, recursive=recursive)
+        except ErrIndexOutdated as e:
+            # Surface as an API-level 410 so clients above the store boundary
+            # (Reflector, HTTP clients) share one expired-watch contract.
+            raise errors.new_expired(str(e))
+        out = watchpkg.Watcher(on_stop=lambda _w: src.stop())
+
+        def pump():
+            for ev in src:
+                sev = ev.object
+                try:
+                    cur = self._decode(sev.kv) if sev.kv else None
+                    prev = self._decode(sev.prev_kv) if sev.prev_kv else None
+                except Exception as e:  # undecodable payload: surface, keep going
+                    out.send(watchpkg.Event(watchpkg.ERROR, errors.new_internal_error(str(e)).status))
+                    continue
+                cur_ok = cur is not None and (filter_fn is None or filter_fn(cur))
+                prev_ok = prev is not None and (filter_fn is None or filter_fn(prev))
+                if sev.action in ("create",):
+                    if cur_ok:
+                        out.send(watchpkg.Event(watchpkg.ADDED, cur))
+                elif sev.action in ("set", "compareAndSwap"):
+                    if cur_ok and prev_ok:
+                        out.send(watchpkg.Event(watchpkg.MODIFIED, cur))
+                    elif cur_ok:
+                        out.send(watchpkg.Event(watchpkg.ADDED, cur))
+                    elif prev_ok:
+                        # fell out of the filter: deliver the *new* state like
+                        # the reference (etcd_helper_watch.go sendModify)
+                        out.send(watchpkg.Event(watchpkg.DELETED, cur))
+                elif sev.action in ("delete", "expire"):
+                    if prev_ok:
+                        prev_out = prev
+                        # deleted object carries the deletion resourceVersion
+                        accessor.set_resource_version(prev_out, str(sev.index))
+                        out.send(watchpkg.Event(watchpkg.DELETED, prev_out))
+            out.close()
+
+        t = threading.Thread(target=pump, daemon=True, name=f"watch-{prefix}")
+        t.start()
+        return out

@@ -1,0 +1,254 @@
+"""MemStore — the versioned KV at the bottom of the stack.
+
+Rebuild of the reference's persistence layer contract (etcd v2 as used by
+pkg/tools/etcd_helper.go): a key/value tree with
+
+- a single monotonically increasing **index**; every mutation gets one and
+  stamps the key's ``modified_index`` (etcd ModifiedIndex — the basis of all
+  resourceVersion semantics, ref: pkg/tools/etcd_helper_watch.go:47-57);
+- **compare-and-swap** on that index (ref: etcd CompareAndSwap, used by
+  EtcdHelper.AtomicUpdate, pkg/tools/etcd_helper.go:311-345);
+- **watch from an index**, recursively over a prefix, served from a bounded
+  in-memory event history (etcd keeps a 1000-event window; same here), with
+  "index outdated" errors past the window;
+- **TTL** per key (events use it, ref: pkg/registry/event seconds-to-live).
+
+It is deliberately also the test double: like the reference's FakeEtcdClient
+(pkg/tools/fake_etcd_client.go:42-67) it supports scriptable error injection
+per (op, key) so registry/controller tests can exercise failure paths.
+
+The store is process-local and thread-safe. A networked deployment puts the
+apiserver in front of it (components never share the store directly —
+DESIGN.md:40's invariant), so single-process ownership is the same model the
+reference has: only the apiserver talks to etcd.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from kubernetes_tpu import watch as watchpkg
+
+__all__ = ["MemStore", "KV", "StoreEvent", "StoreError", "ErrKeyExists",
+           "ErrKeyNotFound", "ErrCASConflict", "ErrIndexOutdated", "ErrInjected"]
+
+
+class StoreError(Exception):
+    pass
+
+
+class ErrKeyExists(StoreError):
+    pass
+
+
+class ErrKeyNotFound(StoreError):
+    pass
+
+
+class ErrCASConflict(StoreError):
+    pass
+
+
+class ErrIndexOutdated(StoreError):
+    """Watch index fell out of the history window (etcd error 401)."""
+
+
+class ErrInjected(StoreError):
+    """Raised by scripted error injection in tests."""
+
+
+@dataclass
+class KV:
+    key: str
+    value: str
+    created_index: int
+    modified_index: int
+    expiration: Optional[float] = None  # monotonic deadline
+
+    @property
+    def resource_version(self) -> int:
+        return self.modified_index
+
+
+@dataclass
+class StoreEvent:
+    """One mutation, as seen by watchers (etcd watch response analog)."""
+
+    action: str  # "create" | "set" | "compareAndSwap" | "delete" | "expire"
+    key: str
+    index: int
+    kv: Optional[KV] = None       # post-state (None for delete/expire)
+    prev_kv: Optional[KV] = None  # pre-state (None for create)
+
+
+class MemStore:
+    HISTORY_WINDOW = 1000
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self._lock = threading.Condition()
+        self._data: Dict[str, KV] = {}
+        self._index = 0
+        self._history: List[StoreEvent] = []
+        self._clock = clock
+        # test error injection: (op, key) -> exception to raise, one-shot list
+        self._inject: Dict[Tuple[str, str], List[Exception]] = {}
+        self._watchers: List[Tuple[str, bool, watchpkg.Watcher]] = []
+
+    # -- error injection (FakeEtcdClient analog) ---------------------------
+    def inject_error(self, op: str, key: str, exc: Exception, times: int = 1) -> None:
+        self._inject.setdefault((op, key), []).extend([exc] * times)
+
+    def _maybe_raise(self, op: str, key: str) -> None:
+        q = self._inject.get((op, key))
+        if q:
+            raise q.pop(0)
+
+    # -- internals ---------------------------------------------------------
+    def _expired(self, kv: KV) -> bool:
+        return kv.expiration is not None and self._clock() >= kv.expiration
+
+    def _sweep_locked(self) -> None:
+        now = self._clock()
+        dead = [k for k, kv in self._data.items()
+                if kv.expiration is not None and now >= kv.expiration]
+        for k in dead:
+            kv = self._data.pop(k)
+            self._index += 1
+            self._record_locked(StoreEvent("expire", k, self._index, None, kv))
+
+    def _record_locked(self, ev: StoreEvent) -> None:
+        self._history.append(ev)
+        if len(self._history) > self.HISTORY_WINDOW:
+            del self._history[: len(self._history) - self.HISTORY_WINDOW]
+        for prefix, recursive, w in list(self._watchers):
+            if w.stopped:
+                self._watchers.remove((prefix, recursive, w))
+                continue
+            if _match(ev.key, prefix, recursive):
+                w.send(watchpkg.Event(ev.action, ev))
+
+    # -- reads -------------------------------------------------------------
+    @property
+    def index(self) -> int:
+        with self._lock:
+            return self._index
+
+    def get(self, key: str) -> KV:
+        with self._lock:
+            self._maybe_raise("get", key)
+            self._sweep_locked()
+            kv = self._data.get(key)
+            if kv is None:
+                raise ErrKeyNotFound(key)
+            return kv
+
+    def list(self, prefix: str) -> Tuple[List[KV], int]:
+        """All KVs under prefix (recursive) + the store index at read time."""
+        with self._lock:
+            self._maybe_raise("list", prefix)
+            self._sweep_locked()
+            if prefix and not prefix.endswith("/"):
+                prefix = prefix + "/"
+            out = [kv for k, kv in sorted(self._data.items()) if k.startswith(prefix)]
+            return out, self._index
+
+    # -- writes ------------------------------------------------------------
+    def create(self, key: str, value: str, ttl: Optional[float] = None) -> KV:
+        with self._lock:
+            self._maybe_raise("create", key)
+            self._sweep_locked()
+            if key in self._data:
+                raise ErrKeyExists(key)
+            self._index += 1
+            kv = KV(key, value, self._index, self._index,
+                    self._clock() + ttl if ttl else None)
+            self._data[key] = kv
+            self._record_locked(StoreEvent("create", key, self._index, kv, None))
+            return kv
+
+    def set(self, key: str, value: str, ttl: Optional[float] = None) -> KV:
+        """Unconditional write (create or replace)."""
+        with self._lock:
+            self._maybe_raise("set", key)
+            self._sweep_locked()
+            prev = self._data.get(key)
+            self._index += 1
+            kv = KV(key, value, prev.created_index if prev else self._index,
+                    self._index, self._clock() + ttl if ttl else None)
+            self._data[key] = kv
+            self._record_locked(
+                StoreEvent("set" if prev else "create", key, self._index, kv, prev))
+            return kv
+
+    def compare_and_swap(self, key: str, value: str, prev_index: int,
+                         ttl: Optional[float] = None) -> KV:
+        """Write iff the key's modified_index is exactly prev_index
+        (ref: etcd CompareAndSwap; pkg/tools/etcd_helper.go:330)."""
+        with self._lock:
+            self._maybe_raise("compare_and_swap", key)
+            self._sweep_locked()
+            prev = self._data.get(key)
+            if prev is None:
+                raise ErrKeyNotFound(key)
+            if prev.modified_index != prev_index:
+                raise ErrCASConflict(
+                    f"{key}: index mismatch (have {prev.modified_index}, want {prev_index})")
+            self._index += 1
+            kv = KV(key, value, prev.created_index, self._index,
+                    self._clock() + ttl if ttl else None)
+            self._data[key] = kv
+            self._record_locked(StoreEvent("compareAndSwap", key, self._index, kv, prev))
+            return kv
+
+    def delete(self, key: str, prev_index: Optional[int] = None) -> KV:
+        with self._lock:
+            self._maybe_raise("delete", key)
+            self._sweep_locked()
+            prev = self._data.get(key)
+            if prev is None:
+                raise ErrKeyNotFound(key)
+            if prev_index is not None and prev.modified_index != prev_index:
+                raise ErrCASConflict(
+                    f"{key}: index mismatch (have {prev.modified_index}, want {prev_index})")
+            del self._data[key]
+            self._index += 1
+            self._record_locked(StoreEvent("delete", key, self._index, None, prev))
+            return prev
+
+    # -- watch -------------------------------------------------------------
+    def watch(self, prefix: str, from_index: int = 0,
+              recursive: bool = True) -> watchpkg.Watcher:
+        """Stream StoreEvents for keys under prefix with index > from_index.
+
+        from_index == 0 means "from now" (ref: ParseWatchResourceVersion,
+        pkg/tools/etcd_helper_watch.go:47-57: rv 0 watches from current state;
+        rv N resumes after N). History replay past the window raises
+        ErrIndexOutdated, which clients handle by relisting (the Reflector
+        contract, ref: pkg/client/cache/reflector.go:83).
+        """
+        with self._lock:
+            self._maybe_raise("watch", prefix)
+            if from_index:
+                oldest_replayable = self._history[0].index if self._history else self._index + 1
+                if from_index + 1 < oldest_replayable and from_index < self._index:
+                    # asked to replay events that are gone
+                    raise ErrIndexOutdated(
+                        f"requested index {from_index} is outside the history window")
+            w = watchpkg.Watcher()
+            if from_index:
+                for ev in self._history:
+                    if ev.index > from_index and _match(ev.key, prefix, recursive):
+                        w.send(watchpkg.Event(ev.action, ev))
+            self._watchers.append((prefix, recursive, w))
+            return w
+
+
+def _match(key: str, prefix: str, recursive: bool) -> bool:
+    if not recursive:
+        return key == prefix
+    if prefix and not prefix.endswith("/"):
+        prefix = prefix + "/"
+    return key.startswith(prefix) or key == prefix.rstrip("/")

@@ -1,0 +1,137 @@
+"""Watch primitives (ref: pkg/watch/).
+
+``Watcher`` is the consumer handle (ref: watch.Interface — a result channel
+plus Stop). ``Broadcaster`` fans one event stream out to many watchers
+(ref: pkg/watch/mux.go:63-143).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Any, Iterator, Optional
+
+__all__ = ["ADDED", "MODIFIED", "DELETED", "ERROR", "Event", "Watcher", "Broadcaster"]
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+ERROR = "ERROR"
+
+
+@dataclass
+class Event:
+    type: str
+    object: Any
+
+
+_SENTINEL = object()
+
+
+class Watcher:
+    """A stream of watch Events. Iterate it, or poll with next_event().
+
+    ref: pkg/watch/watch.go Interface — ResultChan() + Stop().
+    """
+
+    def __init__(self, maxsize: int = 0, on_stop=None):
+        self._q: "queue.Queue" = queue.Queue(maxsize=maxsize)
+        self._stopped = threading.Event()
+        self._on_stop = on_stop
+
+    # producer side -------------------------------------------------------
+    def send(self, event: Event, timeout: Optional[float] = None) -> bool:
+        if self._stopped.is_set():
+            return False
+        try:
+            self._q.put(event, timeout=timeout)
+            return True
+        except queue.Full:
+            return False
+
+    def close(self) -> None:
+        """End of stream: consumers see StopIteration after draining."""
+        if self._stopped.is_set():
+            return
+        self._stopped.set()
+        # Never block here: a full bounded queue would deadlock stop(). The
+        # stream is ending, so dropping one queued event to make room for the
+        # sentinel is safe.
+        while True:
+            try:
+                self._q.put_nowait(_SENTINEL)
+                return
+            except queue.Full:
+                try:
+                    self._q.get_nowait()
+                except queue.Empty:
+                    pass
+
+    # consumer side -------------------------------------------------------
+    def stop(self) -> None:
+        """Consumer is done (ref: watch.Interface.Stop)."""
+        cb, self._on_stop = self._on_stop, None
+        self.close()
+        if cb:
+            cb(self)
+
+    @property
+    def stopped(self) -> bool:
+        return self._stopped.is_set()
+
+    def next_event(self, timeout: Optional[float] = None) -> Optional[Event]:
+        """Next event or None on end-of-stream; raises queue.Empty on timeout."""
+        ev = self._q.get(timeout=timeout)
+        if ev is _SENTINEL:
+            self._q.put(_SENTINEL)  # keep the stream terminated for others
+            return None
+        return ev
+
+    def __iter__(self) -> Iterator[Event]:
+        while True:
+            ev = self._q.get()
+            if ev is _SENTINEL:
+                self._q.put(_SENTINEL)
+                return
+            yield ev
+
+
+class Broadcaster:
+    """Distributes events to many watchers (ref: pkg/watch/mux.go).
+
+    Watchers that fall behind beyond ``queue_length`` block the broadcast
+    (the reference's WaitIfChannelFull behavior) so no event is lost.
+    """
+
+    def __init__(self, queue_length: int = 25):
+        self._lock = threading.Lock()
+        self._watchers: set = set()
+        self._queue_length = queue_length
+        self._closed = False
+
+    def watch(self) -> Watcher:
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("broadcaster is shut down")
+            w = Watcher(maxsize=self._queue_length, on_stop=self._forget)
+            self._watchers.add(w)
+            return w
+
+    def _forget(self, w: Watcher) -> None:
+        with self._lock:
+            self._watchers.discard(w)
+
+    def action(self, event_type: str, obj: Any) -> None:
+        ev = Event(event_type, obj)
+        with self._lock:
+            watchers = list(self._watchers)
+        for w in watchers:
+            w.send(ev)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            watchers, self._watchers = list(self._watchers), set()
+            self._closed = True
+        for w in watchers:
+            w.close()

@@ -1,0 +1,288 @@
+"""MemStore + StoreHelper tests (ref: pkg/tools/etcd_helper_test.go,
+etcd_helper_watch_test.go, fake_etcd_client semantics)."""
+
+import queue
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu import watch as watchpkg
+from kubernetes_tpu.api import errors
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.latest import scheme
+from kubernetes_tpu.storage.helper import StoreHelper, parse_watch_resource_version
+from kubernetes_tpu.storage.memstore import (
+    ErrCASConflict,
+    ErrIndexOutdated,
+    ErrInjected,
+    ErrKeyExists,
+    ErrKeyNotFound,
+    MemStore,
+)
+
+
+# -- raw store --------------------------------------------------------------
+
+def test_create_get_list_delete():
+    s = MemStore()
+    kv = s.create("/pods/default/a", "1")
+    assert kv.modified_index == 1
+    assert s.get("/pods/default/a").value == "1"
+    s.create("/pods/default/b", "2")
+    s.create("/pods/other/c", "3")
+    kvs, index = s.list("/pods/default")
+    assert [k.value for k in kvs] == ["1", "2"]
+    assert index == 3
+    s.delete("/pods/default/a")
+    with pytest.raises(ErrKeyNotFound):
+        s.get("/pods/default/a")
+
+
+def test_create_existing_fails():
+    s = MemStore()
+    s.create("/k", "v")
+    with pytest.raises(ErrKeyExists):
+        s.create("/k", "v2")
+
+
+def test_cas_semantics():
+    s = MemStore()
+    kv = s.create("/k", "v1")
+    kv2 = s.compare_and_swap("/k", "v2", kv.modified_index)
+    assert kv2.value == "v2" and kv2.modified_index > kv.modified_index
+    with pytest.raises(ErrCASConflict):
+        s.compare_and_swap("/k", "v3", kv.modified_index)  # stale index
+    with pytest.raises(ErrKeyNotFound):
+        s.compare_and_swap("/missing", "v", 1)
+
+
+def test_index_monotonic_across_keys():
+    s = MemStore()
+    a = s.create("/a", "1")
+    b = s.create("/b", "1")
+    c = s.set("/a", "2")
+    assert (a.modified_index, b.modified_index, c.modified_index) == (1, 2, 3)
+    assert s.index == 3
+
+
+def test_ttl_expiry():
+    now = [0.0]
+    s = MemStore(clock=lambda: now[0])
+    s.create("/e", "x", ttl=5.0)
+    assert s.get("/e").value == "x"
+    now[0] = 6.0
+    with pytest.raises(ErrKeyNotFound):
+        s.get("/e")
+
+
+def test_watch_from_now_and_replay():
+    s = MemStore()
+    s.create("/p/a", "1")
+    # from_index: replay history after index 1
+    w = s.watch("/p", from_index=1)
+    s.set("/p/a", "2")
+    ev = w.next_event(timeout=1)
+    assert ev.type == "set" and ev.object.kv.value == "2"
+    # watch from now sees only future events
+    w2 = s.watch("/p", from_index=0)
+    s.delete("/p/a")
+    ev2 = w2.next_event(timeout=1)
+    assert ev2.type == "delete" and ev2.object.prev_kv.value == "2"
+    w.stop()
+    w2.stop()
+
+
+def test_watch_replays_missed_events():
+    s = MemStore()
+    kv = s.create("/p/a", "1")
+    s.set("/p/a", "2")
+    s.set("/p/a", "3")
+    w = s.watch("/p", from_index=kv.modified_index)
+    assert w.next_event(timeout=1).object.kv.value == "2"
+    assert w.next_event(timeout=1).object.kv.value == "3"
+    w.stop()
+
+
+def test_watch_history_window_outdated():
+    s = MemStore()
+    s.create("/p/a", "0")
+    for i in range(MemStore.HISTORY_WINDOW + 10):
+        s.set("/p/a", str(i))
+    with pytest.raises(ErrIndexOutdated):
+        s.watch("/p", from_index=1)
+
+
+def test_watch_prefix_isolation():
+    s = MemStore()
+    w = s.watch("/pods", from_index=0)
+    s.create("/nodes/n1", "x")
+    s.create("/pods/p1", "y")
+    ev = w.next_event(timeout=1)
+    assert ev.object.key == "/pods/p1"
+    w.stop()
+
+
+def test_error_injection():
+    s = MemStore()
+    s.inject_error("create", "/k", ErrInjected("boom"))
+    with pytest.raises(ErrInjected):
+        s.create("/k", "v")
+    s.create("/k", "v")  # one-shot: second attempt succeeds
+
+
+# -- typed helper -----------------------------------------------------------
+
+def _helper():
+    return StoreHelper(MemStore(), scheme)
+
+
+def _pod(name="p", ns="default", host=""):
+    return api.Pod(metadata=api.ObjectMeta(name=name, namespace=ns),
+                   spec=api.PodSpec(host=host,
+                                    containers=[api.Container(name="c", image="i")]))
+
+
+def test_helper_create_and_extract():
+    h = _helper()
+    out = h.create_obj("/pods/default/p", _pod())
+    assert out.metadata.resource_version == "1"
+    got = h.extract_obj("/pods/default/p")
+    assert got.metadata.name == "p"
+    assert got.metadata.resource_version == "1"
+    with pytest.raises(errors.StatusError) as ei:
+        h.create_obj("/pods/default/p", _pod())
+    assert errors.is_already_exists(ei.value)
+
+
+def test_helper_set_with_rv_cas():
+    h = _helper()
+    out = h.create_obj("/pods/default/p", _pod())
+    out.spec.host = "node-1"
+    out2 = h.set_obj("/pods/default/p", out)
+    assert int(out2.metadata.resource_version) > int(out.metadata.resource_version)
+    # stale rv conflicts
+    out.metadata.resource_version = "1"
+    with pytest.raises(errors.StatusError) as ei:
+        h.set_obj("/pods/default/p", out)
+    assert errors.is_conflict(ei.value)
+
+
+def test_helper_extract_to_list():
+    h = _helper()
+    h.create_obj("/pods/default/a", _pod("a"))
+    h.create_obj("/pods/default/b", _pod("b"))
+    lst = h.extract_to_list("/pods/default", api.PodList)
+    assert [p.metadata.name for p in lst.items] == ["a", "b"]
+    assert lst.metadata.resource_version == "2"
+
+
+def test_atomic_update_retries_on_conflict():
+    h = _helper()
+    h.create_obj("/k", _pod())
+    calls = []
+
+    def racing_update(current):
+        calls.append(1)
+        if len(calls) == 1:
+            # simulate a concurrent writer between read and CAS
+            raw = h.store.get("/k")
+            h.store.compare_and_swap("/k", raw.value, raw.modified_index)
+        current.spec.host = "won"
+        return current
+
+    out = h.atomic_update("/k", api.Pod, racing_update)
+    assert out.spec.host == "won"
+    assert len(calls) == 2  # first attempt conflicted, second succeeded
+    assert h.extract_obj("/k").spec.host == "won"
+
+
+def test_atomic_update_bind_conflict_guard():
+    """The scheduler bind path: set host iff currently empty
+    (ref: pkg/registry/pod/etcd/etcd.go:125-127 assignPod)."""
+    h = _helper()
+    h.create_obj("/k", _pod())
+
+    def bind(host):
+        def fn(pod):
+            if pod.spec.host:
+                raise errors.new_conflict("Pod", pod.metadata.name, "pod is already assigned")
+            pod.spec.host = host
+            return pod
+        return fn
+
+    h.atomic_update("/k", api.Pod, bind("n1"))
+    with pytest.raises(errors.StatusError) as ei:
+        h.atomic_update("/k", api.Pod, bind("n2"))
+    assert errors.is_conflict(ei.value)
+    assert h.extract_obj("/k").spec.host == "n1"
+
+
+def test_helper_watch_decoded_stream():
+    h = _helper()
+    w = h.watch("/pods", resource_version="")
+    h.create_obj("/pods/default/a", _pod("a"))
+    ev = w.next_event(timeout=1)
+    assert ev.type == watchpkg.ADDED and ev.object.metadata.name == "a"
+    got = h.extract_obj("/pods/default/a")
+    got.status.phase = api.PodRunning
+    h.set_obj("/pods/default/a", got)
+    ev = w.next_event(timeout=1)
+    assert ev.type == watchpkg.MODIFIED and ev.object.status.phase == api.PodRunning
+    h.delete_obj("/pods/default/a")
+    ev = w.next_event(timeout=1)
+    assert ev.type == watchpkg.DELETED and ev.object.metadata.name == "a"
+    w.stop()
+
+
+def test_helper_watch_resume_from_rv():
+    h = _helper()
+    out = h.create_obj("/pods/default/a", _pod("a"))
+    out.status.phase = api.PodRunning
+    h.set_obj("/pods/default/a", out)
+    # resume after create: must deliver the MODIFIED event
+    w = h.watch("/pods", resource_version="1")
+    ev = w.next_event(timeout=1)
+    assert ev.type == watchpkg.MODIFIED
+    assert ev.object.status.phase == api.PodRunning
+    w.stop()
+
+
+def test_helper_watch_filter_transitions():
+    h = _helper()
+    w = h.watch("/pods", filter_fn=lambda p: p.spec.host == "")
+    h.create_obj("/pods/default/a", _pod("a"))
+    assert w.next_event(timeout=1).type == watchpkg.ADDED
+    got = h.extract_obj("/pods/default/a")
+    got.spec.host = "n1"
+    h.set_obj("/pods/default/a", got)  # falls out of filter
+    assert w.next_event(timeout=1).type == watchpkg.DELETED
+    w.stop()
+
+
+def test_parse_watch_resource_version():
+    assert parse_watch_resource_version("") == 0
+    assert parse_watch_resource_version("0") == 0
+    assert parse_watch_resource_version("42") == 42
+    with pytest.raises(errors.StatusError):
+        parse_watch_resource_version("bogus")
+
+
+def test_concurrent_atomic_updates():
+    """Many writers incrementing one counter through CAS all land."""
+    h = _helper()
+    h.create_obj("/rc", api.ReplicationController(
+        metadata=api.ObjectMeta(name="rc", namespace="default")))
+
+    def bump():
+        def fn(rc):
+            rc.spec.replicas += 1
+            return rc
+        h.atomic_update("/rc", api.ReplicationController, fn)
+
+    threads = [threading.Thread(target=bump) for _ in range(10)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert h.extract_obj("/rc").spec.replicas == 10
