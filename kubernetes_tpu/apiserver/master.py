@@ -1,0 +1,181 @@
+"""Master — constructs every registry and serves the verb dispatch.
+
+Rebuild of ``pkg/master/master.go:350-490`` + the generic REST handlers
+(``pkg/apiserver/resthandler.go``): one Config builds the store, the typed
+helper, all per-resource registries and sub-resources, the admission chain,
+and exposes ``dispatch`` — the single seam shared by the in-process client
+and the HTTP layer, mirroring the reference invariant that every component
+talks only through the API surface (DESIGN.md:40).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Dict, List, Optional
+
+from kubernetes_tpu import admission as admission_pkg
+from kubernetes_tpu.admission import plugins as admission_plugins  # registers factories
+from kubernetes_tpu.api import errors
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.fields import parse_field_selector
+from kubernetes_tpu.api.labels import parse_selector
+from kubernetes_tpu.api.latest import scheme as default_scheme
+from kubernetes_tpu.api.meta import default_rest_mapper
+from kubernetes_tpu.registry import resources as reg
+from kubernetes_tpu.registry.generic import Context
+from kubernetes_tpu.storage.helper import StoreHelper
+from kubernetes_tpu.storage.memstore import MemStore
+
+__all__ = ["Master", "MasterConfig"]
+
+DEFAULT_ADMISSION = ("NamespaceAutoProvision", "NamespaceLifecycle",
+                     "LimitRanger", "ResourceQuota")
+
+
+@dataclass
+class MasterConfig:
+    """ref: master.Config (master.go:112-160)."""
+
+    store: Optional[MemStore] = None
+    scheme: Any = None
+    admission_control: tuple = DEFAULT_ADMISSION
+    authorizer: Any = None          # .authorize(user, attrs) raising Forbidden
+    portal_net: str = "10.0.0.0/24"
+    event_ttl_seconds: float = 3600.0
+
+
+class Master:
+    def __init__(self, config: Optional[MasterConfig] = None):
+        c = config or MasterConfig()
+        self.store = c.store or MemStore()
+        self.scheme = c.scheme or default_scheme
+        self.helper = StoreHelper(self.store, self.scheme)
+        self.mapper = default_rest_mapper()
+        self.authorizer = c.authorizer
+
+        # registries (ref: master.go:350-396 init)
+        self.pods = reg.make_pod_registry(self.helper)
+        self.controllers = reg.make_rc_registry(self.helper)
+        self.services = reg.make_service_registry(
+            self.helper, reg.IPAllocator(c.portal_net))
+        self.endpoints = reg.make_endpoints_registry(self.helper)
+        self.nodes = reg.make_node_registry(self.helper)
+        self.events = reg.make_event_registry(self.helper, c.event_ttl_seconds)
+        self.namespaces = reg.make_namespace_registry(self.helper)
+        self.secrets = reg.make_secret_registry(self.helper)
+        self.limitranges = reg.make_limitrange_registry(self.helper)
+        self.resourcequotas = reg.make_resourcequota_registry(self.helper)
+
+        # sub/special resources
+        self.bindings = reg.BindingREST(self.pods)
+        self.pod_status = reg.PodStatusREST(self.pods)
+        self.ns_finalize = reg.NamespaceFinalizeREST(self.namespaces)
+        self.quota_status = reg.ResourceQuotaStatusREST(self.resourcequotas)
+
+        # the storage map (ref: master.go:350 "storage" map[string]RESTStorage)
+        self.storage: Dict[str, Any] = {
+            "pods": self.pods,
+            "replicationcontrollers": self.controllers,
+            "services": self.services,
+            "endpoints": self.endpoints,
+            "nodes": self.nodes,
+            "events": self.events,
+            "namespaces": self.namespaces,
+            "secrets": self.secrets,
+            "limitranges": self.limitranges,
+            "resourcequotas": self.resourcequotas,
+        }
+        self.subresources: Dict[tuple, Any] = {
+            ("pods", "binding"): self.bindings,
+            ("pods", "status"): self.pod_status,
+            ("namespaces", "finalize"): self.ns_finalize,
+            ("resourcequotas", "status"): self.quota_status,
+        }
+
+        self.admission = admission_pkg.new_from_plugins(
+            list(c.admission_control),
+            namespaces=self.namespaces,
+            limitranges=self.limitranges,
+            resourcequotas=self.resourcequotas,
+        )
+
+        # bootstrap: the default namespace always exists (the reference
+        # auto-provisions "default" via admission; we seed it eagerly too)
+        try:
+            self.namespaces.create(
+                Context(), api.Namespace(metadata=api.ObjectMeta(name=api.NamespaceDefault)))
+        except errors.StatusError as e:
+            if not errors.is_already_exists(e):
+                raise
+
+    # ------------------------------------------------------------------
+    def _registry(self, resource: str):
+        resource = self.mapper.resource_for(self.mapper.kind_for(resource)) \
+            if self.mapper.has_resource(resource) else resource
+        r = self.storage.get(resource)
+        if r is None:
+            raise errors.new_not_found("resource", resource)
+        return resource, r
+
+    def _authorize(self, user, attrs: admission_pkg.Attributes) -> None:
+        if self.authorizer is not None:
+            self.authorizer.authorize(user, attrs)
+
+    def dispatch(self, verb: str, resource: str, *, namespace: str = "",
+                 name: str = "", body: Any = None, subresource: str = "",
+                 label_selector: str = "", field_selector: str = "",
+                 resource_version: str = "", user: Any = None) -> Any:
+        """The generic REST entry (ref: resthandler.go Get/List/Create/Update/
+        Delete/Watch Resource). Verbs: get, list, create, update, delete,
+        watch. Returns API objects, or a watch.Watcher for watch."""
+        canonical, registry = self._registry(resource)
+        ctx = Context(namespace=namespace, user=user)
+        attrs = admission_pkg.Attributes(
+            operation="", resource=canonical, namespace=namespace, name=name,
+            obj=body, user=user, subresource=subresource)
+
+        if subresource:
+            sub = self.subresources.get((canonical, subresource))
+            if sub is None:
+                raise errors.new_not_found("resource", f"{canonical}/{subresource}")
+            if verb == "create":
+                attrs.operation = admission_pkg.CREATE
+                self._authorize(user, attrs)
+                self.admission.admit(attrs)
+                return sub.create(ctx, body)
+            if verb == "update":
+                attrs.operation = admission_pkg.UPDATE
+                self._authorize(user, attrs)
+                self.admission.admit(attrs)
+                return sub.update(ctx, body)
+            raise errors.new_method_not_supported(canonical, verb)
+
+        if verb == "get":
+            self._authorize(user, attrs)
+            return registry.get(ctx, name)
+        if verb == "list":
+            self._authorize(user, attrs)
+            return registry.list(ctx, parse_selector(label_selector),
+                                 parse_field_selector(field_selector))
+        if verb == "watch":
+            self._authorize(user, attrs)
+            return registry.watch(ctx, parse_selector(label_selector),
+                                  parse_field_selector(field_selector),
+                                  resource_version=resource_version)
+        if verb == "create":
+            attrs.operation = admission_pkg.CREATE
+            attrs.name = getattr(getattr(body, "metadata", None), "name", name)
+            self._authorize(user, attrs)
+            self.admission.admit(attrs)
+            return registry.create(ctx, body)
+        if verb == "update":
+            attrs.operation = admission_pkg.UPDATE
+            self._authorize(user, attrs)
+            self.admission.admit(attrs)
+            return registry.update(ctx, body)
+        if verb == "delete":
+            attrs.operation = admission_pkg.DELETE
+            self._authorize(user, attrs)
+            self.admission.admit(attrs)
+            return registry.delete(ctx, name)
+        raise errors.new_method_not_supported(canonical, verb)

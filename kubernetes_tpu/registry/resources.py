@@ -1,0 +1,430 @@
+"""Per-resource registries: strategies + resource-specific REST extras.
+
+Rebuild of ``pkg/registry/{pod,controller,service,endpoint,minion,event,
+namespace,secret,limitrange,resourcequota}/``. Each resource is a Strategy
+over the GenericRegistry plus, where the reference has them, special verbs:
+
+- pods: **BindingREST** — the scheduler's write path: Create(Binding) performs
+  an atomic CAS setting spec.host iff currently empty
+  (ref: pkg/registry/pod/etcd/etcd.go:98-152 assignPod), plus a status
+  sub-resource update.
+- services: portal IP allocation from a bitmap allocator
+  (ref: pkg/registry/service/ip_allocator.go:29-241).
+- events: TTL'd storage.
+- namespaces: deletion flips status.phase to Terminating; the finalize
+  sub-resource removes finalizers; actual deletion requires empty finalizers
+  (ref: pkg/registry/namespace/etcd/etcd.go + namespace lifecycle design).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional
+
+from kubernetes_tpu.api import errors
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api import validation
+from kubernetes_tpu.api.meta import accessor
+from kubernetes_tpu.registry.generic import Context, GenericRegistry, Strategy
+from kubernetes_tpu.storage.helper import StoreHelper
+
+__all__ = [
+    "make_pod_registry", "BindingREST", "PodStatusREST",
+    "make_rc_registry", "make_service_registry", "make_endpoints_registry",
+    "make_node_registry", "make_event_registry", "make_namespace_registry",
+    "NamespaceFinalizeREST", "make_secret_registry", "make_limitrange_registry",
+    "make_resourcequota_registry", "ResourceQuotaStatusREST", "IPAllocator",
+]
+
+
+# ---------------------------------------------------------------------------
+# Pods
+# ---------------------------------------------------------------------------
+
+
+class PodStrategy(Strategy):
+    kind = "Pod"
+    namespaced = True
+
+    def prepare_for_create(self, ctx, pod: api.Pod) -> None:
+        pod.status = api.PodStatus(phase=api.PodPending)
+
+    def validate(self, ctx, pod: api.Pod) -> List[Exception]:
+        return validation.validate_pod(pod)
+
+    def prepare_for_update(self, ctx, new: api.Pod, old: api.Pod) -> None:
+        pass
+
+    def validate_update(self, ctx, new: api.Pod, old: api.Pod) -> List[Exception]:
+        return validation.validate_pod_update(new, old)
+
+
+def pod_attr_func(pod: api.Pod):
+    """Pod label/field attributes (ref: pkg/registry/pod/rest.go
+    PodToSelectableFields — the scheduler selects on spec.host='')."""
+    return accessor.labels(pod), {
+        "metadata.name": pod.metadata.name,
+        "spec.host": pod.spec.host,
+        "status.phase": pod.status.phase,
+    }
+
+
+def make_pod_registry(helper: StoreHelper) -> GenericRegistry:
+    return GenericRegistry(helper, "/registry/pods", api.Pod, api.PodList,
+                           PodStrategy(), attr_func=pod_attr_func)
+
+
+class BindingREST:
+    """POST /bindings (ref: pkg/registry/pod/etcd/etcd.go:98-152).
+
+    The bind is an AtomicUpdate that sets spec.host iff it is empty — the
+    CAS guard that makes concurrent schedulers safe.
+    """
+
+    kind = "Binding"
+
+    def __init__(self, pod_registry: GenericRegistry):
+        self.pods = pod_registry
+
+    def create(self, ctx: Context, binding: api.Binding) -> api.Status:
+        name = binding.pod_name or binding.metadata.name
+        if not name:
+            raise errors.new_bad_request("binding must name a pod")
+        if not binding.host:
+            raise errors.new_bad_request("binding must name a host")
+        key = self.pods.key(ctx, name)
+
+        def assign(pod: api.Pod) -> api.Pod:
+            if pod.spec.host:
+                raise errors.new_conflict(
+                    "Pod", name, f"pod {name} is already assigned to host {pod.spec.host!r}")
+            pod.spec.host = binding.host
+            pod.status.host = binding.host
+            return pod
+
+        self.pods.helper.atomic_update(key, api.Pod, assign)
+        return api.Status(status=api.StatusSuccess)
+
+
+class PodStatusREST:
+    """PUT pods/{name}/status — status-only update sub-resource."""
+
+    def __init__(self, pod_registry: GenericRegistry):
+        self.pods = pod_registry
+
+    def update(self, ctx: Context, pod: api.Pod) -> api.Pod:
+        key = self.pods.key(ctx, pod.metadata.name)
+
+        def set_status(current: api.Pod) -> api.Pod:
+            current.status = pod.status
+            return current
+
+        return self.pods.helper.atomic_update(key, api.Pod, set_status)
+
+
+# ---------------------------------------------------------------------------
+# ReplicationControllers
+# ---------------------------------------------------------------------------
+
+
+class RCStrategy(Strategy):
+    kind = "ReplicationController"
+
+    def prepare_for_create(self, ctx, rc: api.ReplicationController) -> None:
+        rc.status = api.ReplicationControllerStatus()
+
+    def validate(self, ctx, rc) -> List[Exception]:
+        return validation.validate_replication_controller(rc)
+
+    def validate_update(self, ctx, new, old) -> List[Exception]:
+        return validation.validate_replication_controller(new)
+
+
+def make_rc_registry(helper: StoreHelper) -> GenericRegistry:
+    return GenericRegistry(helper, "/registry/controllers", api.ReplicationController,
+                           api.ReplicationControllerList, RCStrategy())
+
+
+# ---------------------------------------------------------------------------
+# Services + portal IP allocation
+# ---------------------------------------------------------------------------
+
+
+class IPAllocator:
+    """Bitmap allocator over a /24-ish CIDR
+    (ref: pkg/registry/service/ip_allocator.go:29-241)."""
+
+    def __init__(self, cidr: str = "10.0.0.0/24"):
+        import ipaddress
+
+        self.network = ipaddress.ip_network(cidr)
+        self._lock = threading.Lock()
+        self._used = set()
+        # network and broadcast addresses are never handed out
+        self._reserved = {self.network.network_address, self.network.broadcast_address}
+
+    def allocate(self, ip: Optional[str] = None) -> str:
+        import ipaddress
+
+        with self._lock:
+            if ip:
+                addr = ipaddress.ip_address(ip)
+                if addr not in self.network or addr in self._reserved:
+                    raise errors.new_invalid("Service", ip,
+                                             [ValueError(f"{ip} not usable in portal net {self.network}")])
+                if addr in self._used:
+                    raise errors.new_conflict("Service", ip, f"portal IP {ip} already allocated")
+                self._used.add(addr)
+                return str(addr)
+            for addr in self.network.hosts():
+                if addr not in self._used and addr not in self._reserved:
+                    self._used.add(addr)
+                    return str(addr)
+            raise errors.new_internal_error("portal IP range exhausted")
+
+    def release(self, ip: str) -> None:
+        import ipaddress
+
+        with self._lock:
+            self._used.discard(ipaddress.ip_address(ip))
+
+
+class ServiceStrategy(Strategy):
+    kind = "Service"
+
+    def validate(self, ctx, svc) -> List[Exception]:
+        return validation.validate_service(svc)
+
+    def validate_update(self, ctx, new, old) -> List[Exception]:
+        errs = validation.validate_service(new)
+        if old.spec.portal_ip and new.spec.portal_ip != old.spec.portal_ip:
+            errs.append(ValueError("spec.portalIP: may not be changed"))
+        return errs
+
+
+class ServiceRegistry(GenericRegistry):
+    """Service storage owning portal-IP lifecycle
+    (ref: pkg/registry/service/rest.go Create/Delete)."""
+
+    def __init__(self, helper: StoreHelper, allocator: Optional[IPAllocator] = None):
+        super().__init__(helper, "/registry/services", api.Service, api.ServiceList,
+                         ServiceStrategy())
+        self.allocator = allocator or IPAllocator()
+        # Rebuild the allocation bitmap from pre-existing services, like the
+        # reference does on startup (ip_allocator.go) — a Master over an
+        # existing store must not hand out IPs already in use.
+        for svc in self.helper.extract_to_list(self.prefix, api.ServiceList).items:
+            if svc.spec.portal_ip:
+                try:
+                    self.allocator.allocate(svc.spec.portal_ip)
+                except errors.StatusError:
+                    pass  # duplicate/bad legacy data: leave as-is
+
+    def create(self, ctx: Context, svc: api.Service) -> api.Service:
+        ip = self.allocator.allocate(svc.spec.portal_ip or None)
+        svc.spec.portal_ip = ip
+        try:
+            return super().create(ctx, svc)
+        except Exception:
+            self.allocator.release(ip)
+            raise
+
+    def delete(self, ctx: Context, name: str) -> api.Status:
+        svc = self.get(ctx, name)
+        status = super().delete(ctx, name)
+        if svc.spec.portal_ip:
+            self.allocator.release(svc.spec.portal_ip)
+        return status
+
+
+def make_service_registry(helper: StoreHelper,
+                          allocator: Optional[IPAllocator] = None) -> ServiceRegistry:
+    return ServiceRegistry(helper, allocator)
+
+
+class EndpointsStrategy(Strategy):
+    kind = "Endpoints"
+    allow_create_on_update = True
+
+
+def make_endpoints_registry(helper: StoreHelper) -> GenericRegistry:
+    return GenericRegistry(helper, "/registry/endpoints", api.Endpoints,
+                           api.EndpointsList, EndpointsStrategy())
+
+
+# ---------------------------------------------------------------------------
+# Nodes
+# ---------------------------------------------------------------------------
+
+
+class NodeStrategy(Strategy):
+    kind = "Node"
+    namespaced = False
+
+    def validate(self, ctx, node) -> List[Exception]:
+        return validation.validate_node(node)
+
+
+def node_attr_func(node: api.Node):
+    return accessor.labels(node), {
+        "metadata.name": node.metadata.name,
+        "spec.unschedulable": str(node.spec.unschedulable).lower(),
+    }
+
+
+def make_node_registry(helper: StoreHelper) -> GenericRegistry:
+    return GenericRegistry(helper, "/registry/minions", api.Node, api.NodeList,
+                           NodeStrategy(), attr_func=node_attr_func)
+
+
+# ---------------------------------------------------------------------------
+# Events (TTL'd)
+# ---------------------------------------------------------------------------
+
+
+class EventStrategy(Strategy):
+    kind = "Event"
+    allow_create_on_update = True
+
+    def validate(self, ctx, ev) -> List[Exception]:
+        return validation.validate_event(ev)
+
+    def validate_update(self, ctx, new, old) -> List[Exception]:
+        return validation.validate_event(new)
+
+
+def make_event_registry(helper: StoreHelper, ttl_seconds: float = 3600.0) -> GenericRegistry:
+    """ref: pkg/registry/event/registry.go — events carry an etcd TTL."""
+    return GenericRegistry(helper, "/registry/events", api.Event, api.EventList,
+                           EventStrategy(), ttl_func=lambda ev: ttl_seconds)
+
+
+# ---------------------------------------------------------------------------
+# Namespaces (finalizer-driven termination)
+# ---------------------------------------------------------------------------
+
+
+class NamespaceStrategy(Strategy):
+    kind = "Namespace"
+    namespaced = False
+
+    def prepare_for_create(self, ctx, ns: api.Namespace) -> None:
+        ns.status = api.NamespaceStatus(phase=api.NamespaceActive)
+        if api.FinalizerKubernetes not in ns.spec.finalizers:
+            ns.spec.finalizers.append(api.FinalizerKubernetes)
+
+    def validate(self, ctx, ns) -> List[Exception]:
+        return validation.validate_namespace(ns)
+
+
+class NamespaceRegistry(GenericRegistry):
+    """DELETE marks Terminating while finalizers remain; the namespace
+    controller drains content, finalizes, and re-deletes
+    (ref: namespace lifecycle, pkg/registry/namespace/)."""
+
+    def __init__(self, helper: StoreHelper):
+        super().__init__(helper, "/registry/namespaces", api.Namespace,
+                         api.NamespaceList, NamespaceStrategy())
+
+    def delete(self, ctx: Context, name: str) -> api.Status:
+        ns = self.get(ctx, name)
+        if ns.spec.finalizers:
+            def terminate(cur: api.Namespace) -> api.Namespace:
+                cur.status.phase = api.NamespaceTerminating
+                return cur
+
+            self.helper.atomic_update(self.key(ctx, name), api.Namespace, terminate)
+            return api.Status(status=api.StatusSuccess,
+                              reason="Terminating",
+                              message=f"namespace {name} is terminating; "
+                                      "content is being drained")
+        return super().delete(ctx, name)
+
+
+class NamespaceFinalizeREST:
+    """PUT namespaces/{name}/finalize — replace spec.finalizers."""
+
+    def __init__(self, registry: NamespaceRegistry):
+        self.registry = registry
+
+    def update(self, ctx: Context, ns: api.Namespace) -> api.Namespace:
+        key = self.registry.key(ctx, ns.metadata.name)
+
+        def fin(cur: api.Namespace) -> api.Namespace:
+            cur.spec.finalizers = list(ns.spec.finalizers)
+            return cur
+
+        return self.registry.helper.atomic_update(key, api.Namespace, fin)
+
+
+def make_namespace_registry(helper: StoreHelper) -> NamespaceRegistry:
+    return NamespaceRegistry(helper)
+
+
+# ---------------------------------------------------------------------------
+# Secrets, LimitRanges, ResourceQuotas
+# ---------------------------------------------------------------------------
+
+
+class SecretStrategy(Strategy):
+    kind = "Secret"
+
+    def validate(self, ctx, s) -> List[Exception]:
+        import base64
+
+        errs = validation.validate_object_meta(s.metadata, namespaced=True)
+        total = 0
+        for k, v in (s.data or {}).items():
+            try:
+                total += len(base64.b64decode(v, validate=True))
+            except Exception:
+                errs.append(ValueError(f"data[{k}]: not valid base64"))
+        if total > 1024 * 1024:
+            errs.append(ValueError("secret data exceeds 1MB"))
+        return errs
+
+
+def make_secret_registry(helper: StoreHelper) -> GenericRegistry:
+    return GenericRegistry(helper, "/registry/secrets", api.Secret, api.SecretList,
+                           SecretStrategy())
+
+
+class LimitRangeStrategy(Strategy):
+    kind = "LimitRange"
+
+
+def make_limitrange_registry(helper: StoreHelper) -> GenericRegistry:
+    return GenericRegistry(helper, "/registry/limitranges", api.LimitRange,
+                           api.LimitRangeList, LimitRangeStrategy())
+
+
+class ResourceQuotaStrategy(Strategy):
+    kind = "ResourceQuota"
+
+    def prepare_for_create(self, ctx, q: api.ResourceQuota) -> None:
+        q.status = api.ResourceQuotaStatus(hard=dict(q.spec.hard))
+
+
+def make_resourcequota_registry(helper: StoreHelper) -> GenericRegistry:
+    return GenericRegistry(helper, "/registry/resourcequotas", api.ResourceQuota,
+                           api.ResourceQuotaList, ResourceQuotaStrategy())
+
+
+class ResourceQuotaStatusREST:
+    """PUT resourcequotas/{name}/status — used by the quota admission plugin's
+    CAS-based usage decrement (ref: plugin/pkg/admission/resourcequota)."""
+
+    def __init__(self, registry: GenericRegistry):
+        self.registry = registry
+
+    def update(self, ctx: Context, quota: api.ResourceQuota) -> api.ResourceQuota:
+        key = self.registry.key(ctx, quota.metadata.name)
+        expect_rv = quota.metadata.resource_version
+
+        def set_status(cur: api.ResourceQuota) -> api.ResourceQuota:
+            if expect_rv and cur.metadata.resource_version != expect_rv:
+                raise errors.new_conflict("ResourceQuota", quota.metadata.name)
+            cur.status = quota.status
+            return cur
+
+        return self.registry.helper.atomic_update(key, api.ResourceQuota, set_status)

@@ -76,7 +76,13 @@ def to_wire(obj: Any) -> Any:
             if f.default is not dataclasses.MISSING and v == f.default and not f.metadata.get("keep_empty"):
                 continue
             if isinstance(v, (list, dict)) and not v and not f.metadata.get("keep_empty"):
-                continue
+                # only omit an empty collection when decoding restores the same
+                # empty value — a non-empty default (e.g. NamespaceSpec
+                # .finalizers) must be encoded explicitly or a cleared list
+                # would resurrect the default on round-trip.
+                if (f.default_factory is dataclasses.MISSING
+                        or not f.default_factory()):
+                    continue
             out[_wire_name(f)] = to_wire(v)
         return out
     if isinstance(obj, dict):

@@ -1,0 +1,331 @@
+"""Master/registry/admission/client tests.
+
+Mirrors the reference's registry tests (pkg/registry/*_test.go), the
+resttest conformance shape (pkg/api/rest/resttest), and admission plugin
+tests (plugin/pkg/admission/*_test.go).
+"""
+
+import pytest
+
+from kubernetes_tpu.api import errors
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.quantity import Quantity
+from kubernetes_tpu.apiserver.master import Master, MasterConfig
+from kubernetes_tpu.client.client import Client, FakeClient, InProcessTransport
+from kubernetes_tpu import watch as watchpkg
+
+
+@pytest.fixture()
+def cluster():
+    m = Master()
+    return m, Client(InProcessTransport(m))
+
+
+def _pod(name, ns="default", labels=None, host="", cpu="100m", mem="64Mi", ports=()):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace=ns, labels=labels or {}),
+        spec=api.PodSpec(
+            host=host,
+            containers=[api.Container(
+                name="ctr", image="img",
+                ports=[api.ContainerPort(container_port=80, host_port=p) for p in ports],
+                resources=api.ResourceRequirements(
+                    limits={"cpu": Quantity(cpu), "memory": Quantity(mem)}))],
+        ),
+    )
+
+
+# -- generic verbs ----------------------------------------------------------
+
+def test_pod_crud_lifecycle(cluster):
+    m, c = cluster
+    pods = c.pods("default")
+    created = pods.create(_pod("a"))
+    assert created.metadata.uid != ""
+    assert created.metadata.resource_version != ""
+    assert created.status.phase == api.PodPending  # strategy resets status
+    got = pods.get("a")
+    assert got.metadata.name == "a"
+    got.metadata.labels = {"app": "web"}
+    updated = pods.update(got)
+    assert int(updated.metadata.resource_version) > int(created.metadata.resource_version)
+    lst = pods.list()
+    assert [p.metadata.name for p in lst.items] == ["a"]
+    assert pods.list(label_selector="app=web").items
+    assert not pods.list(label_selector="app=db").items
+    pods.delete("a")
+    with pytest.raises(errors.StatusError) as ei:
+        pods.get("a")
+    assert errors.is_not_found(ei.value)
+
+
+def test_create_duplicate_conflicts(cluster):
+    _, c = cluster
+    c.pods().create(_pod("a"))
+    with pytest.raises(errors.StatusError) as ei:
+        c.pods().create(_pod("a"))
+    assert errors.is_already_exists(ei.value)
+
+
+def test_create_invalid_rejected(cluster):
+    _, c = cluster
+    bad = _pod("a")
+    bad.spec.containers = []
+    with pytest.raises(errors.StatusError) as ei:
+        c.pods().create(bad)
+    assert errors.is_invalid(ei.value)
+    assert ei.value.code == 422
+
+
+def test_update_stale_rv_conflicts(cluster):
+    _, c = cluster
+    created = c.pods().create(_pod("a"))
+    first = c.pods().get("a")
+    second = c.pods().get("a")
+    first.metadata.labels = {"v": "1"}
+    c.pods().update(first)
+    second.metadata.labels = {"v": "2"}
+    with pytest.raises(errors.StatusError) as ei:
+        c.pods().update(second)  # stale resourceVersion
+    assert errors.is_conflict(ei.value)
+
+
+def test_namespace_isolation(cluster):
+    _, c = cluster
+    c.pods("ns1").create(_pod("a", ns="ns1"))
+    c.pods("ns2").create(_pod("a", ns="ns2"))
+    assert len(c.pods("ns1").list().items) == 1
+    assert len(c.pods("ns2").list().items) == 1
+
+
+def test_generate_name(cluster):
+    _, c = cluster
+    p = _pod("")
+    p.metadata.name = ""
+    p.metadata.generate_name = "web-"
+    out = c.pods().create(p)
+    assert out.metadata.name.startswith("web-") and len(out.metadata.name) > 4
+
+
+def test_field_selector_unassigned_pods(cluster):
+    """The scheduler's source: pods with spec.host='' (ref: factory.go:177)."""
+    _, c = cluster
+    c.pods().create(_pod("unassigned"))
+    bound = _pod("bound")
+    bound.spec.host = ""  # host set via binding below
+    c.pods().create(bound)
+    c.pods().bind(api.Binding(metadata=api.ObjectMeta(name="bound", namespace="default"),
+                              pod_name="bound", host="n1"))
+    lst = c.pods().list(field_selector="spec.host=")
+    assert [p.metadata.name for p in lst.items] == ["unassigned"]
+
+
+# -- binding (the scheduler write path) ------------------------------------
+
+def test_binding_cas_guard(cluster):
+    _, c = cluster
+    c.pods().create(_pod("a"))
+    c.pods().bind(api.Binding(metadata=api.ObjectMeta(name="a", namespace="default"),
+                              pod_name="a", host="n1"))
+    assert c.pods().get("a").spec.host == "n1"
+    with pytest.raises(errors.StatusError) as ei:
+        c.pods().bind(api.Binding(metadata=api.ObjectMeta(name="a", namespace="default"),
+                                  pod_name="a", host="n2"))
+    assert errors.is_conflict(ei.value)
+    assert c.pods().get("a").spec.host == "n1"
+
+
+def test_pod_status_subresource(cluster):
+    _, c = cluster
+    c.pods().create(_pod("a"))
+    p = c.pods().get("a")
+    p.status.phase = api.PodRunning
+    out = c.pods().update_status(p)
+    assert out.status.phase == api.PodRunning
+    assert c.pods().get("a").status.phase == api.PodRunning
+
+
+# -- watch through the client ----------------------------------------------
+
+def test_client_watch_stream(cluster):
+    _, c = cluster
+    w = c.pods().watch()
+    c.pods().create(_pod("a"))
+    ev = w.next_event(timeout=2)
+    assert ev.type == watchpkg.ADDED and ev.object.metadata.name == "a"
+    # boundary: mutating the event object must not corrupt the server copy
+    ev.object.metadata.labels["hacked"] = "yes"
+    assert "hacked" not in c.pods().get("a").metadata.labels
+    w.stop()
+
+
+def test_watch_resume_from_list_rv(cluster):
+    _, c = cluster
+    c.pods().create(_pod("a"))
+    lst = c.pods().list()
+    w = c.pods().watch(resource_version=lst.metadata.resource_version)
+    c.pods().create(_pod("b"))
+    ev = w.next_event(timeout=2)
+    assert ev.object.metadata.name == "b"
+    w.stop()
+
+
+# -- services / portal IPs --------------------------------------------------
+
+def test_service_portal_ip_allocation(cluster):
+    _, c = cluster
+    s1 = c.services().create(api.Service(
+        metadata=api.ObjectMeta(name="s1", namespace="default"),
+        spec=api.ServiceSpec(port=80)))
+    s2 = c.services().create(api.Service(
+        metadata=api.ObjectMeta(name="s2", namespace="default"),
+        spec=api.ServiceSpec(port=81)))
+    assert s1.spec.portal_ip and s2.spec.portal_ip
+    assert s1.spec.portal_ip != s2.spec.portal_ip
+    # release on delete allows reuse of an explicitly requested IP
+    ip = s1.spec.portal_ip
+    c.services().delete("s1")
+    s3 = c.services().create(api.Service(
+        metadata=api.ObjectMeta(name="s3", namespace="default"),
+        spec=api.ServiceSpec(port=82, portal_ip=ip)))
+    assert s3.spec.portal_ip == ip
+
+
+def test_service_portal_ip_conflict(cluster):
+    _, c = cluster
+    s1 = c.services().create(api.Service(
+        metadata=api.ObjectMeta(name="s1", namespace="default"),
+        spec=api.ServiceSpec(port=80)))
+    with pytest.raises(errors.StatusError):
+        c.services().create(api.Service(
+            metadata=api.ObjectMeta(name="s2", namespace="default"),
+            spec=api.ServiceSpec(port=81, portal_ip=s1.spec.portal_ip)))
+
+
+# -- nodes ------------------------------------------------------------------
+
+def test_node_cluster_scoped(cluster):
+    _, c = cluster
+    c.nodes().create(api.Node(metadata=api.ObjectMeta(name="n1"),
+                              spec=api.NodeSpec(capacity={"cpu": Quantity("4")})))
+    assert c.nodes().get("n1").spec.capacity["cpu"] == Quantity("4")
+    assert len(c.nodes().list().items) == 1
+
+
+# -- namespace lifecycle ----------------------------------------------------
+
+def test_namespace_terminates_then_finalizes(cluster):
+    _, c = cluster
+    c.namespaces().create(api.Namespace(metadata=api.ObjectMeta(name="doomed")))
+    st = c.namespaces().delete("doomed")
+    ns = c.namespaces().get("doomed")
+    assert ns.status.phase == api.NamespaceTerminating
+    # creates are blocked in terminating namespaces (NamespaceLifecycle)
+    with pytest.raises(errors.StatusError) as ei:
+        c.pods("doomed").create(_pod("x", ns="doomed"))
+    assert ei.value.code == 403
+    # finalize: clear finalizers then delete for real
+    ns.spec.finalizers = []
+    c.namespaces().finalize(ns)
+    c.namespaces().delete("doomed")
+    with pytest.raises(errors.StatusError):
+        c.namespaces().get("doomed")
+
+
+def test_namespace_autoprovision(cluster):
+    _, c = cluster
+    c.pods("brandnew").create(_pod("a", ns="brandnew"))
+    assert c.namespaces().get("brandnew").status.phase == api.NamespaceActive
+
+
+# -- admission: limits & quota ---------------------------------------------
+
+def test_limitranger_enforces_max(cluster):
+    _, c = cluster
+    c.limit_ranges().create(api.LimitRange(
+        metadata=api.ObjectMeta(name="lims", namespace="default"),
+        spec=api.LimitRangeSpec(limits=[api.LimitRangeItem(
+            type="Container", max={"cpu": Quantity("500m")})])))
+    with pytest.raises(errors.StatusError) as ei:
+        c.pods().create(_pod("big", cpu="2"))
+    assert ei.value.code == 403
+    c.pods().create(_pod("ok", cpu="250m"))
+
+
+def test_resourcequota_object_counts_and_compute(cluster):
+    _, c = cluster
+    c.resource_quotas().create(api.ResourceQuota(
+        metadata=api.ObjectMeta(name="q", namespace="default"),
+        spec=api.ResourceQuotaSpec(hard={"pods": Quantity("2"), "cpu": Quantity("300m")})))
+    c.pods().create(_pod("a", cpu="100m"))
+    c.pods().create(_pod("b", cpu="100m"))
+    # third pod breaks the pod-count quota
+    with pytest.raises(errors.StatusError) as ei:
+        c.pods().create(_pod("c", cpu="50m"))
+    assert "quota" in str(ei.value).lower()
+    q = c.resource_quotas().get("q")
+    assert q.status.used["pods"] == Quantity("2")
+    assert q.status.used["cpu"] == Quantity("200m")
+
+
+def test_binding_not_charged_against_quota(cluster):
+    """Sub-resource writes (bindings/status) must not count as pod creates —
+    regression: a full quota used to 403 every bind."""
+    _, c = cluster
+    c.resource_quotas().create(api.ResourceQuota(
+        metadata=api.ObjectMeta(name="q", namespace="default"),
+        spec=api.ResourceQuotaSpec(hard={"pods": Quantity("1")})))
+    c.pods().create(_pod("only"))
+    c.pods().bind(api.Binding(metadata=api.ObjectMeta(name="only", namespace="default"),
+                              pod_name="only", host="n1"))
+    assert c.pods().get("only").spec.host == "n1"
+    p = c.pods().get("only")
+    p.status.phase = api.PodRunning
+    c.pods().update_status(p)  # status update also uncharged
+
+
+def test_resourcequota_cpu_limit(cluster):
+    _, c = cluster
+    c.resource_quotas().create(api.ResourceQuota(
+        metadata=api.ObjectMeta(name="q", namespace="default"),
+        spec=api.ResourceQuotaSpec(hard={"cpu": Quantity("150m")})))
+    c.pods().create(_pod("a", cpu="100m"))
+    with pytest.raises(errors.StatusError):
+        c.pods().create(_pod("b", cpu="100m"))
+
+
+def test_always_deny_plugin():
+    m = Master(MasterConfig(admission_control=("AlwaysDeny",)))
+    c = Client(InProcessTransport(m))
+    with pytest.raises(errors.StatusError) as ei:
+        c.pods().create(_pod("a"))
+    assert ei.value.code == 403
+
+
+# -- events TTL -------------------------------------------------------------
+
+def test_event_registry_ttl():
+    import itertools
+    now = [0.0]
+    from kubernetes_tpu.storage.memstore import MemStore
+    m = Master(MasterConfig(store=MemStore(clock=lambda: now[0]), event_ttl_seconds=10))
+    c = Client(InProcessTransport(m))
+    c.events().create(api.Event(
+        metadata=api.ObjectMeta(name="e1", namespace="default"),
+        involved_object=api.ObjectReference(kind="Pod", name="p", namespace="default"),
+        reason="started"))
+    assert len(c.events().list().items) == 1
+    now[0] = 11.0
+    assert len(c.events().list().items) == 0
+
+
+# -- fake client ------------------------------------------------------------
+
+def test_fake_client_records_actions():
+    fc = FakeClient()
+    fc.pods("default").list()
+    fc.pods("default").create(_pod("x"))
+    assert [a.verb for a in fc.actions] == ["list", "create"]
+    fc.on("list", "pods", lambda **kw: api.PodList(items=[_pod("scripted")]))
+    out = fc.pods("default").list()
+    assert out.items[0].metadata.name == "scripted"
