@@ -1,0 +1,330 @@
+"""The scheduler driver + factory.
+
+Rebuild of ``plugin/pkg/scheduler/`` — the harness around the pure algorithm:
+
+- ``Scheduler.schedule_one`` (scheduler.go:90-119): blocking FIFO pop ->
+  Algorithm.schedule -> POST binding -> Modeler.assume_pod, with events on
+  every outcome.
+- ``SimpleModeler`` (modeler.go:56-155): the optimistic "assumed pods" cache
+  bridging bind -> watch-confirmation latency.
+- ``PodBackoff`` (factory.go:245-369): per-pod exponential backoff 1s -> 60s
+  with gc; the default error handler re-fetches and re-queues.
+- ``ConfigFactory`` (factory.go:40-172): wires reflectors (unassigned pods ->
+  FIFO via field selector spec.host=; assigned pods -> store), a node poller
+  filtering Schedulable/Ready conditions (factory.go:203-238), and a services
+  reflector.
+
+The ``algorithm`` seam accepts anything with ``schedule(pod, minion_lister)``
+— the serial GenericScheduler or the TPU-backed batch adapter — so both sit
+behind identical plumbing.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+from kubernetes_tpu.api import errors
+from kubernetes_tpu.api import labels as labels_pkg
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.client.cache import (
+    FIFO,
+    Poller,
+    Reflector,
+    Store,
+    StorePodLister,
+    StoreServiceLister,
+    meta_namespace_key_func,
+)
+from kubernetes_tpu.client.record import EventRecorder
+from kubernetes_tpu.scheduler import plugins as schedplugins
+from kubernetes_tpu.scheduler.generic import GenericScheduler
+
+__all__ = ["Scheduler", "SchedulerConfig", "SimpleModeler", "PodBackoff",
+           "ConfigFactory", "filter_schedulable_nodes"]
+
+
+class SimpleModeler:
+    """ref: modeler.go:56-155."""
+
+    def __init__(self, queued_pods: FIFO, scheduled_pods: Store):
+        self.queued = queued_pods
+        self.scheduled = scheduled_pods
+        self.assumed = Store()
+
+    def assume_pod(self, pod: api.Pod) -> None:
+        self.assumed.add(pod)
+
+    def _prune_assumed(self) -> None:
+        """Drop assumed pods once seen in the queued or scheduled stores
+        (ref: modeler.go:90-139 listPods)."""
+        for pod in self.assumed.list():
+            key = meta_namespace_key_func(pod)
+            if self.queued.get_by_key(key) is not None:
+                self.assumed.delete(pod)
+            elif self.scheduled.get_by_key(key) is not None:
+                self.assumed.delete(pod)
+
+    def list(self, selector: Optional[labels_pkg.Selector] = None):
+        self._prune_assumed()
+        scheduled = StorePodLister(self.scheduled).list(selector)
+        assumed = StorePodLister(self.assumed).list(selector)
+        return scheduled + assumed
+
+    def pod_lister(self):
+        return self
+
+
+class PodBackoff:
+    """ref: factory.go:245-268,320-369 — exponential 1s -> 60s + gc."""
+
+    def __init__(self, initial: float = 1.0, max_duration: float = 60.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.initial = initial
+        self.max_duration = max_duration
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._entries: Dict[str, list] = {}  # key -> [backoff_seconds, last_update]
+
+    def get_backoff(self, pod_key: str) -> float:
+        """Returns the duration to wait, doubling for next time."""
+        with self._lock:
+            entry = self._entries.setdefault(pod_key, [self.initial, self.clock()])
+            duration = entry[0]
+            entry[0] = min(entry[0] * 2, self.max_duration)
+            entry[1] = self.clock()
+            return duration
+
+    def gc(self, max_age: float = 60.0) -> None:
+        with self._lock:
+            now = self.clock()
+            for key in [k for k, e in self._entries.items() if now - e[1] > max_age]:
+                del self._entries[key]
+
+
+@dataclass
+class SchedulerConfig:
+    """ref: scheduler.go:55-75 Config — the full DI seam for tests."""
+
+    modeler: SimpleModeler = None
+    minion_lister: object = None
+    algorithm: object = None                       # .schedule(pod, minion_lister)
+    binder: object = None                          # .bind(binding)
+    next_pod: Callable[[], api.Pod] = None
+    error: Callable[[api.Pod, Exception], None] = None
+    recorder: Optional[EventRecorder] = None
+
+
+class Scheduler:
+    """ref: scheduler.go:78-119."""
+
+    def __init__(self, config: SchedulerConfig):
+        self.config = config
+        self._stop = threading.Event()
+
+    def run(self) -> "Scheduler":
+        t = threading.Thread(target=self._loop, daemon=True, name="scheduler")
+        t.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.schedule_one(timeout=0.2)
+            except TimeoutError:
+                continue
+            except Exception:
+                time.sleep(0.01)
+
+    def _record(self, pod, reason, fmt, *args):
+        if self.config.recorder is not None:
+            self.config.recorder.eventf(pod, reason, fmt, *args)
+
+    def schedule_one(self, timeout: Optional[float] = None) -> Optional[str]:
+        """ref: scheduler.go:90-119 scheduleOne."""
+        c = self.config
+        pod = c.next_pod() if timeout is None else c.next_pod(timeout)
+        try:
+            dest = c.algorithm.schedule(pod, c.minion_lister)
+        except Exception as e:
+            self._record(pod, "FailedScheduling", "Error scheduling: %s", e)
+            c.error(pod, e)
+            return None
+        binding = api.Binding(
+            metadata=api.ObjectMeta(name=pod.metadata.name,
+                                    namespace=pod.metadata.namespace),
+            pod_name=pod.metadata.name, host=dest)
+        try:
+            c.binder.bind(binding)
+        except Exception as e:
+            self._record(pod, "FailedScheduling", "Binding rejected: %s", e)
+            c.error(pod, e)
+            return None
+        self._record(pod, "Scheduled", "Successfully assigned %s to %s",
+                     pod.metadata.name, dest)
+        # copy before mutating, like the reference's `assumed := *pod`
+        # (scheduler.go:114-117) — the popped pod may be shared
+        assumed = copy.deepcopy(pod)
+        assumed.spec.host = dest
+        assumed.status.host = dest
+        c.modeler.assume_pod(assumed)
+        return dest
+
+
+def filter_schedulable_nodes(nodes: api.NodeList) -> api.NodeList:
+    """ref: factory.go:203-238 pollMinions — keep nodes whose Schedulable
+    condition isn't false and that are Ready (or Reachable, or carry no
+    conditions at all)."""
+    out = []
+    for node in nodes.items:
+        conds = {c.type: c for c in node.status.conditions}
+        sched = conds.get(api.NodeSchedulable)
+        if sched is not None and sched.status != api.ConditionTrue:
+            continue
+        ready = conds.get(api.NodeReady)
+        reachable = conds.get(api.NodeReachable)
+        if ready is not None:
+            if ready.status == api.ConditionTrue:
+                out.append(node)
+        elif reachable is not None:
+            if reachable.status == api.ConditionTrue:
+                out.append(node)
+        else:
+            out.append(node)
+    return api.NodeList(items=out)
+
+
+class _StoreMinionLister:
+    def __init__(self, store: Store):
+        self.store = store
+
+    def list(self) -> api.NodeList:
+        items = sorted(self.store.list(), key=lambda n: n.metadata.name)
+        return api.NodeList(items=items)
+
+
+class ConfigFactory:
+    """ref: factory.go:40-172 ConfigFactory/CreateFromKeys."""
+
+    def __init__(self, client, node_poll_period: float = 10.0):
+        self.client = client
+        self.node_poll_period = node_poll_period
+        self.pod_queue = FIFO()              # unassigned pods
+        self.scheduled_pods = Store()        # assigned pods
+        self.node_store = Store()
+        self.service_store = Store()
+        self.modeler = SimpleModeler(self.pod_queue, self.scheduled_pods)
+        self.backoff = PodBackoff()
+        self._runners = []
+
+    def create(self, provider: str = schedplugins.DEFAULT_PROVIDER,
+               policy: Optional[schedplugins.Policy] = None,
+               algorithm_override=None,
+               recorder: Optional[EventRecorder] = None) -> SchedulerConfig:
+        """ref: factory.go:77-172 CreateFromProvider/CreateFromConfig/
+        CreateFromKeys."""
+        # reflector: unassigned pods -> FIFO (field selector spec.host=)
+        self._runners.append(Reflector(
+            self.client.pods(api.NamespaceAll).list_watch(field_selector="spec.host="),
+            self.pod_queue, name="unassigned-pods").run())
+        # reflector: assigned pods -> store
+        self._runners.append(Reflector(
+            self.client.pods(api.NamespaceAll).list_watch(field_selector="spec.host!="),
+            self.scheduled_pods, name="assigned-pods").run())
+        # poller: nodes every node_poll_period, filtered (factory.go:139)
+        self._runners.append(Poller(
+            lambda: filter_schedulable_nodes(self.client.nodes().list()),
+            self.node_poll_period, self.node_store).run())
+        # reflector: services
+        self._runners.append(Reflector(
+            self.client.services(api.NamespaceAll).list_watch(),
+            self.service_store, name="services").run())
+
+        minion_lister = _StoreMinionLister(self.node_store)
+        pod_lister = self.modeler.pod_lister()
+        args = schedplugins.PluginFactoryArgs(
+            pod_lister=pod_lister,
+            service_lister=StoreServiceLister(self.service_store),
+            node_lister=minion_lister,
+            node_info=_NodeStoreInfo(self.node_store))
+
+        if algorithm_override is not None:
+            algorithm = algorithm_override(args)
+        elif policy is not None:
+            algorithm = GenericScheduler(
+                schedplugins.predicates_from_policy(policy, args),
+                schedplugins.priorities_from_policy(policy, args), pod_lister)
+        else:
+            keys = schedplugins.get_algorithm_provider(provider)
+            algorithm = GenericScheduler(
+                schedplugins.get_predicates(keys["predicates"], args),
+                schedplugins.get_priorities(keys["priorities"], args), pod_lister)
+
+        return SchedulerConfig(
+            modeler=self.modeler,
+            minion_lister=minion_lister,
+            algorithm=algorithm,
+            binder=_Binder(self.client),
+            next_pod=self._next_pod,
+            error=self._make_error_func(),
+            recorder=recorder,
+        )
+
+    def stop(self) -> None:
+        for r in self._runners:
+            r.stop()
+
+    def _next_pod(self, timeout: Optional[float] = None) -> api.Pod:
+        """ref: factory.go:164-168 — blocking FIFO pop."""
+        return self.pod_queue.pop(timeout=timeout)
+
+    def _make_error_func(self):
+        """ref: factory.go makeDefaultErrorFunc — backoff, re-fetch, re-queue
+        if still unscheduled."""
+
+        def handle(pod: api.Pod, err: Exception) -> None:
+            key = meta_namespace_key_func(pod)
+            delay = self.backoff.get_backoff(key)
+
+            def requeue():
+                time.sleep(delay)
+                try:
+                    fresh = self.client.pods(pod.metadata.namespace).get(pod.metadata.name)
+                    if not fresh.spec.host:
+                        self.pod_queue.add(fresh)
+                except errors.StatusError:
+                    pass  # deleted meanwhile
+                self.backoff.gc()
+
+            threading.Thread(target=requeue, daemon=True).start()
+
+        return handle
+
+
+class _Binder:
+    """ref: factory.go:297-308 binder — POST /bindings."""
+
+    def __init__(self, client):
+        self.client = client
+
+    def bind(self, binding: api.Binding) -> None:
+        self.client.pods(binding.metadata.namespace).bind(binding)
+
+
+class _NodeStoreInfo:
+    """NodeInfo over the scheduler's node store (GetNodeInfo by name)."""
+
+    def __init__(self, store: Store):
+        self.store = store
+
+    def get_node_info(self, name: str) -> api.Node:
+        node = self.store.get_by_key(name)
+        if node is None:
+            raise KeyError(f"unknown node {name!r}")
+        return node

@@ -1,0 +1,240 @@
+"""Fit predicates — pure functions, the Filter phase.
+
+Rebuild of ``pkg/scheduler/predicates.go``. Signature mirrors the reference's
+``FitPredicate`` (types.go:24): ``predicate(pod, existing_pods, node_name) ->
+bool``. Semantics are mirrored exactly — these are the oracle the TPU mask
+kernels (kubernetes_tpu.models.batch_solver) must agree with bit-for-bit:
+
+- PodFitsResources (:127-152): zero-request pods always fit; greedy
+  sequential capacity accounting via check_pods_exceeding_capacity (:104-124)
+  where a zero capacity dimension means "unlimited".
+- PodFitsPorts (:326-350): HostPort conflicts, port 0 ignored.
+- NoDiskConflict (:68-83): exclusive GCE PD mounts.
+- MatchNodeSelector (:161-179), HostName (:181-186).
+- CheckNodeLabelPresence (:194-229) and CheckServiceAffinity (:238-324),
+  the policy-configured predicates.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Tuple
+
+from kubernetes_tpu.api import labels as labels_pkg
+from kubernetes_tpu.api import types as api
+
+__all__ = [
+    "FitPredicate", "get_resource_request", "check_pods_exceeding_capacity",
+    "ResourceFit", "NodeSelector", "pod_fits_host", "NodeLabelChecker",
+    "ServiceAffinity", "pod_fits_ports", "get_used_ports", "no_disk_conflict",
+    "map_pods_to_machines",
+]
+
+FitPredicate = Callable[[api.Pod, List[api.Pod], str], bool]
+
+
+@dataclass
+class ResourceRequest:
+    milli_cpu: int = 0
+    memory: int = 0
+
+
+def get_resource_request(pod: api.Pod) -> ResourceRequest:
+    """ref: predicates.go:93-101 getResourceRequest — container limits."""
+    r = ResourceRequest()
+    for c in pod.spec.containers:
+        limits = c.resources.limits
+        q = limits.get(api.ResourceMemory)
+        if q is not None:
+            r.memory += q.int_value()
+        q = limits.get(api.ResourceCPU)
+        if q is not None:
+            r.milli_cpu += q.milli_value()
+    return r
+
+
+def check_pods_exceeding_capacity(pods: List[api.Pod], capacity: dict
+                                  ) -> Tuple[List[api.Pod], List[api.Pod]]:
+    """ref: predicates.go:104-124 CheckPodsExceedingCapacity.
+
+    Greedy in-order accounting; a zero capacity dimension never constrains.
+    Returns (fitting, not_fitting).
+    """
+    cap_cpu_q = capacity.get(api.ResourceCPU)
+    cap_mem_q = capacity.get(api.ResourceMemory)
+    total_milli_cpu = cap_cpu_q.milli_value() if cap_cpu_q is not None else 0
+    total_memory = cap_mem_q.int_value() if cap_mem_q is not None else 0
+    cpu_requested = 0
+    mem_requested = 0
+    fitting: List[api.Pod] = []
+    not_fitting: List[api.Pod] = []
+    for p in pods:
+        req = get_resource_request(p)
+        fits_cpu = total_milli_cpu == 0 or (total_milli_cpu - cpu_requested) >= req.milli_cpu
+        fits_mem = total_memory == 0 or (total_memory - mem_requested) >= req.memory
+        if not fits_cpu or not fits_mem:
+            not_fitting.append(p)
+            continue
+        cpu_requested += req.milli_cpu
+        mem_requested += req.memory
+        fitting.append(p)
+    return fitting, not_fitting
+
+
+class ResourceFit:
+    """ref: predicates.go:127-152 ResourceFit.PodFitsResources."""
+
+    def __init__(self, node_info):
+        self.info = node_info
+
+    def pod_fits_resources(self, pod: api.Pod, existing_pods: List[api.Pod],
+                           node: str) -> bool:
+        req = get_resource_request(pod)
+        if req.milli_cpu == 0 and req.memory == 0:
+            return True  # no resources requested always fits (:129)
+        info = self.info.get_node_info(node)
+        pods = list(existing_pods) + [pod]
+        _, exceeding = check_pods_exceeding_capacity(pods, info.spec.capacity)
+        return len(exceeding) == 0
+
+
+def pod_matches_node_labels(pod: api.Pod, node: api.Node) -> bool:
+    """ref: predicates.go:161-168 PodMatchesNodeLabels."""
+    if not pod.spec.node_selector:
+        return True
+    sel = labels_pkg.selector_from_set(pod.spec.node_selector)
+    return sel.matches(node.metadata.labels)
+
+
+class NodeSelector:
+    """ref: predicates.go:170-179 NodeSelector.PodSelectorMatches."""
+
+    def __init__(self, node_info):
+        self.info = node_info
+
+    def pod_selector_matches(self, pod: api.Pod, existing_pods: List[api.Pod],
+                             node: str) -> bool:
+        return pod_matches_node_labels(pod, self.info.get_node_info(node))
+
+
+def pod_fits_host(pod: api.Pod, existing_pods: List[api.Pod], node: str) -> bool:
+    """ref: predicates.go:181-186 PodFitsHost."""
+    if not pod.spec.host:
+        return True
+    return pod.spec.host == node
+
+
+class NodeLabelChecker:
+    """ref: predicates.go:194-229 CheckNodeLabelPresence (policy-only)."""
+
+    def __init__(self, node_info, labels: List[str], presence: bool):
+        self.info = node_info
+        self.labels = labels
+        self.presence = presence
+
+    def check_node_label_presence(self, pod: api.Pod, existing_pods: List[api.Pod],
+                                  node: str) -> bool:
+        minion = self.info.get_node_info(node)
+        minion_labels = minion.metadata.labels or {}
+        for label in self.labels:
+            exists = label in minion_labels
+            if (exists and not self.presence) or (not exists and self.presence):
+                return False
+        return True
+
+
+class ServiceAffinity:
+    """ref: predicates.go:238-324 CheckServiceAffinity (policy-only) —
+    co-locate service peers on nodes sharing label values (the ancestor of
+    inter-pod affinity)."""
+
+    def __init__(self, pod_lister, service_lister, node_info, labels: List[str]):
+        self.pod_lister = pod_lister
+        self.service_lister = service_lister
+        self.node_info = node_info
+        self.labels = labels
+
+    def check_service_affinity(self, pod: api.Pod, existing_pods: List[api.Pod],
+                               node: str) -> bool:
+        affinity_labels: Dict[str, str] = {}
+        node_selector = pod.spec.node_selector or {}
+        labels_exist = True
+        for l in self.labels:
+            if l in node_selector:
+                affinity_labels[l] = node_selector[l]
+            else:
+                labels_exist = False
+        if not labels_exist:
+            services = self.service_lister.get_pod_services(pod)
+            if services:
+                sel = labels_pkg.selector_from_set(services[0].spec.selector)
+                service_pods = self.pod_lister.list(sel)
+                ns_service_pods = [p for p in service_pods
+                                   if p.metadata.namespace == pod.metadata.namespace]
+                if ns_service_pods:
+                    other = self.node_info.get_node_info(ns_service_pods[0].status.host)
+                    other_labels = other.metadata.labels or {}
+                    for l in self.labels:
+                        if l in affinity_labels:
+                            continue
+                        if l in other_labels:
+                            affinity_labels[l] = other_labels[l]
+        if not affinity_labels:
+            affinity_selector = labels_pkg.everything()
+        else:
+            affinity_selector = labels_pkg.selector_from_set(affinity_labels)
+        minion = self.node_info.get_node_info(node)
+        return affinity_selector.matches(minion.metadata.labels)
+
+
+def get_used_ports(*pods: api.Pod) -> set:
+    """ref: predicates.go:340-350 getUsedPorts — keyed on HostPort only."""
+    ports = set()
+    for pod in pods:
+        for c in pod.spec.containers:
+            for p in c.ports:
+                ports.add(p.host_port)
+    return ports
+
+
+def pod_fits_ports(pod: api.Pod, existing_pods: List[api.Pod], node: str) -> bool:
+    """ref: predicates.go:326-338 PodFitsPorts."""
+    existing_ports = get_used_ports(*existing_pods)
+    want_ports = get_used_ports(pod)
+    for wport in want_ports:
+        if wport == 0:
+            continue
+        if wport in existing_ports:
+            return False
+    return True
+
+
+def _is_volume_conflict(volume: api.Volume, pod: api.Pod) -> bool:
+    """ref: predicates.go:40-66 isVolumeConflict — GCE PD exclusivity."""
+    gce = volume.source.gce_persistent_disk
+    if gce is None:
+        return False
+    for v in pod.spec.volumes:
+        other = v.source.gce_persistent_disk
+        if other is not None and other.pd_name == gce.pd_name:
+            return True
+    return False
+
+
+def no_disk_conflict(pod: api.Pod, existing_pods: List[api.Pod], node: str) -> bool:
+    """ref: predicates.go:68-83 NoDiskConflict."""
+    for volume in pod.spec.volumes:
+        for existing in existing_pods:
+            if _is_volume_conflict(volume, existing):
+                return False
+    return True
+
+
+def map_pods_to_machines(pod_lister) -> Dict[str, List[api.Pod]]:
+    """ref: predicates.go:354-375 MapPodsToMachines — pivots ALL pods into a
+    host -> pods map using status.host, rebuilt per scheduling cycle. This is
+    the quadratic-ish cost the TPU snapshot encoder replaces."""
+    machine_to_pods: Dict[str, List[api.Pod]] = {}
+    for pod in pod_lister.list(labels_pkg.everything()):
+        machine_to_pods.setdefault(pod.status.host, []).append(pod)
+    return machine_to_pods
