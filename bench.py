@@ -1,0 +1,165 @@
+"""Benchmark: batch-scheduler throughput on the north-star config.
+
+Config (BASELINE.md): bind 10k pending pods onto 5k nodes — bin-packing
+(cpu+memory) + service topology spread — in one TPU solve, decisions
+bit-identical to the serial reference path. The published reference target
+this is measured against (docs/roadmap.md:61): 99% of scheduling decisions
+in < 1 s on a 100-node / 3000-pod cluster, i.e. the north star normalizes to
+10_000 pods/s. vs_baseline = pods_per_sec / 10_000 — >= 1.0 means the
+"10k pods in under a second" goal is met.
+
+Prints ONE JSON line on stdout; diagnostics go to stderr.
+
+Usage: python bench.py [--smoke] [--pods P] [--nodes N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def build_cluster(n_nodes: int, n_pods: int, n_services: int = 8,
+                  existing_per_node: int = 2):
+    from kubernetes_tpu.api import types as api
+    from kubernetes_tpu.api.quantity import Quantity
+
+    nodes = [api.Node(
+        metadata=api.ObjectMeta(name=f"node-{i:05d}",
+                                labels={"zone": f"z{i % 16}",
+                                        "disk": "ssd" if i % 4 else "hdd"}),
+        spec=api.NodeSpec(capacity={"cpu": Quantity("16"),
+                                    "memory": Quantity("64Gi")}))
+        for i in range(n_nodes)]
+    services = [api.Service(
+        metadata=api.ObjectMeta(name=f"svc-{s}", namespace="default"),
+        spec=api.ServiceSpec(port=80, selector={"app": f"app-{s}"}))
+        for s in range(n_services)]
+
+    def pod(name, i, host=""):
+        return api.Pod(
+            metadata=api.ObjectMeta(
+                name=name, namespace="default", uid=f"uid-{name}",
+                labels={"app": f"app-{i % n_services}"}),
+            spec=api.PodSpec(
+                host=host,
+                containers=[api.Container(
+                    name="c", image="img",
+                    ports=[api.ContainerPort(container_port=80,
+                                             host_port=7000 + (i % 50))]
+                    if i % 10 == 0 else [],
+                    resources=api.ResourceRequirements(limits={
+                        "cpu": Quantity(f"{100 + (i % 8) * 100}m"),
+                        "memory": Quantity(f"{128 + (i % 6) * 256}Mi")}))]),
+            status=api.PodStatus(host=host))
+
+    existing = [pod(f"old-{n}-{j}", n * existing_per_node + j,
+                    host=nodes[n].metadata.name)
+                for n in range(n_nodes) for j in range(existing_per_node)]
+    pending = [pod(f"new-{i:05d}", i) for i in range(n_pods)]
+    return nodes, existing, pending, services
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="small shapes + force CPU (CI / laptops)")
+    ap.add_argument("--pods", type=int, default=None)
+    ap.add_argument("--nodes", type=int, default=None)
+    ap.add_argument("--oracle-pods", type=int, default=300,
+                    help="pods for the serial-oracle rate + equivalence gate")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.smoke:
+        jax.config.update("jax_platforms", "cpu")
+    n_pods = args.pods or (500 if args.smoke else 10_000)
+    n_nodes = args.nodes or (100 if args.smoke else 5_000)
+
+    from kubernetes_tpu.models.batch_solver import (
+        decisions_to_names,
+        snapshot_to_inputs,
+        solve_jit,
+    )
+    from kubernetes_tpu.models.oracle import solve_serial
+    from kubernetes_tpu.models.snapshot import encode_snapshot
+
+    log(f"backend={jax.default_backend()} devices={jax.devices()}")
+    log(f"building cluster: {n_pods} pods x {n_nodes} nodes")
+    nodes, existing, pending, services = build_cluster(n_nodes, n_pods)
+
+    # -- correctness gate: bit-identical to the serial oracle on a slice ----
+    gate_pods = pending[: min(args.oracle_pods, n_pods)]
+    gate_nodes = nodes[: min(200, n_nodes)]
+    gate_existing = [p for p in existing
+                     if p.status.host in {n.metadata.name for n in gate_nodes}]
+    t0 = time.perf_counter()
+    serial = solve_serial(gate_nodes, gate_existing, gate_pods, services)
+    serial_s = time.perf_counter() - t0
+    serial_rate = len(gate_pods) / serial_s if serial_s > 0 else 0.0
+    snap_gate = encode_snapshot(gate_nodes, gate_existing, gate_pods, services)
+    chosen_gate, _ = solve_jit(snapshot_to_inputs(snap_gate))
+    import numpy as np
+
+    batch_gate = decisions_to_names(snap_gate, np.asarray(chosen_gate))
+    if batch_gate != serial:
+        diverge = sum(1 for a, b in zip(batch_gate, serial) if a != b)
+        log(f"EQUIVALENCE FAILURE: {diverge}/{len(serial)} decisions diverge")
+        print(json.dumps({"metric": f"pods_scheduled_per_sec_{n_pods}pods_{n_nodes}nodes",
+                          "value": 0.0, "unit": "pods/s", "vs_baseline": 0.0,
+                          "error": "batch decisions diverge from serial oracle"}))
+        return 1
+    log(f"equivalence gate OK on {len(gate_pods)} pods x {len(gate_nodes)} nodes; "
+        f"serial oracle rate = {serial_rate:.1f} pods/s")
+
+    # -- the timed solve ----------------------------------------------------
+    t0 = time.perf_counter()
+    snap = encode_snapshot(nodes, existing, pending, services)
+    encode_s = time.perf_counter() - t0
+    inp = snapshot_to_inputs(snap)
+    inp = jax.tree.map(jax.device_put, inp)
+    jax.block_until_ready(inp)
+
+    t0 = time.perf_counter()
+    chosen, scores = solve_jit(inp)
+    jax.block_until_ready((chosen, scores))
+    compile_s = time.perf_counter() - t0
+    log(f"encode={encode_s:.3f}s first-call(compile+run)={compile_s:.3f}s")
+
+    runs = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        chosen, scores = solve_jit(inp)
+        jax.block_until_ready((chosen, scores))
+        runs.append(time.perf_counter() - t0)
+    solve_s = min(runs)
+    chosen_np = np.asarray(chosen)
+    scheduled = int((chosen_np >= 0).sum())
+    log(f"solve runs: {[f'{r:.4f}' for r in runs]} -> {solve_s:.4f}s; "
+        f"scheduled {scheduled}/{n_pods}")
+
+    # end-to-end = snapshot encode + solve (what a scheduling wave costs)
+    wall = solve_s + encode_s
+    pods_per_sec = n_pods / wall
+    log(f"end-to-end wave: {wall:.3f}s = encode {encode_s:.3f} + solve {solve_s:.4f}; "
+        f"{pods_per_sec:.0f} pods/s (device-only: {n_pods / solve_s:.0f} pods/s); "
+        f"serial-oracle-extrapolated speedup ~{pods_per_sec / serial_rate:.0f}x")
+
+    print(json.dumps({
+        "metric": f"pods_scheduled_per_sec_{n_pods}pods_{n_nodes}nodes",
+        "value": round(pods_per_sec, 1),
+        "unit": "pods/s",
+        "vs_baseline": round(pods_per_sec / 10_000.0, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,218 @@
+"""TPU batch scheduler — the north-star solver.
+
+Lifts the reference's serial per-pod loop (ref:
+pkg/scheduler/generic_scheduler.go:54-128 Schedule/findNodesThatFit and
+plugin/pkg/scheduler/scheduler.go:90-119 scheduleOne) into ONE compiled XLA
+call over a dense (pending_pods x nodes) problem:
+
+- **Batched Filter pre-pass** (MXU): node-selector satisfaction is an exact
+  boolean matmul over the interned (key,value) vocabulary; pinned-host masks
+  broadcast. This replaces the nodes x predicates short-circuit loop.
+- **Sequential commit scan** (`lax.scan` over pods): the reference schedules
+  pods one at a time, each decision updating node state before the next; the
+  scan reproduces that exactly — per-step vector ops over [N] (resource fit,
+  port/PD conflict, LeastRequested + ServiceSpreading scores, deterministic
+  tie-break) and a one-hot carry update on the chosen node. Decisions are
+  bit-identical to the serial oracle by construction: same integer score
+  truncation, same float32 spread rounding, same FNV-1a-mod-count tie-break
+  over nodes in list order.
+
+Everything is static-shaped, integer/float32 only (int64 enabled for byte
+capacities), no data-dependent Python control flow — XLA compiles the whole
+wave to a single TPU program. Sharding over the node axis for multi-chip is
+layered on in kubernetes_tpu.parallel.mesh without changing this module.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+
+
+def ensure_x64() -> None:
+    """Byte capacities need int64; without x64, jnp silently downcasts to
+    int32 and 8Gi capacities wrap. Called at the array-creation boundary
+    (snapshot_to_inputs) rather than at import so merely importing this
+    module does not flip process-global dtype semantics."""
+    if not jax.config.jax_enable_x64:
+        jax.config.update("jax_enable_x64", True)
+
+
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_tpu.models.snapshot import ClusterSnapshot
+from kubernetes_tpu.ops.kernels import (
+    calculate_score as _calculate_score,
+    masked_top_count,
+    select_kth_true,
+    spread_score as _spread_score,
+    u64_mod_small as _u64_mod,
+)
+
+__all__ = ["solve", "solve_jit", "SolverInputs", "decisions_to_names"]
+
+NEG = -1  # masked score sentinel (scores are always >= 0); plain int so the
+# module can be imported before x64 is enabled without freezing an int32
+
+
+class SolverInputs(NamedTuple):
+    """Device-ready arrays (see ClusterSnapshot for shapes/meaning)."""
+
+    cap_cpu: jnp.ndarray
+    cap_mem: jnp.ndarray
+    fit_used_cpu: jnp.ndarray
+    fit_used_mem: jnp.ndarray
+    fit_exceeded: jnp.ndarray
+    score_used_cpu: jnp.ndarray
+    score_used_mem: jnp.ndarray
+    node_ports: jnp.ndarray
+    node_sel: jnp.ndarray
+    node_pds: jnp.ndarray
+    node_extra_ok: jnp.ndarray
+    req_cpu: jnp.ndarray
+    req_mem: jnp.ndarray
+    pod_ports: jnp.ndarray
+    pod_sel: jnp.ndarray
+    pod_pds: jnp.ndarray
+    pod_host_idx: jnp.ndarray
+    tie_hi: jnp.ndarray
+    tie_lo: jnp.ndarray
+    pod_gid: jnp.ndarray
+    pod_group_member: jnp.ndarray
+    group_counts: jnp.ndarray
+
+
+def snapshot_to_inputs(snap: ClusterSnapshot) -> SolverInputs:
+    ensure_x64()
+    return SolverInputs(
+        cap_cpu=jnp.asarray(snap.cap_cpu), cap_mem=jnp.asarray(snap.cap_mem),
+        fit_used_cpu=jnp.asarray(snap.fit_used_cpu),
+        fit_used_mem=jnp.asarray(snap.fit_used_mem),
+        fit_exceeded=jnp.asarray(snap.fit_exceeded),
+        score_used_cpu=jnp.asarray(snap.score_used_cpu),
+        score_used_mem=jnp.asarray(snap.score_used_mem),
+        node_ports=jnp.asarray(snap.node_ports), node_sel=jnp.asarray(snap.node_sel),
+        node_pds=jnp.asarray(snap.node_pds),
+        node_extra_ok=jnp.asarray(snap.node_extra_ok),
+        req_cpu=jnp.asarray(snap.req_cpu), req_mem=jnp.asarray(snap.req_mem),
+        pod_ports=jnp.asarray(snap.pod_ports), pod_sel=jnp.asarray(snap.pod_sel),
+        pod_pds=jnp.asarray(snap.pod_pds),
+        pod_host_idx=jnp.asarray(snap.pod_host_idx),
+        tie_hi=jnp.asarray(snap.tie_hi), tie_lo=jnp.asarray(snap.tie_lo),
+        pod_gid=jnp.asarray(snap.pod_gid),
+        pod_group_member=jnp.asarray(snap.pod_group_member),
+        group_counts=jnp.asarray(snap.group_counts),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("w_lr", "w_spread", "w_equal"))
+def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
+              w_equal: int = 0) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Solve one wave. Returns (chosen_node_idx[P] int32 — -1 unschedulable,
+    scores[P] int64 — the winning combined score, -1 if unschedulable)."""
+    if inp.cap_cpu.dtype != jnp.int64:
+        raise TypeError(
+            "solver inputs lost int64 (x64 disabled?) — build them via "
+            "snapshot_to_inputs, which enables jax_enable_x64")
+    N = inp.cap_cpu.shape[0]
+    arange_n = jnp.arange(N, dtype=jnp.int32)
+
+    # ---- batched Filter pre-pass (MXU) -----------------------------------
+    # selector violations: required pairs the node lacks, exact f32 matmul
+    violations = jnp.dot(inp.pod_sel.astype(jnp.float32),
+                         (~inp.node_sel).astype(jnp.float32).T)  # [P, N]
+    sel_ok = violations == 0
+    host_ok = (inp.pod_host_idx[:, None] == -1) | \
+              (inp.pod_host_idx[:, None] == arange_n[None, :])
+    static_mask = sel_ok & host_ok & inp.node_extra_ok[None, :]  # [P, N]
+
+    # ---- sequential commit scan over pods --------------------------------
+    class Carry(NamedTuple):
+        fit_used_cpu: jnp.ndarray    # [N] i64
+        fit_used_mem: jnp.ndarray
+        score_used_cpu: jnp.ndarray
+        score_used_mem: jnp.ndarray
+        ports: jnp.ndarray           # [N, K] bool
+        pds: jnp.ndarray             # [N, K3] bool
+        counts: jnp.ndarray          # [G, N+1] i32
+
+    init = Carry(inp.fit_used_cpu, inp.fit_used_mem,
+                 inp.score_used_cpu, inp.score_used_mem,
+                 inp.node_ports, inp.node_pds, inp.group_counts)
+
+    def step(carry: Carry, xs):
+        (static_row, req_cpu, req_mem, pod_ports, pod_pds,
+         tie_hi, tie_lo, gid, member) = xs
+
+        # Filter: resources (predicates.go:127-152 — zero-request always
+        # fits; zero capacity never constrains; pre-exceeded nodes fail)
+        cpu_ok = (inp.cap_cpu == 0) | (inp.cap_cpu - carry.fit_used_cpu >= req_cpu)
+        mem_ok = (inp.cap_mem == 0) | (inp.cap_mem - carry.fit_used_mem >= req_mem)
+        zero_req = (req_cpu == 0) & (req_mem == 0)
+        # fit_exceeded is static: committed pending pods always fit, so they
+        # never flip a node into the pre-exceeded state.
+        res_ok = zero_req | (~inp.fit_exceeded & cpu_ok & mem_ok)
+        # Filter: host ports (predicates.go:326-338)
+        port_conflict = jnp.any(carry.ports & pod_ports[None, :], axis=1)
+        # Filter: GCE PD exclusivity (predicates.go:68-83)
+        pd_conflict = jnp.any(carry.pds & pod_pds[None, :], axis=1)
+
+        feasible = static_row & res_ok & ~port_conflict & ~pd_conflict
+
+        # Score: LeastRequested (priorities.go:41-75 — all-pods usage + pod)
+        total_cpu = carry.score_used_cpu + req_cpu
+        total_mem = carry.score_used_mem + req_mem
+        lr = (_calculate_score(total_cpu, inp.cap_cpu)
+              + _calculate_score(total_mem, inp.cap_mem)) // 2
+        # Score: ServiceSpreading (spreading.go:37-86)
+        safe_gid = jnp.maximum(gid, 0)
+        counts_row = carry.counts[safe_gid]          # [N+1]
+        max_count = jnp.max(counts_row)
+        spread = _spread_score(max_count, counts_row[:N])
+        spread = jnp.where(gid >= 0, spread, jnp.int64(10))  # no service: 10
+
+        score = lr * w_lr + spread * w_spread + jnp.int64(w_equal)
+        masked = jnp.where(feasible, score, NEG)
+
+        # select host (generic_scheduler.go:84-96, deterministic tie-break)
+        top, any_feasible, best, cnt = masked_top_count(masked, NEG)
+        best = best & feasible
+        k = _u64_mod(tie_hi, tie_lo, cnt)
+        chosen = select_kth_true(best, k)
+        chosen = jnp.where(any_feasible, chosen, jnp.int32(-1))
+
+        # commit: one-hot update of every accumulator at the chosen node
+        onehot = (arange_n == chosen)                # [N] (all-False if -1)
+        carry = Carry(
+            fit_used_cpu=carry.fit_used_cpu + onehot * req_cpu,
+            fit_used_mem=carry.fit_used_mem + onehot * req_mem,
+            score_used_cpu=carry.score_used_cpu + onehot * req_cpu,
+            score_used_mem=carry.score_used_mem + onehot * req_mem,
+            ports=carry.ports | (onehot[:, None] & pod_ports[None, :]),
+            pds=carry.pds | (onehot[:, None] & pod_pds[None, :]),
+            counts=carry.counts + (member[:, None]
+                                   * jnp.pad(onehot, (0, 1)).astype(jnp.int32)[None, :]),
+        )
+        win_score = jnp.where(any_feasible, top, NEG)
+        return carry, (chosen, win_score)
+
+    xs = (static_mask, inp.req_cpu, inp.req_mem, inp.pod_ports, inp.pod_pds,
+          inp.tie_hi, inp.tie_lo, inp.pod_gid, inp.pod_group_member)
+    _, (chosen, scores) = jax.lax.scan(step, init, xs)
+    return chosen, scores
+
+
+def solve(snap: ClusterSnapshot) -> Tuple[np.ndarray, np.ndarray]:
+    """Host entry: encode -> device -> solve -> host decisions."""
+    inp = snapshot_to_inputs(snap)
+    chosen, scores = solve_jit(inp, w_lr=snap.w_least_requested,
+                               w_spread=snap.w_spreading, w_equal=snap.w_equal)
+    return np.asarray(chosen), np.asarray(scores)
+
+
+def decisions_to_names(snap: ClusterSnapshot, chosen: np.ndarray):
+    """Map node indices back to host names; None = unschedulable."""
+    return [snap.node_names[i] if i >= 0 else None for i in chosen]

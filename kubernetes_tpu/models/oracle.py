@@ -1,0 +1,62 @@
+"""Serial wave oracle — the reference path run over a whole pending batch.
+
+Runs the unmodified serial GenericScheduler (kubernetes_tpu.scheduler.generic)
+pod-by-pod over the same inputs the TPU batch solver sees, committing each
+decision before the next — exactly the reference driver's behavior
+(scheduleOne + Modeler.AssumePod, plugin/pkg/scheduler/scheduler.go:90-119).
+The equivalence contract: ``solve_serial(...) == decisions_to_names(solve(...))``
+for every input; tests/test_batch_solver.py fuzzes it, and bench.py re-checks
+it on every benchmark run.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import List, Optional, Sequence
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.scheduler import plugins as schedplugins
+from kubernetes_tpu.scheduler.generic import FitError, GenericScheduler
+from kubernetes_tpu.scheduler.listers import (
+    FakeMinionLister,
+    FakeNodeInfo,
+    FakePodLister,
+    FakeServiceLister,
+)
+
+__all__ = ["solve_serial"]
+
+
+def solve_serial(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
+                 pending_pods: Sequence[api.Pod],
+                 services: Sequence[api.Service] = (),
+                 provider: str = schedplugins.DEFAULT_PROVIDER
+                 ) -> List[Optional[str]]:
+    node_list = api.NodeList(items=list(nodes))
+    committed: List[api.Pod] = list(existing_pods)
+    pod_lister = FakePodLister(committed)  # shared, mutated via committed
+    args = schedplugins.PluginFactoryArgs(
+        pod_lister=pod_lister,
+        service_lister=FakeServiceLister(list(services)),
+        node_lister=FakeMinionLister(node_list),
+        node_info=FakeNodeInfo(node_list))
+    keys = schedplugins.get_algorithm_provider(provider)
+    scheduler = GenericScheduler(
+        schedplugins.get_predicates(keys["predicates"], args),
+        schedplugins.get_priorities(keys["priorities"], args),
+        pod_lister)
+
+    decisions: List[Optional[str]] = []
+    minion_lister = FakeMinionLister(node_list)
+    for pod in pending_pods:
+        try:
+            host = scheduler.schedule(pod, minion_lister)
+        except FitError:
+            decisions.append(None)
+            continue
+        decisions.append(host)
+        bound = copy.deepcopy(pod)
+        bound.spec.host = host
+        bound.status.host = host
+        committed.append(bound)  # visible to the next decision via pod_lister
+    return decisions

@@ -1,0 +1,286 @@
+"""ClusterSnapshot — dense-tensor encoding of scheduler state.
+
+The TPU analog of the reference's per-cycle ``MapPodsToMachines`` pivot
+(ref: pkg/scheduler/predicates.go:354-375): one host-side pass encodes nodes,
+existing pods, and the pending-pod batch into fixed-shape arrays the batch
+solver (kubernetes_tpu.models.batch_solver) consumes in a single compiled
+call.
+
+Exactness over hashing: label selectors, host ports, and GCE PD names are
+interned into small per-batch vocabularies built from the pending pods, so
+the "does pod p's selector accept node n" check is an exact boolean matmul —
+no hash collisions to reconcile with the serial oracle.
+
+Encoded predicate state mirrors predicates.go exactly:
+- resources: two accumulators per node — the greedy-fitting usage + exceeded
+  flag (CheckPodsExceedingCapacity semantics, :104-124) used by the Filter,
+  and the sum over ALL pods used by LeastRequested scoring
+  (priorities.go:41-75, which does not skip exceeding pods);
+- ports: vocabulary over host ports observed anywhere (getUsedPorts :340);
+- service spreading: per (namespace, first-matching-service) group counts by
+  host, plus one overflow bucket for unassigned/unknown hosts — the
+  reference counts those toward maxCount too (spreading.go:62-68).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from kubernetes_tpu.api import labels as labels_pkg
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.scheduler.generic import fnv1a64, pod_tie_break_key
+from kubernetes_tpu.scheduler.predicates import get_resource_request
+
+__all__ = ["ClusterSnapshot", "encode_snapshot"]
+
+_PAD = 8  # minimum vocabulary padding (keeps matmul shapes nonzero)
+
+
+def _pad_to(n: int, multiple: int = _PAD) -> int:
+    return max(multiple, ((n + multiple - 1) // multiple) * multiple)
+
+
+@dataclass
+class ClusterSnapshot:
+    """All arrays are numpy; the solver moves them to device."""
+
+    node_names: List[str]
+    # capacities / usage (int64: memory bytes exceed int32)
+    cap_cpu: np.ndarray          # [N] i64 milli-CPU
+    cap_mem: np.ndarray          # [N] i64 bytes
+    fit_used_cpu: np.ndarray     # [N] i64 greedy-fitting usage (Filter)
+    fit_used_mem: np.ndarray     # [N] i64
+    fit_exceeded: np.ndarray     # [N] bool — an existing pod already didn't fit
+    score_used_cpu: np.ndarray   # [N] i64 all-pods usage (Score)
+    score_used_mem: np.ndarray   # [N] i64
+    # vocab-interned boolean features
+    node_ports: np.ndarray       # [N, K] bool
+    node_sel: np.ndarray         # [N, K2] bool — node has (key,value) label
+    node_pds: np.ndarray         # [N, K3] bool
+    node_extra_ok: np.ndarray    # [N] bool — policy NodeLabelPresence etc.
+    # pending pods
+    pod_names: List[str]
+    req_cpu: np.ndarray          # [P] i64
+    req_mem: np.ndarray          # [P] i64
+    pod_ports: np.ndarray        # [P, K] bool
+    pod_sel: np.ndarray          # [P, K2] bool — required (key,value) pairs
+    pod_pds: np.ndarray          # [P, K3] bool
+    pod_host_idx: np.ndarray     # [P] i32: -1 unset, -2 host not in node list
+    tie_hi: np.ndarray           # [P] i64 — fnv1a64(pod key) >> 32
+    tie_lo: np.ndarray           # [P] i64 — fnv1a64(pod key) & 0xffffffff
+    # service spreading groups
+    pod_gid: np.ndarray          # [P] i32, -1 = no service
+    pod_group_member: np.ndarray  # [P, G] bool — pod's labels match group's selector
+    group_counts: np.ndarray     # [G, N+1] i32 (slot N: unassigned/unknown hosts)
+    # priority weights (static)
+    w_least_requested: int = 1
+    w_spreading: int = 1
+    w_equal: int = 0
+
+    @property
+    def n_nodes(self) -> int:
+        return len(self.node_names)
+
+    @property
+    def n_pods(self) -> int:
+        return len(self.pod_names)
+
+
+def encode_snapshot(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
+                    pending_pods: Sequence[api.Pod],
+                    services: Sequence[api.Service] = (),
+                    node_extra_ok: Optional[np.ndarray] = None,
+                    max_groups: int = 64) -> ClusterSnapshot:
+    """Encode one scheduling wave. Node order defines the tie-break order and
+    must match what the serial oracle sees."""
+    N, P = len(nodes), len(pending_pods)
+    node_index = {n.metadata.name: i for i, n in enumerate(nodes)}
+
+    # -- capacities ---------------------------------------------------------
+    cap_cpu = np.zeros(N, np.int64)
+    cap_mem = np.zeros(N, np.int64)
+    for i, n in enumerate(nodes):
+        cap = n.spec.capacity or {}
+        q = cap.get(api.ResourceCPU)
+        cap_cpu[i] = q.milli_value() if q is not None else 0
+        q = cap.get(api.ResourceMemory)
+        cap_mem[i] = q.int_value() if q is not None else 0
+
+    # -- existing pod usage: greedy Filter accumulators + Score sums --------
+    pods_by_host: Dict[str, List[api.Pod]] = {}
+    for p in existing_pods:
+        pods_by_host.setdefault(p.status.host, []).append(p)
+
+    fit_used_cpu = np.zeros(N, np.int64)
+    fit_used_mem = np.zeros(N, np.int64)
+    fit_exceeded = np.zeros(N, bool)
+    score_used_cpu = np.zeros(N, np.int64)
+    score_used_mem = np.zeros(N, np.int64)
+    for host, host_pods in pods_by_host.items():
+        i = node_index.get(host)
+        if i is None:
+            continue
+        ccpu, cmem = cap_cpu[i], cap_mem[i]
+        used_c = used_m = 0
+        for p in host_pods:
+            req = get_resource_request(p)
+            score_used_cpu[i] += req.milli_cpu
+            score_used_mem[i] += req.memory
+            fits_cpu = ccpu == 0 or (ccpu - used_c) >= req.milli_cpu
+            fits_mem = cmem == 0 or (cmem - used_m) >= req.memory
+            if fits_cpu and fits_mem:
+                used_c += req.milli_cpu
+                used_m += req.memory
+            else:
+                fit_exceeded[i] = True
+        fit_used_cpu[i] = used_c
+        fit_used_mem[i] = used_m
+
+    # -- vocabularies -------------------------------------------------------
+    port_vocab: Dict[int, int] = {}
+    sel_vocab: Dict[Tuple[str, str], int] = {}
+    pd_vocab: Dict[str, int] = {}
+
+    def intern(vocab, key):
+        if key not in vocab:
+            vocab[key] = len(vocab)
+        return vocab[key]
+
+    def pod_port_list(p: api.Pod):
+        return [cp.host_port for c in p.spec.containers for cp in c.ports]
+
+    def pod_pd_list(p: api.Pod):
+        return [v.source.gce_persistent_disk.pd_name for v in p.spec.volumes
+                if v.source.gce_persistent_disk is not None]
+
+    for p in pending_pods:
+        for port in pod_port_list(p):
+            if port:
+                intern(port_vocab, port)
+        for kv in (p.spec.node_selector or {}).items():
+            intern(sel_vocab, kv)
+        for pd in pod_pd_list(p):
+            intern(pd_vocab, pd)
+
+    K = _pad_to(len(port_vocab))
+    K2 = _pad_to(len(sel_vocab))
+    K3 = _pad_to(len(pd_vocab))
+
+    node_ports = np.zeros((N, K), bool)
+    node_pds = np.zeros((N, K3), bool)
+    for host, host_pods in pods_by_host.items():
+        i = node_index.get(host)
+        if i is None:
+            continue
+        for p in host_pods:
+            for port in pod_port_list(p):
+                k = port_vocab.get(port)
+                if k is not None and port:
+                    node_ports[i, k] = True
+            for pd in pod_pd_list(p):
+                k = pd_vocab.get(pd)
+                if k is not None:
+                    node_pds[i, k] = True
+
+    node_sel = np.zeros((N, K2), bool)
+    for i, n in enumerate(nodes):
+        lbls = n.metadata.labels or {}
+        for kv, k in sel_vocab.items():
+            if lbls.get(kv[0]) == kv[1]:
+                node_sel[i, k] = True
+
+    # -- pending pods -------------------------------------------------------
+    req_cpu = np.zeros(P, np.int64)
+    req_mem = np.zeros(P, np.int64)
+    pod_ports = np.zeros((P, K), bool)
+    pod_sel = np.zeros((P, K2), bool)
+    pod_pds = np.zeros((P, K3), bool)
+    pod_host_idx = np.full(P, -1, np.int32)
+    tie_hi = np.zeros(P, np.int64)
+    tie_lo = np.zeros(P, np.int64)
+    pod_names = []
+    for j, p in enumerate(pending_pods):
+        pod_names.append(f"{p.metadata.namespace}/{p.metadata.name}")
+        req = get_resource_request(p)
+        req_cpu[j] = req.milli_cpu
+        req_mem[j] = req.memory
+        for port in pod_port_list(p):
+            if port:
+                pod_ports[j, port_vocab[port]] = True
+        for kv in (p.spec.node_selector or {}).items():
+            pod_sel[j, sel_vocab[kv]] = True
+        for pd in pod_pd_list(p):
+            pod_pds[j, pd_vocab[pd]] = True
+        if p.spec.host:
+            pod_host_idx[j] = node_index.get(p.spec.host, -2)
+        h = fnv1a64(pod_tie_break_key(p))
+        tie_hi[j] = h >> 32
+        tie_lo[j] = h & 0xFFFFFFFF
+
+    # -- service spreading groups ------------------------------------------
+    # group = (namespace, index of FIRST service whose selector matches the
+    # pod) — mirrors ServiceSpread's "just use the first service"
+    # (spreading.go:44). Group membership of *any* pod (existing or committed)
+    # is: same namespace + selector match.
+    services = list(services)
+    svc_selectors = [labels_pkg.selector_from_set(s.spec.selector or {})
+                     for s in services]
+    group_ids: Dict[Tuple[str, int], int] = {}
+    pod_gid = np.full(P, -1, np.int32)
+
+    def first_service_for(p: api.Pod) -> Optional[int]:
+        for si, s in enumerate(services):
+            if s.metadata.namespace and s.metadata.namespace != p.metadata.namespace:
+                continue
+            if not (s.spec.selector or {}):
+                continue
+            if svc_selectors[si].matches(p.metadata.labels):
+                return si
+        return None
+
+    for j, p in enumerate(pending_pods):
+        si = first_service_for(p)
+        if si is None:
+            continue
+        key = (p.metadata.namespace, si)
+        if key not in group_ids:
+            if len(group_ids) >= max_groups:
+                raise ValueError(
+                    f"pending batch spans more than {max_groups} service groups; "
+                    "split the wave or raise max_groups")
+            group_ids[key] = len(group_ids)
+        pod_gid[j] = group_ids[key]
+
+    G = max(1, len(group_ids))
+    group_counts = np.zeros((G, N + 1), np.int32)
+    pod_group_member = np.zeros((P, G), bool)
+    for (ns, si), g in group_ids.items():
+        sel = svc_selectors[si]
+        for p in existing_pods:
+            if p.metadata.namespace != ns or not sel.matches(p.metadata.labels):
+                continue
+            i = node_index.get(p.status.host, N)  # unknown/unassigned -> slot N
+            group_counts[g, i] += 1
+        for j, p in enumerate(pending_pods):
+            if p.metadata.namespace == ns and sel.matches(p.metadata.labels):
+                pod_group_member[j, g] = True
+
+    return ClusterSnapshot(
+        node_names=[n.metadata.name for n in nodes],
+        cap_cpu=cap_cpu, cap_mem=cap_mem,
+        fit_used_cpu=fit_used_cpu, fit_used_mem=fit_used_mem,
+        fit_exceeded=fit_exceeded,
+        score_used_cpu=score_used_cpu, score_used_mem=score_used_mem,
+        node_ports=node_ports, node_sel=node_sel, node_pds=node_pds,
+        node_extra_ok=(node_extra_ok if node_extra_ok is not None
+                       else np.ones(N, bool)),
+        pod_names=pod_names,
+        req_cpu=req_cpu, req_mem=req_mem,
+        pod_ports=pod_ports, pod_sel=pod_sel, pod_pds=pod_pds,
+        pod_host_idx=pod_host_idx, tie_hi=tie_hi, tie_lo=tie_lo,
+        pod_gid=pod_gid, pod_group_member=pod_group_member,
+        group_counts=group_counts,
+    )

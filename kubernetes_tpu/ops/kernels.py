@@ -1,0 +1,59 @@
+"""Scoring / masking kernels shared by the batch solver.
+
+These are the TPU-side twins of the serial scoring functions — each one
+cites the exact reference semantics it reproduces. Kept in ops/ so the
+solver (models/batch_solver.py) reads as orchestration and the kernels are
+individually testable against their serial counterparts.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["calculate_score", "spread_score", "u64_mod_small",
+           "select_kth_true", "masked_top_count"]
+
+
+def calculate_score(requested: jnp.ndarray, capacity: jnp.ndarray) -> jnp.ndarray:
+    """LeastRequested per-dimension score: integer ((cap-req)*10)//cap with 0
+    on zero or exceeded capacity (ref: pkg/scheduler/priorities.go:27-37;
+    serial twin kubernetes_tpu.scheduler.priorities.calculate_score)."""
+    safe_cap = jnp.where(capacity == 0, 1, capacity)
+    score = ((capacity - requested) * 10) // safe_cap
+    return jnp.where((capacity == 0) | (requested > capacity), 0, score).astype(jnp.int64)
+
+
+def spread_score(total: jnp.ndarray, counts: jnp.ndarray) -> jnp.ndarray:
+    """ServiceSpreading score: every operation in float32 then truncate —
+    bit-identical to Go's float32 evaluation (ref: spreading.go:76-80;
+    serial twin kubernetes_tpu.scheduler.priorities.spread_score_f32)."""
+    div = (total - counts).astype(jnp.float32) / total.astype(jnp.float32)
+    fscore = jnp.float32(10) * div
+    return jnp.where(total > 0, fscore.astype(jnp.int64), jnp.int64(10))
+
+
+def u64_mod_small(hi: jnp.ndarray, lo: jnp.ndarray, m: jnp.ndarray) -> jnp.ndarray:
+    """(hi*2^32 + lo) % m using only int64 ops (m < 2^31 so every partial
+    product fits). The tie-break hash is FNV-1a-64 computed host-side and
+    shipped as (hi, lo) int64 halves — TPU has no native u64 modulo."""
+    two32_mod = jnp.int64(1 << 32) % m
+    return ((hi % m) * two32_mod + lo % m) % m
+
+
+def masked_top_count(masked_scores: jnp.ndarray, sentinel) -> tuple:
+    """(top, any_valid, best_mask, count) over a sentinel-masked score row —
+    the vector form of sort-desc + getBestHosts
+    (ref: generic_scheduler.go:84-112)."""
+    top = jnp.max(masked_scores)
+    any_valid = top > sentinel
+    best = masked_scores == top
+    count = jnp.maximum(jnp.sum(best.astype(jnp.int64)), 1)
+    return top, any_valid, best, count
+
+
+def select_kth_true(mask: jnp.ndarray, k: jnp.ndarray) -> jnp.ndarray:
+    """Index of the (k+1)-th True in mask, in index order — the deterministic
+    replacement for the reference's rand.Int()%len(bestHosts) choice."""
+    cum = jnp.cumsum(mask.astype(jnp.int64))
+    return jnp.argmax((cum == k + 1) & mask).astype(jnp.int32)

@@ -1,0 +1,118 @@
+"""Multi-chip sharding for the batch solver.
+
+The scaling model (SURVEY.md section 5 "long-context" note): the
+(pods x nodes) problem is our sequence. When the node axis outgrows one
+chip's HBM or FLOPs, shard it over a ``jax.sharding.Mesh``:
+
+- 2D mesh ("pods", "nodes"): the batched Filter pre-pass — an MXU matmul of
+  pod features against node features — shards both operands (data-parallel
+  over pods, tensor-parallel over nodes).
+- the sequential-commit scan keeps its [N]-shaped carries sharded over
+  "nodes"; per-step reductions (max/sum/cumsum for the deterministic
+  tie-break) become XLA collectives over ICI, inserted by the SPMD
+  partitioner — no hand-written communication.
+
+Nodes are padded to the mesh size with permanently-infeasible entries
+(node_extra_ok=False), so padding can never win a tie-break and decisions
+remain bit-identical to the unsharded / serial paths.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubernetes_tpu.models.batch_solver import SolverInputs, solve_jit
+
+__all__ = ["make_mesh", "pad_inputs_for_mesh", "solve_sharded"]
+
+
+def make_mesh(devices=None, pods_axis: int = 1) -> Mesh:
+    """Mesh over available devices: ("pods", "nodes"). With pods_axis=1 the
+    whole mesh shards the node axis (pure tensor-parallel layout)."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if n % pods_axis != 0:
+        raise ValueError(f"{n} devices not divisible by pods_axis={pods_axis}")
+    arr = np.array(devices).reshape(pods_axis, n // pods_axis)
+    return Mesh(arr, ("pods", "nodes"))
+
+
+def pad_inputs_for_mesh(inp: SolverInputs, mesh: Mesh) -> Tuple[SolverInputs, int]:
+    """Pad the node axis to a multiple of the "nodes" mesh axis with
+    infeasible nodes. Returns (padded inputs, original N)."""
+    shards = mesh.shape["nodes"]
+    n = int(inp.cap_cpu.shape[0])
+    pad = (-n) % shards
+    if pad == 0:
+        return inp, n
+
+    def pad_n(x, axis=0, fill=0):
+        widths = [(0, 0)] * x.ndim
+        widths[axis] = (0, pad)
+        return jnp.pad(x, widths, constant_values=fill)
+
+    return SolverInputs(
+        cap_cpu=pad_n(inp.cap_cpu), cap_mem=pad_n(inp.cap_mem),
+        fit_used_cpu=pad_n(inp.fit_used_cpu), fit_used_mem=pad_n(inp.fit_used_mem),
+        fit_exceeded=pad_n(inp.fit_exceeded, fill=True),
+        score_used_cpu=pad_n(inp.score_used_cpu),
+        score_used_mem=pad_n(inp.score_used_mem),
+        node_ports=pad_n(inp.node_ports), node_sel=pad_n(inp.node_sel),
+        node_pds=pad_n(inp.node_pds),
+        node_extra_ok=pad_n(inp.node_extra_ok, fill=False),  # never feasible
+        req_cpu=inp.req_cpu, req_mem=inp.req_mem,
+        pod_ports=inp.pod_ports, pod_sel=inp.pod_sel, pod_pds=inp.pod_pds,
+        pod_host_idx=inp.pod_host_idx, tie_hi=inp.tie_hi, tie_lo=inp.tie_lo,
+        pod_gid=inp.pod_gid, pod_group_member=inp.pod_group_member,
+        group_counts=pad_n(inp.group_counts, axis=1),
+    ), n
+
+
+def _input_shardings(mesh: Mesh) -> SolverInputs:
+    """Sharding spec per input: node-axis arrays shard over "nodes"; per-pod
+    arrays shard the scan axis over "pods" where legal, else replicate."""
+    def s(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    node = s("nodes")
+    node2d = s("nodes", None)
+    rep = s()
+    return SolverInputs(
+        cap_cpu=node, cap_mem=node,
+        fit_used_cpu=node, fit_used_mem=node, fit_exceeded=node,
+        score_used_cpu=node, score_used_mem=node,
+        node_ports=node2d, node_sel=node2d, node_pds=node2d,
+        node_extra_ok=node,
+        req_cpu=rep, req_mem=rep,
+        pod_ports=rep, pod_sel=rep, pod_pds=rep,
+        pod_host_idx=rep, tie_hi=rep, tie_lo=rep,
+        pod_gid=rep, pod_group_member=rep,
+        # counts: small [G, N+1] — the +1 overflow slot breaks even node
+        # sharding; replicate (GSPMD gathers the one-hot update, tiny)
+        group_counts=rep,
+    )
+
+
+def solve_sharded(inp: SolverInputs, mesh: Optional[Mesh] = None,
+                  w_lr: int = 1, w_spread: int = 1, w_equal: int = 0
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+    """Run solve_jit under a device mesh. Decisions are identical to the
+    single-device path; only the layout changes."""
+    mesh = mesh or make_mesh()
+    padded, n = pad_inputs_for_mesh(inp, mesh)
+    shardings = _input_shardings(mesh)
+    placed = jax.tree.map(jax.device_put, tuple(padded), tuple(shardings))
+    with mesh:
+        chosen, scores = solve_jit(SolverInputs(*placed), w_lr=w_lr,
+                                   w_spread=w_spread, w_equal=w_equal)
+    chosen = np.asarray(chosen)
+    scores = np.asarray(scores)
+    # padded nodes are infeasible, so indices never point past n; no remap
+    assert chosen.max(initial=-1) < n
+    return chosen, scores

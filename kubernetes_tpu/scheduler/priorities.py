@@ -50,6 +50,17 @@ def f32_trunc(x: float) -> int:
     return int(struct.unpack("f", struct.pack("f", x))[0])
 
 
+def spread_score_f32(total: int, count: int) -> int:
+    """``int(10 * (float32(total-count) / float32(total)))`` with every
+    operation performed in float32, exactly as Go evaluates it
+    (spreading.go:78-79, :154-156) and exactly as the TPU score kernel
+    computes it — keeping all three implementations bit-identical."""
+    import numpy as np
+
+    div = np.float32(total - count) / np.float32(total)
+    return int(np.float32(10) * div)
+
+
 def calculate_score(requested: int, capacity: int, node: str) -> int:
     """ref: priorities.go:27-37 calculateScore — Go integer division."""
     if capacity == 0:
@@ -156,10 +167,10 @@ class ServiceSpread:
 
         result = []
         for minion in minions.items:
-            fscore = 10.0
+            score = 10
             if max_count > 0:
-                fscore = 10 * ((max_count - counts.get(minion.metadata.name, 0)) / max_count)
-            result.append(HostPriority(host=minion.metadata.name, score=f32_trunc(fscore)))
+                score = spread_score_f32(max_count, counts.get(minion.metadata.name, 0))
+            result.append(HostPriority(host=minion.metadata.name, score=score))
         return result
 
 
@@ -195,11 +206,11 @@ class ServiceAntiAffinity:
         num_service_pods = len(ns_service_pods)
         result = []
         for minion in labeled_minions:
-            fscore = 10.0
+            score = 10
             if num_service_pods > 0:
-                fscore = 10 * ((num_service_pods - pod_counts.get(labeled_minions[minion], 0))
-                               / num_service_pods)
-            result.append(HostPriority(host=minion, score=f32_trunc(fscore)))
+                score = spread_score_f32(num_service_pods,
+                                         pod_counts.get(labeled_minions[minion], 0))
+            result.append(HostPriority(host=minion, score=score))
         for minion in other_minions:
             result.append(HostPriority(host=minion, score=0))
         return result

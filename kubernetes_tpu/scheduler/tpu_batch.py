@@ -1,0 +1,143 @@
+"""The "tpu-batch" scheduler profile — wave scheduling on the batch solver.
+
+Replaces the reference's one-pod-at-a-time loop
+(plugin/pkg/scheduler/scheduler.go:87-90 ``util.Forever(scheduleOne)``) with:
+
+    drain a wave from the FIFO -> snapshot cluster state -> ONE TPU solve
+    -> commit bindings sequentially -> assume pods
+
+Decisions are bit-identical to running the serial scheduler over the same
+wave (models/oracle.py contract), because the solver reproduces the serial
+sequential-commit semantics inside one compiled call. The Binding write path,
+backoff/error handling, and the assume/confirm modeler are shared with the
+serial driver — this is a drop-in Config.algorithm-level swap, the same
+boundary the reference exposes for alternate schedulers.
+
+Bind conflicts (another scheduler won the CAS) invalidate that pod only; the
+error handler requeues it and the next wave re-solves against fresh state.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.client.record import EventRecorder
+from kubernetes_tpu.models.batch_solver import (
+    decisions_to_names,
+    snapshot_to_inputs,
+    solve_jit,
+)
+from kubernetes_tpu.models.snapshot import encode_snapshot
+from kubernetes_tpu.scheduler.driver import ConfigFactory, SchedulerConfig
+from kubernetes_tpu.scheduler.generic import FitError
+
+__all__ = ["BatchScheduler"]
+
+
+class BatchScheduler:
+    """Wave-based driver over SchedulerConfig plumbing."""
+
+    def __init__(self, config: SchedulerConfig, factory: ConfigFactory,
+                 client, wave_size: int = 1024, wave_linger_s: float = 0.02,
+                 solve_fn=None):
+        self.config = config
+        self.factory = factory
+        self.client = client
+        self.wave_size = wave_size
+        self.wave_linger_s = wave_linger_s
+        self.solve_fn = solve_fn or self._default_solve
+        self._stop = threading.Event()
+
+    # -- wave assembly ------------------------------------------------------
+    def _drain_wave(self, timeout: Optional[float]) -> List[api.Pod]:
+        pods: List[api.Pod] = [self.config.next_pod(timeout)]
+        deadline = time.monotonic() + self.wave_linger_s
+        while len(pods) < self.wave_size:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                pods.append(self.config.next_pod(remaining))
+            except TimeoutError:
+                break
+        return pods
+
+    # -- solving ------------------------------------------------------------
+    def _default_solve(self, nodes, existing, pending, services):
+        snap = encode_snapshot(nodes, existing, pending, services)
+        chosen, _ = solve_jit(snapshot_to_inputs(snap))
+        import numpy as np
+
+        return decisions_to_names(snap, np.asarray(chosen))
+
+    def schedule_wave(self, timeout: Optional[float] = None) -> int:
+        """Drain, solve, commit. Returns the number of pods bound."""
+        c = self.config
+        pending = self._drain_wave(timeout)
+        try:
+            nodes = c.minion_lister.list().items
+            existing = c.modeler.list()
+            services = self.factory.service_store.list()
+            decisions = self.solve_fn(nodes, existing, pending, services)
+        except Exception as e:
+            # a failed solve must not drop the drained wave: hand every pod
+            # to the error handler for backoff+requeue, like the serial
+            # driver does per pod (scheduler.go:96-101)
+            for pod in pending:
+                self._record(pod, "FailedScheduling", "Error scheduling wave: %s", e)
+                c.error(pod, e)
+            return 0
+
+        bound = 0
+        for pod, host in zip(pending, decisions):
+            if host is None:
+                err = FitError(pod, {})
+                self._record(pod, "FailedScheduling", "Error scheduling: %s", err)
+                c.error(pod, err)
+                continue
+            binding = api.Binding(
+                metadata=api.ObjectMeta(name=pod.metadata.name,
+                                        namespace=pod.metadata.namespace),
+                pod_name=pod.metadata.name, host=host)
+            try:
+                c.binder.bind(binding)
+            except Exception as e:
+                # lost a CAS race: requeue; next wave sees fresh state
+                self._record(pod, "FailedScheduling", "Binding rejected: %s", e)
+                c.error(pod, e)
+                continue
+            self._record(pod, "Scheduled", "Successfully assigned %s to %s",
+                         pod.metadata.name, host)
+            import copy as _copy
+
+            assumed = _copy.deepcopy(pod)
+            assumed.spec.host = host
+            assumed.status.host = host
+            c.modeler.assume_pod(assumed)
+            bound += 1
+        return bound
+
+    # -- loop ---------------------------------------------------------------
+    def run(self) -> "BatchScheduler":
+        t = threading.Thread(target=self._loop, daemon=True, name="tpu-batch-scheduler")
+        t.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.schedule_wave(timeout=0.2)
+            except TimeoutError:
+                continue
+            except Exception:
+                time.sleep(0.01)
+
+    def _record(self, pod, reason, fmt, *args):
+        if self.config.recorder is not None:
+            self.config.recorder.eventf(pod, reason, fmt, *args)

@@ -4,11 +4,20 @@ Tests run on CPU with 8 virtual XLA devices so multi-chip sharding
 (kubernetes_tpu.parallel) is exercised without TPU hardware, mirroring how the
 reference tests "multi-node" behavior in one process with fakes
 (ref: cmd/integration/integration.go:67-117).
+
+NOTE: in this image jax is pre-imported by a sitecustomize hook that
+registers the hardware backend, so setting JAX_PLATFORMS via os.environ here
+is too late — the platform must be forced through jax.config, before any
+backend initialization.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")  # for any subprocesses
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,222 @@
+"""TPU batch solver vs serial oracle — bit-identical equivalence.
+
+The decision contract (BASELINE.md north star): for every snapshot, the batch
+solver's per-pod host choices equal the serial reference path's, including
+tie-breaks. Fuzzed over cluster shapes, resources, ports, selectors, PDs,
+pinned hosts, and service spreading groups.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.quantity import Quantity
+from kubernetes_tpu.models.batch_solver import decisions_to_names, solve
+from kubernetes_tpu.models.oracle import solve_serial
+from kubernetes_tpu.models.snapshot import encode_snapshot
+
+
+def mk_node(name, cpu_m=4000, mem=8 << 30, labels=None):
+    return api.Node(
+        metadata=api.ObjectMeta(name=name, labels=labels or {}),
+        spec=api.NodeSpec(capacity={"cpu": Quantity(f"{cpu_m}m"),
+                                    "memory": Quantity(mem)}))
+
+
+def mk_pod(name, ns="default", cpu_m=0, mem=0, host="", labels=None,
+           node_selector=None, host_ports=(), pds=()):
+    limits = {}
+    if cpu_m:
+        limits["cpu"] = Quantity(f"{cpu_m}m")
+    if mem:
+        limits["memory"] = Quantity(mem)
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace=ns, uid=f"uid-{ns}-{name}",
+                                labels=labels or {}),
+        spec=api.PodSpec(
+            host=host,
+            node_selector=node_selector or {},
+            containers=[api.Container(
+                name="c", image="i",
+                ports=[api.ContainerPort(container_port=80 + i, host_port=p)
+                       for i, p in enumerate(host_ports)],
+                resources=api.ResourceRequirements(limits=limits))],
+            volumes=[api.Volume(name=f"v{i}", source=api.VolumeSource(
+                gce_persistent_disk=api.GCEPersistentDiskVolumeSource(pd_name=pd)))
+                for i, pd in enumerate(pds)]),
+        status=api.PodStatus(host=host))
+
+
+def assert_equivalent(nodes, existing, pending, services=()):
+    serial = solve_serial(nodes, existing, pending, services)
+    snap = encode_snapshot(nodes, existing, pending, services)
+    chosen, _ = solve(snap)
+    batch = decisions_to_names(snap, chosen)
+    assert batch == serial, (
+        f"divergence:\n  serial={serial}\n  batch ={batch}")
+    return serial
+
+
+# -- targeted cases ---------------------------------------------------------
+
+def test_empty_cluster():
+    assert solve_serial([], [], [mk_pod("p")]) == [None]
+    snap = encode_snapshot([mk_node("n1")], [], [])
+    chosen, _ = solve(snap)
+    assert chosen.shape == (0,)
+
+
+def test_least_requested_prefers_idle():
+    nodes = [mk_node("busy"), mk_node("idle")]
+    existing = [mk_pod("e", cpu_m=3000, mem=6 << 30, host="busy")]
+    hosts = assert_equivalent(nodes, existing, [mk_pod("x", cpu_m=500, mem=1 << 30)])
+    assert hosts == ["idle"]
+
+
+def test_sequential_commits_affect_later_pods():
+    """Each decision must update usage for the next — the serial semantics."""
+    nodes = [mk_node("a", cpu_m=1000, mem=1 << 30), mk_node("b", cpu_m=1000, mem=1 << 30)]
+    pending = [mk_pod(f"p{i}", cpu_m=600, mem=100 << 20) for i in range(3)]
+    hosts = assert_equivalent(nodes, [], pending)
+    assert hosts[0] != hosts[1]        # second pod forced to the other node
+    assert hosts[2] is None            # third fits nowhere
+
+
+def test_capacity_exhaustion_and_unschedulable():
+    nodes = [mk_node("n", cpu_m=1000, mem=1 << 30)]
+    pending = [mk_pod("big", cpu_m=2000), mk_pod("ok", cpu_m=500),
+               mk_pod("overflow", cpu_m=600)]
+    hosts = assert_equivalent(nodes, [], pending)
+    assert hosts == [None, "n", None]
+
+
+def test_zero_request_always_fits():
+    nodes = [mk_node("full", cpu_m=100, mem=1 << 20)]
+    existing = [mk_pod("hog", cpu_m=100, mem=1 << 20, host="full")]
+    hosts = assert_equivalent(nodes, existing, [mk_pod("zero")])
+    assert hosts == ["full"]
+
+
+def test_zero_capacity_never_constrains():
+    n = api.Node(metadata=api.ObjectMeta(name="limitless"), spec=api.NodeSpec(capacity={}))
+    hosts = assert_equivalent([n], [], [mk_pod("huge", cpu_m=10**6, mem=1 << 40)])
+    assert hosts == ["limitless"]
+
+
+def test_host_port_conflicts_within_wave():
+    nodes = [mk_node("a"), mk_node("b")]
+    pending = [mk_pod(f"p{i}", host_ports=(8080,)) for i in range(3)]
+    hosts = assert_equivalent(nodes, [], pending)
+    assert sorted(h for h in hosts if h) == ["a", "b"]
+    assert hosts.count(None) == 1
+
+
+def test_node_selector_and_pinned_host():
+    nodes = [mk_node("gpu", labels={"accel": "tpu"}), mk_node("plain")]
+    pending = [
+        mk_pod("wants-accel", node_selector={"accel": "tpu"}),
+        mk_pod("pinned", host="plain"),
+        mk_pod("pinned-unknown", host="ghost"),
+    ]
+    hosts = assert_equivalent(nodes, [], pending)
+    assert hosts == ["gpu", "plain", None]
+
+
+def test_pd_conflicts_within_wave_and_snapshot():
+    nodes = [mk_node("a"), mk_node("b")]
+    existing = [mk_pod("e", host="a", pds=("disk-1",))]
+    pending = [mk_pod("p1", pds=("disk-1",)), mk_pod("p2", pds=("disk-1",))]
+    hosts = assert_equivalent(nodes, existing, pending)
+    assert hosts == ["b", None]
+
+
+def test_service_spreading_within_wave():
+    nodes = [mk_node(f"n{i}") for i in range(4)]
+    svc = api.Service(metadata=api.ObjectMeta(name="web", namespace="default"),
+                      spec=api.ServiceSpec(port=80, selector={"app": "web"}))
+    pending = [mk_pod(f"w{i}", labels={"app": "web"}) for i in range(8)]
+    hosts = assert_equivalent(nodes, [], pending, [svc])
+    placement = {n: hosts.count(n) for n in ("n0", "n1", "n2", "n3")}
+    assert set(placement.values()) == {2}  # perfect spread
+
+
+def test_spreading_counts_unassigned_peers():
+    """Unassigned peers (status.host == '') count toward maxCount
+    (spreading.go:62-68) — slot N in the group counts."""
+    nodes = [mk_node("n0"), mk_node("n1")]
+    svc = api.Service(metadata=api.ObjectMeta(name="s", namespace="default"),
+                      spec=api.ServiceSpec(port=80, selector={"app": "x"}))
+    existing = [mk_pod("floating", labels={"app": "x"}, host="")]
+    assert_equivalent(nodes, existing, [mk_pod("p", labels={"app": "x"})], [svc])
+
+
+def test_tie_break_matches_oracle():
+    nodes = [mk_node(f"n{i}") for i in range(7)]
+    pending = [mk_pod(f"p{i}") for i in range(7)]  # all scores equal
+    hosts = assert_equivalent(nodes, [], pending)
+    assert len(set(hosts)) > 1  # hash tie-break spreads across nodes
+
+
+def test_multiple_namespaces_and_services():
+    nodes = [mk_node(f"n{i}") for i in range(3)]
+    svcs = [
+        api.Service(metadata=api.ObjectMeta(name="a", namespace="ns1"),
+                    spec=api.ServiceSpec(port=80, selector={"app": "a"})),
+        api.Service(metadata=api.ObjectMeta(name="b", namespace="ns2"),
+                    spec=api.ServiceSpec(port=80, selector={"app": "b"})),
+    ]
+    pending = [mk_pod("a1", ns="ns1", labels={"app": "a"}),
+               mk_pod("b1", ns="ns2", labels={"app": "b"}),
+               mk_pod("a2", ns="ns1", labels={"app": "a"}),
+               mk_pod("c", ns="ns1")]
+    assert_equivalent(nodes, [], pending, svcs)
+
+
+# -- fuzz -------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", range(12))
+def test_fuzz_equivalence(seed):
+    rng = random.Random(seed)
+    n_nodes = rng.randint(1, 16)
+    n_existing = rng.randint(0, 20)
+    n_pending = rng.randint(1, 40)
+    zones = ["z1", "z2", "z3"]
+    nodes = []
+    for i in range(n_nodes):
+        labels = {}
+        if rng.random() < 0.5:
+            labels["zone"] = rng.choice(zones)
+        if rng.random() < 0.3:
+            labels["disk"] = "ssd"
+        nodes.append(mk_node(
+            f"n{i}", cpu_m=rng.choice([500, 1000, 2000, 4000]),
+            mem=rng.choice([1 << 30, 2 << 30, 8 << 30]), labels=labels))
+    services = [
+        api.Service(metadata=api.ObjectMeta(name="svc-a", namespace="default"),
+                    spec=api.ServiceSpec(port=80, selector={"app": "a"})),
+        api.Service(metadata=api.ObjectMeta(name="svc-b", namespace="default"),
+                    spec=api.ServiceSpec(port=80, selector={"app": "b"})),
+    ]
+
+    def random_pod(name, may_have_host):
+        kw = dict(
+            cpu_m=rng.choice([0, 100, 250, 500, 1000]),
+            mem=rng.choice([0, 64 << 20, 512 << 20, 1 << 30]),
+            labels={"app": rng.choice(["a", "b", "c"])} if rng.random() < 0.7 else {},
+        )
+        if rng.random() < 0.3:
+            kw["host_ports"] = (rng.choice([8080, 9090]),)
+        if rng.random() < 0.2:
+            kw["node_selector"] = {"zone": rng.choice(zones)}
+        if rng.random() < 0.15:
+            kw["pds"] = (rng.choice(["pd1", "pd2"]),)
+        if may_have_host:
+            kw["host"] = rng.choice([n.metadata.name for n in nodes]
+                                    + ["", "dead-node"])
+        return mk_pod(name, **kw)
+
+    existing = [random_pod(f"e{i}", True) for i in range(n_existing)]
+    pending = [random_pod(f"p{i}", False) for i in range(n_pending)]
+    assert_equivalent(nodes, existing, pending, services)

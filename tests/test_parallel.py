@@ -1,0 +1,78 @@
+"""Multi-chip sharding tests on the 8-virtual-device CPU mesh.
+
+The contract: sharding changes layout, never decisions — solve_sharded must
+be bit-identical to single-device solve and to the serial oracle.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.quantity import Quantity
+from kubernetes_tpu.models.batch_solver import (
+    decisions_to_names,
+    snapshot_to_inputs,
+    solve,
+)
+from kubernetes_tpu.models.oracle import solve_serial
+from kubernetes_tpu.models.snapshot import encode_snapshot
+from kubernetes_tpu.parallel.mesh import make_mesh, pad_inputs_for_mesh, solve_sharded
+
+
+def _cluster(n_nodes=13, n_pods=24):
+    """Deliberately non-divisible node count: exercises mesh padding."""
+    nodes = [api.Node(metadata=api.ObjectMeta(
+        name=f"n{i}", labels={"zone": f"z{i % 3}"}),
+        spec=api.NodeSpec(capacity={"cpu": Quantity("2"), "memory": Quantity("4Gi")}))
+        for i in range(n_nodes)]
+    svc = api.Service(metadata=api.ObjectMeta(name="web", namespace="default"),
+                      spec=api.ServiceSpec(port=80, selector={"app": "web"}))
+    pending = [api.Pod(
+        metadata=api.ObjectMeta(name=f"p{i}", namespace="default",
+                                uid=f"u{i}", labels={"app": "web"} if i % 2 else {}),
+        spec=api.PodSpec(containers=[api.Container(
+            name="c", image="i",
+            resources=api.ResourceRequirements(limits={
+                "cpu": Quantity("250m"), "memory": Quantity("256Mi")}))]))
+        for i in range(n_pods)]
+    return nodes, [], pending, [svc]
+
+
+def test_mesh_has_eight_devices():
+    assert len(jax.devices()) == 8, (
+        "conftest must provide 8 virtual CPU devices for sharding tests")
+
+
+def test_sharded_solve_bit_identical():
+    nodes, existing, pending, services = _cluster()
+    serial = solve_serial(nodes, existing, pending, services)
+    snap = encode_snapshot(nodes, existing, pending, services)
+
+    single, _ = solve(snap)
+    mesh = make_mesh(pods_axis=1)  # 1x8: all devices shard the node axis
+    sharded, _ = solve_sharded(snapshot_to_inputs(snap), mesh)
+    assert np.array_equal(single, sharded)
+    assert decisions_to_names(snap, sharded) == serial
+
+
+def test_sharded_2d_mesh():
+    nodes, existing, pending, services = _cluster(n_nodes=16, n_pods=16)
+    serial = solve_serial(nodes, existing, pending, services)
+    snap = encode_snapshot(nodes, existing, pending, services)
+    mesh = make_mesh(pods_axis=2)  # 2x4 mesh: dp over pods in the pre-pass
+    sharded, _ = solve_sharded(snapshot_to_inputs(snap), mesh)
+    assert decisions_to_names(snap, sharded) == serial
+
+
+def test_padding_nodes_never_win():
+    nodes, existing, pending, services = _cluster(n_nodes=3, n_pods=40)
+    snap = encode_snapshot(nodes, existing, pending, services)
+    mesh = make_mesh(pods_axis=1)
+    inp, n = pad_inputs_for_mesh(snapshot_to_inputs(snap), mesh)
+    assert inp.cap_cpu.shape[0] == 8 and n == 3
+    chosen, _ = solve_sharded(snapshot_to_inputs(snap), mesh)
+    assert chosen.max() < 3  # padding indices unreachable
+    assert decisions_to_names(snap, chosen) == solve_serial(
+        nodes, existing, pending, services)

@@ -1,0 +1,84 @@
+"""BatchScheduler (tpu-batch profile) driving a live cluster on CPU."""
+
+import time
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.quantity import Quantity
+from kubernetes_tpu.apiserver.master import Master
+from kubernetes_tpu.client.client import Client, InProcessTransport
+from kubernetes_tpu.scheduler.driver import ConfigFactory, PodBackoff
+from kubernetes_tpu.scheduler.tpu_batch import BatchScheduler
+
+
+def mk_node(name, cpu="8", mem="16Gi"):
+    return api.Node(metadata=api.ObjectMeta(name=name),
+                    spec=api.NodeSpec(capacity={"cpu": Quantity(cpu),
+                                                "memory": Quantity(mem)}))
+
+
+def mk_pod(name, app="web"):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace="default",
+                                labels={"app": app}),
+        spec=api.PodSpec(containers=[api.Container(
+            name="c", image="i",
+            resources=api.ResourceRequirements(limits={
+                "cpu": Quantity("500m"), "memory": Quantity("512Mi")}))]))
+
+
+def _wait(pred, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_batch_scheduler_schedules_and_spreads():
+    m = Master()
+    client = Client(InProcessTransport(m))
+    for i in range(4):
+        client.nodes().create(mk_node(f"n{i}"))
+    client.services().create(api.Service(
+        metadata=api.ObjectMeta(name="web", namespace="default"),
+        spec=api.ServiceSpec(port=80, selector={"app": "web"})))
+    factory = ConfigFactory(client, node_poll_period=0.1)
+    config = factory.create()
+    sched = BatchScheduler(config, factory, client, wave_size=64,
+                           wave_linger_s=0.1).run()
+    try:
+        time.sleep(0.3)  # let reflectors sync
+        for i in range(12):
+            client.pods().create(mk_pod(f"w{i}"))
+        assert _wait(lambda: all(p.spec.host for p in client.pods().list().items))
+        placement = {}
+        for p in client.pods().list().items:
+            placement[p.spec.host] = placement.get(p.spec.host, 0) + 1
+        # 12 service pods over 4 nodes: perfect spread
+        assert sorted(placement.values()) == [3, 3, 3, 3], placement
+    finally:
+        sched.stop()
+        factory.stop()
+
+
+def test_batch_scheduler_requeues_unschedulable():
+    m = Master()
+    client = Client(InProcessTransport(m))
+    client.nodes().create(mk_node("tiny", cpu="1", mem="1Gi"))
+    factory = ConfigFactory(client, node_poll_period=0.05)
+    factory.backoff = PodBackoff(initial=0.05, max_duration=0.2)
+    config = factory.create()
+    sched = BatchScheduler(config, factory, client, wave_size=8,
+                           wave_linger_s=0.05).run()
+    try:
+        big = mk_pod("big")
+        big.spec.containers[0].resources.limits["cpu"] = Quantity("4")
+        client.pods().create(big)
+        time.sleep(0.4)
+        assert client.pods().get("big").spec.host == ""
+        client.nodes().create(mk_node("huge", cpu="32", mem="64Gi"))
+        assert _wait(lambda: client.pods().get("big").spec.host == "huge")
+    finally:
+        sched.stop()
+        factory.stop()
