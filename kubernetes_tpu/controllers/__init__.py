@@ -1,0 +1,20 @@
+"""Control loops (ref: pkg/controller/, pkg/service/, pkg/namespace/,
+pkg/resourcequota/, pkg/cloudprovider/controller/).
+
+Every controller is a level-triggered reconciliation loop over the shared
+watchable store, talking only through the typed client — the reference's core
+architectural invariant (DESIGN.md:40).
+"""
+
+from kubernetes_tpu.controllers.replication import ReplicationManager, PodControl
+from kubernetes_tpu.controllers.endpoints import EndpointsController
+from kubernetes_tpu.controllers.node import NodeController
+from kubernetes_tpu.controllers.namespace import NamespaceController
+from kubernetes_tpu.controllers.resourcequota import ResourceQuotaController
+from kubernetes_tpu.controllers.manager import ControllerManager
+
+__all__ = [
+    "ReplicationManager", "PodControl", "EndpointsController",
+    "NodeController", "NamespaceController", "ResourceQuotaController",
+    "ControllerManager",
+]

@@ -1,0 +1,32 @@
+"""Shared periodic-runner helper for the control loops.
+
+Equivalent of the reference's ``util.Forever`` + ``HandleCrash`` idiom: run
+an initial sync immediately (errors swallowed — the loop retries), then tick
+on ``period`` until the stop event fires.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+__all__ = ["run_periodic"]
+
+
+def run_periodic(fn: Callable[[], None], period: float, name: str,
+                 stop: threading.Event) -> threading.Thread:
+    try:
+        fn()
+    except Exception:
+        pass  # crash-only: the first tick retries
+
+    def loop():
+        while not stop.wait(period):
+            try:
+                fn()
+            except Exception:
+                pass
+
+    t = threading.Thread(target=loop, daemon=True, name=name)
+    t.start()
+    return t
