@@ -109,6 +109,34 @@ class Master:
                 raise
 
     # ------------------------------------------------------------------
+    def _self_link(self, resource: str, obj) -> str:
+        """ref: resthandler.go setSelfLink — /api/<v>/namespaces/<ns>/<res>/<name>
+        for namespaced resources, /api/<v>/<res>/<name> cluster-scoped."""
+        m = getattr(obj, "metadata", None)
+        if m is None:
+            return ""
+        version = getattr(self.scheme, "version", "v1")
+        if self.mapper.is_namespaced(resource) and m.namespace:
+            return f"/api/{version}/namespaces/{m.namespace}/{resource}/{m.name}"
+        return f"/api/{version}/{resource}/{m.name}"
+
+    def _stamp_self_links(self, resource: str, obj, namespace: str = ""):
+        if obj is None:
+            return obj
+        items = getattr(obj, "items", None)
+        if items is not None:
+            for item in items:
+                item.metadata.self_link = self._self_link(resource, item)
+            version = getattr(self.scheme, "version", "v1")
+            if self.mapper.is_namespaced(resource) and namespace:
+                obj.metadata.self_link = \
+                    f"/api/{version}/namespaces/{namespace}/{resource}"
+            else:
+                obj.metadata.self_link = f"/api/{version}/{resource}"
+        elif hasattr(obj, "metadata") and isinstance(obj.metadata, api.ObjectMeta):
+            obj.metadata.self_link = self._self_link(resource, obj)
+        return obj
+
     def _registry(self, resource: str):
         resource = self.mapper.resource_for(self.mapper.kind_for(resource)) \
             if self.mapper.has_resource(resource) else resource
@@ -152,11 +180,13 @@ class Master:
 
         if verb == "get":
             self._authorize(user, attrs)
-            return registry.get(ctx, name)
+            return self._stamp_self_links(canonical, registry.get(ctx, name))
         if verb == "list":
             self._authorize(user, attrs)
-            return registry.list(ctx, parse_selector(label_selector),
-                                 parse_field_selector(field_selector))
+            return self._stamp_self_links(
+                canonical, registry.list(ctx, parse_selector(label_selector),
+                                         parse_field_selector(field_selector)),
+                namespace=namespace)
         if verb == "watch":
             self._authorize(user, attrs)
             return registry.watch(ctx, parse_selector(label_selector),
@@ -167,12 +197,12 @@ class Master:
             attrs.name = getattr(getattr(body, "metadata", None), "name", name)
             self._authorize(user, attrs)
             self.admission.admit(attrs)
-            return registry.create(ctx, body)
+            return self._stamp_self_links(canonical, registry.create(ctx, body))
         if verb == "update":
             attrs.operation = admission_pkg.UPDATE
             self._authorize(user, attrs)
             self.admission.admit(attrs)
-            return registry.update(ctx, body)
+            return self._stamp_self_links(canonical, registry.update(ctx, body))
         if verb == "delete":
             attrs.operation = admission_pkg.DELETE
             self._authorize(user, attrs)

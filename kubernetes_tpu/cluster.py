@@ -1,0 +1,171 @@
+"""In-process cluster harness (ref: cmd/integration/integration.go:67-246 +
+cmd/kubernetes/ standalone binary).
+
+Starts, in one process: the master (API + registries + admission), the
+scheduler (serial or TPU batch), the controller manager, and N kubelets
+backed by FakeRuntimes — the reference's flagship integration setup ("two
+kubelets with FakeDockerClients"). This is both the integration-test fixture
+and the standalone demo cluster.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.quantity import Quantity
+from kubernetes_tpu.apiserver.master import Master, MasterConfig
+from kubernetes_tpu.client.client import Client, InProcessTransport
+from kubernetes_tpu.client.record import EventRecorder
+from kubernetes_tpu.controllers.manager import (
+    ControllerManager,
+    ControllerManagerConfig,
+)
+from kubernetes_tpu.kubelet import (
+    ApiserverSource,
+    FakeRuntime,
+    FileSource,
+    Kubelet,
+    PodConfig,
+)
+from kubernetes_tpu.scheduler.driver import ConfigFactory, Scheduler
+
+__all__ = ["ClusterConfig", "Cluster"]
+
+
+@dataclass
+class ClusterConfig:
+    num_nodes: int = 2
+    node_cpu: str = "8"
+    node_memory: str = "16Gi"
+    node_labels: Dict[str, str] = field(default_factory=dict)
+    scheduler_provider: str = "DefaultProvider"
+    algorithm_override: Optional[object] = None     # e.g. the TPU batch adapter
+    rc_sync_period: float = 0.5
+    endpoints_sync_period: float = 0.5
+    node_sync_period: float = 0.5
+    kubelet_resync: float = 0.5
+    node_poll_period: float = 0.5
+    static_pod_dirs: Dict[str, str] = field(default_factory=dict)  # node -> dir
+
+
+class _NodeHandle:
+    def __init__(self, name: str, runtime: FakeRuntime, kubelet: Kubelet,
+                 config: PodConfig, sources: list):
+        self.name = name
+        self.runtime = runtime
+        self.kubelet = kubelet
+        self.config = config
+        self.sources = sources
+        self.healthy = True  # flipped by tests to simulate node death
+
+
+class Cluster:
+    def __init__(self, config: Optional[ClusterConfig] = None):
+        self.config = config or ClusterConfig()
+        c = self.config
+        self.master = Master(MasterConfig())
+        self.client = Client(InProcessTransport(self.master))
+        self.nodes: Dict[str, _NodeHandle] = {}
+
+        static_nodes = [
+            api.Node(metadata=api.ObjectMeta(name=f"node-{i}",
+                                             labels=dict(c.node_labels)),
+                     spec=api.NodeSpec(capacity={
+                         api.ResourceCPU: Quantity(c.node_cpu),
+                         api.ResourceMemory: Quantity(c.node_memory)}))
+            for i in range(c.num_nodes)]
+
+        # kubelets (ref: integration.go:131-246 startKubelet x2)
+        for node in static_nodes:
+            name = node.metadata.name
+            runtime = FakeRuntime(ip_base=f"10.{88 + len(self.nodes)}.0.")
+            recorder = EventRecorder(self.client, api.EventSource(
+                component="kubelet", host=name))
+            kubelet = Kubelet(name, runtime, client=self.client,
+                              recorder=recorder, resync_period=c.kubelet_resync)
+            pod_config = PodConfig()
+            sources = [ApiserverSource(pod_config, self.client, name)]
+            if name in c.static_pod_dirs:
+                sources.append(FileSource(pod_config, c.static_pod_dirs[name],
+                                          name, period=c.kubelet_resync))
+            self.nodes[name] = _NodeHandle(name, runtime, kubelet, pod_config,
+                                           sources)
+
+        # controller manager, with the node prober wired to kubelet health
+        self.controller_manager = ControllerManager(
+            self.client, ControllerManagerConfig(
+                rc_sync_period=c.rc_sync_period,
+                endpoints_sync_period=c.endpoints_sync_period,
+                node_sync_period=c.node_sync_period,
+                static_nodes=static_nodes,
+                node_prober=self._probe_node))
+
+        # scheduler (ref: plugin/cmd/kube-scheduler wiring)
+        self.scheduler_factory = ConfigFactory(
+            self.client, node_poll_period=c.node_poll_period)
+        self._scheduler: Optional[Scheduler] = None
+
+    def _probe_node(self, node: api.Node) -> bool:
+        handle = self.nodes.get(node.metadata.name)
+        return handle.healthy if handle is not None else False
+
+    # ------------------------------------------------------------------
+    def start(self) -> "Cluster":
+        self.controller_manager.run()
+        sched_config = self.scheduler_factory.create(
+            provider=self.config.scheduler_provider,
+            algorithm_override=self.config.algorithm_override,
+            recorder=EventRecorder(self.client, api.EventSource(
+                component=api.DefaultSchedulerName)))
+        self._scheduler = Scheduler(sched_config).run()
+        for handle in self.nodes.values():
+            for src in handle.sources:
+                src.run()
+            handle.kubelet.run(handle.config)
+        return self
+
+    def stop(self) -> None:
+        if self._scheduler is not None:
+            self._scheduler.stop()
+        self.scheduler_factory.stop()
+        self.controller_manager.stop()
+        for handle in self.nodes.values():
+            for src in handle.sources:
+                src.stop()
+            handle.kubelet.stop()
+
+    # ------------------------------------------------------------------
+    # test helpers (ref: integration.go podsOnMinions / waitForPodRunning)
+    # ------------------------------------------------------------------
+    def wait_for(self, predicate, timeout: float = 10.0,
+                 interval: float = 0.05) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                if predicate():
+                    return True
+            except Exception:
+                pass
+            time.sleep(interval)
+        return False
+
+    def wait_pods_running(self, n: int, label_selector: str = "",
+                          timeout: float = 15.0) -> bool:
+        def check():
+            pods = self.client.pods(api.NamespaceAll).list(
+                label_selector=label_selector).items
+            return sum(1 for p in pods
+                       if p.status.phase == api.PodRunning) >= n
+        return self.wait_for(check, timeout)
+
+    def pods_on_node(self, node_name: str) -> List[str]:
+        handle = self.nodes[node_name]
+        names = set()
+        for r in handle.runtime.list_containers():
+            p = r.parsed
+            if p:
+                names.add(p[1])
+        return sorted(names)

@@ -22,18 +22,22 @@ def find_port(pod: api.Pod, service: api.Service) -> Optional[int]:
     """Resolve the container port a service targets on a pod
     (ref: findPort in endpoints_controller.go — ContainerPort 0 means
     "the first declared port")."""
+    def effective(p: api.ContainerPort) -> int:
+        # on host-network pods traffic must target the host port
+        return p.host_port if pod.spec.host_network and p.host_port \
+            else p.container_port
+
     target = service.spec.container_port
     if target:
         for c in pod.spec.containers:
             for p in c.ports:
                 if p.container_port == target:
-                    return p.host_port if pod.spec.host_network and p.host_port \
-                        else p.container_port
+                    return effective(p)
         # unresolvable named/mismatched target: still honor the literal value
         return target
     for c in pod.spec.containers:
         for p in c.ports:
-            return p.container_port
+            return effective(p)
     return None
 
 

@@ -69,9 +69,24 @@ class NodeController:
                 self._check_one(node)
             except errors.StatusError:
                 continue  # node deleted/raced; next tick reconciles
+        # pods bound to a node that no longer exists are orphaned — evict
+        # them immediately so controllers can replace them (ref: the cloud
+        # node-set sync deletes pods of removed nodes, nodecontroller.go:208)
+        live = {n.metadata.name for n in nodes.items}
+        try:
+            bound = self.client.pods(api.NamespaceAll).list(
+                field_selector="spec.host!=")
+            for pod in bound.items:
+                if pod.spec.host not in live:
+                    try:
+                        self.client.pods(pod.metadata.namespace).delete(
+                            pod.metadata.name)
+                    except errors.StatusError:
+                        continue
+        except errors.StatusError:
+            pass
         # forget eviction timers of nodes that no longer exist, so a
         # re-registered node with the same name starts a fresh grace period
-        live = {n.metadata.name for n in nodes.items}
         for name in [n for n in self._not_ready_since if n not in live]:
             del self._not_ready_since[name]
 
@@ -86,6 +101,8 @@ class NodeController:
         desired = {
             api.NodeReady: (status,
                             "kubelet healthy" if healthy else "kubelet unhealthy"),
+            api.NodeReachable: (status,
+                                "node reachable" if healthy else "node unreachable"),
             api.NodeSchedulable: (
                 api.ConditionFalse if node.spec.unschedulable else api.ConditionTrue,
                 "marked unschedulable" if node.spec.unschedulable else "schedulable"),

@@ -15,17 +15,16 @@ __all__ = ["run_periodic"]
 
 def run_periodic(fn: Callable[[], None], period: float, name: str,
                  stop: threading.Event) -> threading.Thread:
-    try:
-        fn()
-    except Exception:
-        pass  # crash-only: the first tick retries
-
     def loop():
-        while not stop.wait(period):
+        # initial sync runs in the loop thread so a slow/hung API call can't
+        # block the caller (ControllerManager.run starts five of these)
+        while True:
             try:
                 fn()
             except Exception:
                 pass
+            if stop.wait(period):
+                return
 
     t = threading.Thread(target=loop, daemon=True, name=name)
     t.start()

@@ -1,0 +1,28 @@
+"""Node agent (ref: pkg/kubelet/).
+
+The kubelet-equivalent: consumes desired pod state from merged config
+sources (file / apiserver watch), reconciles the node's container runtime to
+match via per-pod workers, probes container health, and pushes PodStatus
+back to the API server.
+
+The container runtime sits behind the ``ContainerRuntime`` seam
+(ref: dockertools.DockerInterface); ``FakeRuntime`` is the test double
+(ref: FakeDockerClient) and the integration harness's "node".
+"""
+
+from kubernetes_tpu.kubelet.runtime import (
+    ContainerRecord,
+    ContainerRuntime,
+    FakeRuntime,
+    INFRA_CONTAINER_NAME,
+)
+from kubernetes_tpu.kubelet.kubelet import Kubelet
+from kubernetes_tpu.kubelet.config import PodConfig, ApiserverSource, FileSource
+from kubernetes_tpu.kubelet.pod_workers import PodWorkers
+from kubernetes_tpu.kubelet.status import StatusManager
+
+__all__ = [
+    "ContainerRecord", "ContainerRuntime", "FakeRuntime",
+    "INFRA_CONTAINER_NAME", "Kubelet", "PodConfig", "ApiserverSource",
+    "FileSource", "PodWorkers", "StatusManager",
+]

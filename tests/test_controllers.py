@@ -250,6 +250,20 @@ class TestNodeController:
                  client.nodes().get("n1").status.conditions}
         assert conds[api.NodeSchedulable] == api.ConditionFalse
 
+    def test_deleted_node_pods_evicted(self, client):
+        """Pods bound to a node that no longer exists are orphans: evicted on
+        the next status sync even though the node is never probed again."""
+        ctl = NodeController(client, static_nodes=[make_node("n1")])
+        ctl.register_nodes()
+        client.pods().create(api.Pod(
+            metadata=api.ObjectMeta(name="p1", namespace="default"),
+            spec=api.PodSpec(host="n1",
+                             containers=[api.Container(name="c", image="i")])))
+        client.nodes().delete("n1")
+        ctl.sync_node_status()
+        with pytest.raises(errors.StatusError):
+            client.pods().get("p1")
+
     def test_dead_node_pods_evicted(self, client):
         ctl = NodeController(client, static_nodes=[make_node("n1")],
                              node_prober=lambda n: False,

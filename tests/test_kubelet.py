@@ -1,0 +1,393 @@
+"""Kubelet tests (ref: pkg/kubelet/kubelet_test.go, pod_workers_test.go,
+status_manager_test.go, config/*_test.go, container_gc_test.go,
+image_manager_test.go) — all against FakeRuntime, no real containers.
+"""
+
+import json
+import time
+
+import pytest
+
+from kubernetes_tpu import probe as probe_pkg
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.quantity import Quantity
+from kubernetes_tpu.apiserver.master import Master
+from kubernetes_tpu.client.client import Client, InProcessTransport
+from kubernetes_tpu.kubelet import (
+    ApiserverSource,
+    FakeRuntime,
+    FileSource,
+    Kubelet,
+    PodConfig,
+)
+from kubernetes_tpu.kubelet.gc import (
+    ContainerGC,
+    GCPolicy,
+    ImageGCPolicy,
+    ImageManager,
+)
+from kubernetes_tpu.kubelet.runtime import (
+    INFRA_CONTAINER_NAME,
+    build_container_name,
+    parse_container_name,
+)
+
+
+def make_pod(name="p1", uid=None, containers=None, **spec_kw):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace="default",
+                                uid=uid or f"uid-{name}"),
+        spec=api.PodSpec(containers=containers or [
+            api.Container(name="c1", image="img:1")], **spec_kw))
+
+
+def running_names(runtime, uid):
+    out = set()
+    for r in runtime.list_containers():
+        p = r.parsed
+        if p and p[3] == uid:
+            out.add(p[0])
+    return out
+
+
+class TestNaming:
+    def test_round_trip(self):
+        pod = make_pod()
+        name = build_container_name(pod, "web", 3)
+        assert parse_container_name(name) == ("web", "p1", "default", "uid-p1", 3)
+
+    def test_garbage_rejected(self):
+        assert parse_container_name("random_container") is None
+        assert parse_container_name("k8s_a_b_c_d_notanint") is None
+
+
+class TestSyncPod:
+    def test_creates_infra_then_containers(self):
+        rt = FakeRuntime()
+        kl = Kubelet("n1", rt)
+        pod = make_pod()
+        kl.sync_pods([pod])
+        assert kl.pod_workers.wait_idle()
+        assert running_names(rt, "uid-p1") == {INFRA_CONTAINER_NAME, "c1"}
+        # infra is created before app containers (ref: syncPod order)
+        ops = [op for op, _ in rt.call_log if op.startswith("create")]
+        assert ops[0] == "create_infra"
+
+    def test_sync_is_idempotent(self):
+        rt = FakeRuntime()
+        kl = Kubelet("n1", rt)
+        pod = make_pod()
+        kl.sync_pods([pod])
+        kl.pod_workers.wait_idle()
+        n_before = len(rt.list_containers(include_dead=True))
+        kl.sync_pods([pod])
+        kl.pod_workers.wait_idle()
+        assert len(rt.list_containers(include_dead=True)) == n_before
+
+    def test_restart_policy_always_restarts(self):
+        rt = FakeRuntime()
+        kl = Kubelet("n1", rt)
+        pod = make_pod()
+        kl.sync_pods([pod])
+        kl.pod_workers.wait_idle()
+        assert rt.kill_container_of("uid-p1", "c1", exit_code=1)
+        kl.sync_pods([pod])
+        kl.pod_workers.wait_idle()
+        assert "c1" in running_names(rt, "uid-p1")
+        status = kl.generate_pod_status(pod)
+        cs = next(s for s in status.container_statuses if s.name == "c1")
+        assert cs.restart_count == 1
+        assert cs.last_termination_state.termination.exit_code == 1
+
+    def test_restart_policy_never(self):
+        rt = FakeRuntime()
+        kl = Kubelet("n1", rt)
+        pod = make_pod(restart_policy=api.RestartPolicyNever)
+        kl.sync_pods([pod])
+        kl.pod_workers.wait_idle()
+        rt.kill_container_of("uid-p1", "c1", exit_code=0)
+        kl.sync_pods([pod])
+        kl.pod_workers.wait_idle()
+        assert "c1" not in running_names(rt, "uid-p1")
+        assert kl.generate_pod_status(pod).phase == api.PodSucceeded
+
+    def test_restart_policy_onfailure(self):
+        rt = FakeRuntime()
+        kl = Kubelet("n1", rt)
+        pod = make_pod(restart_policy=api.RestartPolicyOnFailure)
+        kl.sync_pods([pod])
+        kl.pod_workers.wait_idle()
+        rt.kill_container_of("uid-p1", "c1", exit_code=0)  # clean exit
+        kl.sync_pods([pod])
+        kl.pod_workers.wait_idle()
+        assert "c1" not in running_names(rt, "uid-p1")
+        rt2 = FakeRuntime()
+        kl2 = Kubelet("n1", rt2)
+        kl2.sync_pods([pod])
+        kl2.pod_workers.wait_idle()
+        rt2.kill_container_of("uid-p1", "c1", exit_code=2)  # crash
+        kl2.sync_pods([pod])
+        kl2.pod_workers.wait_idle()
+        assert "c1" in running_names(rt2, "uid-p1")
+
+    def test_unwanted_pod_containers_stopped(self):
+        rt = FakeRuntime()
+        kl = Kubelet("n1", rt)
+        pod = make_pod()
+        kl.sync_pods([pod])
+        kl.pod_workers.wait_idle()
+        kl.sync_pods([])  # pod deleted
+        assert running_names(rt, "uid-p1") == set()
+
+    def test_pod_gets_ip_from_infra(self):
+        rt = FakeRuntime()
+        kl = Kubelet("n1", rt)
+        pod = make_pod()
+        kl.sync_pods([pod])
+        kl.pod_workers.wait_idle()
+        status = kl.generate_pod_status(pod)
+        assert status.pod_ip.startswith("10.88.0.")
+        assert status.phase == api.PodRunning
+        assert status.host == "n1"
+
+
+class TestNodeAdmission:
+    def test_host_port_conflict_rejected(self):
+        rt = FakeRuntime()
+        kl = Kubelet("n1", rt)
+        mk = lambda n: api.Pod(
+            metadata=api.ObjectMeta(name=n, namespace="default", uid=f"u-{n}"),
+            spec=api.PodSpec(containers=[api.Container(
+                name="c", image="i",
+                ports=[api.ContainerPort(container_port=80, host_port=80)])]))
+        kl.sync_pods([mk("a"), mk("b")])
+        kl.pod_workers.wait_idle()
+        assert running_names(rt, "u-a") != set()
+        assert running_names(rt, "u-b") == set()
+        st = kl.status_manager.get_pod_status(mk("b"))
+        assert st.phase == api.PodFailed
+
+    def test_capacity_exceeded_rejected(self):
+        master = Master()
+        client = Client(InProcessTransport(master))
+        client.nodes().create(api.Node(
+            metadata=api.ObjectMeta(name="n1"),
+            spec=api.NodeSpec(capacity={"cpu": Quantity("1"),
+                                        "memory": Quantity("1Gi")})))
+        rt = FakeRuntime()
+        kl = Kubelet("n1", rt, client=client)
+        big = api.Pod(
+            metadata=api.ObjectMeta(name="big", namespace="default", uid="u-big"),
+            spec=api.PodSpec(containers=[api.Container(
+                name="c", image="i",
+                resources=api.ResourceRequirements(
+                    limits={"cpu": Quantity("4")}))]))
+        kl.sync_pods([big])
+        kl.pod_workers.wait_idle()
+        assert running_names(rt, "u-big") == set()
+
+
+class TestProbes:
+    def test_exec_liveness_failure_restarts(self):
+        rt = FakeRuntime()
+        kl = Kubelet("n1", rt)
+        pod = make_pod(containers=[api.Container(
+            name="c1", image="img:1",
+            liveness_probe=api.Probe(exec=api.ExecAction(command=["check"])))])
+        kl.sync_pods([pod])
+        kl.pod_workers.wait_idle()
+        rt.exec_results[("c1", ("check",))] = (1, "unhealthy")
+        kl.sync_pods([pod])
+        kl.pod_workers.wait_idle()
+        # old container stopped, new one started (restart count bumped)
+        status = kl.generate_pod_status(pod)
+        cs = status.container_statuses[0]
+        assert cs.restart_count == 1
+        assert cs.state.running is not None
+
+    def test_exec_readiness_gates_ready_condition(self):
+        rt = FakeRuntime()
+        kl = Kubelet("n1", rt)
+        pod = make_pod(containers=[api.Container(
+            name="c1", image="img:1",
+            readiness_probe=api.Probe(exec=api.ExecAction(command=["ready"])))])
+        kl.sync_pods([pod])
+        kl.pod_workers.wait_idle()
+        rt.exec_results[("c1", ("ready",))] = (1, "not ready")
+        st = kl.generate_pod_status(pod)
+        assert st.phase == api.PodRunning
+        assert st.conditions[0].status == api.ConditionFalse
+        rt.exec_results[("c1", ("ready",))] = (0, "")
+        st = kl.generate_pod_status(pod)
+        assert st.conditions[0].status == api.ConditionTrue
+
+    def test_tcp_probe_against_real_socket(self):
+        import socket
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        s.listen(1)
+        port = s.getsockname()[1]
+        assert probe_pkg.probe_tcp("127.0.0.1", port)[0] == probe_pkg.SUCCESS
+        s.close()
+        assert probe_pkg.probe_tcp("127.0.0.1", port)[0] == probe_pkg.FAILURE
+
+
+class TestStatusPush:
+    def test_status_pushed_and_deduped(self):
+        master = Master()
+        client = Client(InProcessTransport(master))
+        rt = FakeRuntime()
+        kl = Kubelet("n1", rt, client=client)
+        pod = client.pods().create(make_pod())
+        kl.sync_pods([pod])
+        kl.pod_workers.wait_idle()
+        got = client.pods().get("p1")
+        assert got.status.phase == api.PodRunning
+        rv = got.metadata.resource_version
+        kl.sync_pods([pod])  # steady state: no second write
+        kl.pod_workers.wait_idle()
+        assert client.pods().get("p1").metadata.resource_version == rv
+
+
+class TestConfigSources:
+    def test_file_source_static_pods(self, tmp_path):
+        manifest = {
+            "kind": "Pod", "apiVersion": "v1",
+            "metadata": {"name": "static-web"},
+            "spec": {"containers": [{"name": "c", "image": "nginx"}]}}
+        (tmp_path / "web.json").write_text(json.dumps(manifest))
+        (tmp_path / "junk.json").write_text("{not json")
+        cfg = PodConfig()
+        src = FileSource(cfg, str(tmp_path), hostname="n1")
+        src.sync()
+        upd = cfg.updates.get(timeout=1)
+        assert len(upd.pods) == 1
+        p = upd.pods[0]
+        assert p.metadata.name == "static-web-n1"
+        assert p.spec.host == "n1"
+        assert p.metadata.annotations["kubernetes.io/config.source"] == "file"
+
+    def test_apiserver_source_sees_bound_pods(self):
+        master = Master()
+        client = Client(InProcessTransport(master))
+        pod = client.pods().create(make_pod("bound"))
+        client.pods().bind(api.Binding(
+            metadata=api.ObjectMeta(name="bound", namespace="default"),
+            pod_name="bound", host="n1"))
+        cfg = PodConfig()
+        src = ApiserverSource(cfg, client, hostname="n1").run()
+        deadline = time.time() + 5
+        names = set()
+        while time.time() < deadline:
+            try:
+                upd = cfg.updates.get(timeout=0.2)
+            except Exception:
+                continue
+            names = {p.metadata.name for p in upd.pods}
+            if "bound" in names:
+                break
+        src.stop()
+        assert "bound" in names
+
+    def test_sources_merge(self):
+        cfg = PodConfig()
+        cfg.merge("file", [make_pod("a", uid="u-a")])
+        cfg.updates.get()
+        cfg.merge("api", [make_pod("b", uid="u-b")])
+        upd = cfg.updates.get()
+        assert {p.metadata.name for p in upd.pods} == {"a", "b"}
+
+    def test_mirror_pod_created_for_static(self):
+        master = Master()
+        client = Client(InProcessTransport(master))
+        rt = FakeRuntime()
+        kl = Kubelet("n1", rt, client=client)
+        static = make_pod("static-web-n1", uid="file-default-static-web-n1")
+        static.metadata.annotations["kubernetes.io/config.source"] = "file"
+        static.spec.host = "n1"
+        kl.sync_pods([static])
+        kl.pod_workers.wait_idle()
+        mirror = client.pods().get("static-web-n1")
+        assert mirror.metadata.annotations.get("kubernetes.io/config.mirror") == "true"
+        assert mirror.spec.host == "n1"
+
+
+class TestGC:
+    def _dead_container(self, rt, pod, cname, attempt):
+        c = api.Container(name=cname, image="img:1")
+        rt.pull_image("img:1")
+        cid = rt.create_container(pod, c, attempt)
+        rt.start_container(cid)
+        rt.stop_container(cid)
+        return cid
+
+    def test_per_pod_cap(self):
+        rt = FakeRuntime()
+        pod = make_pod()
+        for i in range(5):
+            self._dead_container(rt, pod, "c1", i)
+        gc = ContainerGC(rt, GCPolicy(max_per_pod_container=2))
+        removed = gc.collect(live_uids={"uid-p1"})
+        assert removed == 3
+        assert len(rt.list_containers(include_dead=True)) == 2
+
+    def test_dead_pods_fully_reaped(self):
+        rt = FakeRuntime()
+        pod = make_pod()
+        self._dead_container(rt, pod, "c1", 0)
+        gc = ContainerGC(rt, GCPolicy(max_per_pod_container=2))
+        assert gc.collect(live_uids=set()) == 1
+
+    def test_min_age_respected(self):
+        rt = FakeRuntime()
+        pod = make_pod()
+        self._dead_container(rt, pod, "c1", 0)
+        gc = ContainerGC(rt, GCPolicy(min_age=3600, max_per_pod_container=0))
+        assert gc.collect(live_uids={"uid-p1"}) == 0
+
+    def test_image_gc_over_threshold(self):
+        rt = FakeRuntime()
+        rt.pull_image("used:1")
+        rt.pull_image("unused:1")
+        pod = make_pod(containers=[api.Container(name="c", image="used:1")])
+        cid = rt.create_container(pod, pod.spec.containers[0], 0)
+        rt.start_container(cid)
+        usage = {"pct": 95.0}
+        mgr = ImageManager(rt, ImageGCPolicy(), lambda: usage["pct"])
+        # removing one image drops usage below the low threshold
+        def dynamic():
+            return usage["pct"] if len(rt.list_images()) > 1 else 50.0
+        mgr.disk_usage_percent = dynamic
+        removed = mgr.garbage_collect()
+        assert removed == ["unused:1"]
+        assert rt.list_images() == ["used:1"]
+
+    def test_image_gc_under_threshold_noop(self):
+        rt = FakeRuntime()
+        rt.pull_image("unused:1")
+        mgr = ImageManager(rt, ImageGCPolicy(), lambda: 50.0)
+        assert mgr.garbage_collect() == []
+
+
+class TestSyncLoop:
+    def test_run_consumes_updates_and_resyncs(self):
+        rt = FakeRuntime()
+        kl = Kubelet("n1", rt, resync_period=0.1)
+        cfg = PodConfig()
+        kl.run(cfg)
+        cfg.merge("file", [make_pod()])
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if running_names(rt, "uid-p1") == {INFRA_CONTAINER_NAME, "c1"}:
+                break
+            time.sleep(0.02)
+        assert running_names(rt, "uid-p1") == {INFRA_CONTAINER_NAME, "c1"}
+        # resync restarts a died container without a new update
+        rt.kill_container_of("uid-p1", "c1")
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if "c1" in running_names(rt, "uid-p1"):
+                break
+            time.sleep(0.02)
+        kl.stop()
+        assert "c1" in running_names(rt, "uid-p1")
