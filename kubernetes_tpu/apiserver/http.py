@@ -1,0 +1,477 @@
+"""HTTP REST layer over Master.dispatch.
+
+Rebuild of the reference's API serving stack: route installation
+(ref: pkg/apiserver/api_installer.go:194-239), generic REST handlers
+(ref: pkg/apiserver/resthandler.go), watch streaming as chunked JSON frames
+(ref: pkg/apiserver/watch.go:62-142), JSON merge PATCH
+(ref: resthandler.go:205 PatchResource), proxy/redirect
+(ref: pkg/apiserver/{proxy,redirect}.go), request logging
+(ref: pkg/httplog/log.go), Prometheus request metrics
+(ref: pkg/apiserver/apiserver.go:40-87), plus the unversioned endpoints
+/healthz (ref: pkg/healthz), /version (ref: pkg/version), /validate
+(ref: pkg/master/master.go:516-551) and /metrics.
+
+Paths, both namespaced-in-path (v1-style, ref v1beta3) and
+namespace-as-query-param (legacy v1beta1 style):
+
+    /api                                   -> {"versions": [...]}
+    /api/{v}/namespaces/{ns}/{res}[/{name}[/{sub}]]
+    /api/{v}/{res}[/{name}]?namespace=ns
+    /api/{v}/watch/...        or ?watch=true  -> chunked watch stream
+    /api/{v}/proxy/{res}/{name}/{path...}     -> subrequest relay
+    /api/{v}/redirect/{res}/{name}            -> 307 Location
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+from kubernetes_tpu import version as version_pkg
+from kubernetes_tpu import watch as watchpkg
+from kubernetes_tpu.api import errors
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.auth import AuthRequest
+from kubernetes_tpu.util import metrics as metrics_pkg
+
+__all__ = ["APIServer"]
+
+READONLY_VERBS = {"GET"}
+
+
+def _merge_patch(target: Any, patch: Any) -> Any:
+    """RFC 7386 JSON merge patch (ref: resthandler.go:205 PatchResource)."""
+    if not isinstance(patch, dict):
+        return patch
+    if not isinstance(target, dict):
+        target = {}
+    out = dict(target)
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        else:
+            out[k] = _merge_patch(out.get(k), v)
+    return out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "kubernetes-tpu-apiserver"
+
+    # ----- plumbing -------------------------------------------------------
+
+    def log_message(self, fmt, *args):  # ref: pkg/httplog — route to hook
+        log = self.server.api.request_log  # type: ignore[attr-defined]
+        if log is not None:
+            log("%s %s" % (self.address_string(), fmt % args))
+
+    def _send_json(self, code: int, payload: str, extra_headers=()):
+        body = payload.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in extra_headers:
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_text(self, code: int, text: str, ctype="text/plain; charset=utf-8"):
+        body = text.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_status_error(self, e: errors.StatusError, version: str):
+        apisrv = self.server.api  # type: ignore[attr-defined]
+        try:
+            payload = apisrv.scheme.encode(e.status, version)
+        except Exception:
+            payload = json.dumps({"kind": "Status", "status": "Failure",
+                                  "message": str(e), "code": e.code})
+        self._send_json(e.code, payload)
+
+    def _read_body(self) -> bytes:
+        length = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(length) if length else b""
+
+    # ----- verb entry points ---------------------------------------------
+
+    def do_GET(self):
+        self._route("GET")
+
+    def do_POST(self):
+        self._route("POST")
+
+    def do_PUT(self):
+        self._route("PUT")
+
+    def do_PATCH(self):
+        self._route("PATCH")
+
+    def do_DELETE(self):
+        self._route("DELETE")
+
+    # ----- routing --------------------------------------------------------
+
+    def _route(self, method: str):
+        apisrv = self.server.api  # type: ignore[attr-defined]
+        started = time.monotonic()
+        parsed = urllib.parse.urlsplit(self.path)
+        query = {k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()}
+        parts = [p for p in parsed.path.split("/") if p]
+        code = 200
+        verb_label = method.lower()
+        self._metric_resource = (parts + ["", "", ""])[2]
+        try:
+            user = self._authenticate(apisrv)
+            code = self._dispatch_path(method, parts, query, user)
+        except errors.StatusError as e:
+            code = e.code
+            self._send_status_error(e, self._version_of(parts))
+        except (BrokenPipeError, ConnectionResetError):
+            code = 499
+        except Exception as e:  # ref: util.HandleCrash — 500, keep serving
+            code = 500
+            try:
+                self._send_status_error(errors.new_internal_error(repr(e)),
+                                        self._version_of(parts))
+            except Exception:
+                pass
+        finally:
+            apisrv.metric_requests.inc(verb_label, self._metric_resource,
+                                       self.client_address[0], str(code))
+            apisrv.metric_latency.observe(time.monotonic() - started,
+                                          verb_label, self._metric_resource)
+
+    def _version_of(self, parts) -> str:
+        apisrv = self.server.api  # type: ignore[attr-defined]
+        if len(parts) >= 2 and parts[0] == "api" and parts[1] in apisrv.versions:
+            return parts[1]
+        return apisrv.default_version
+
+    def _authenticate(self, apisrv):
+        authn = apisrv.authenticator
+        if authn is None:
+            return None
+        peer_cert = None
+        if hasattr(self.connection, "getpeercert"):
+            try:
+                peer_cert = self.connection.getpeercert()
+            except Exception:
+                peer_cert = None
+        req = AuthRequest(headers=dict(self.headers.items()), peer_cert=peer_cert)
+        info, ok = authn.authenticate(req)
+        if not ok:
+            raise errors.new_unauthorized()
+        return info
+
+    def _dispatch_path(self, method: str, parts, query: Dict[str, str], user) -> int:
+        apisrv = self.server.api  # type: ignore[attr-defined]
+
+        if not parts:
+            self._send_json(200, json.dumps(
+                {"paths": ["/api", "/healthz", "/metrics", "/validate", "/version"]}))
+            return 200
+        head = parts[0]
+        if head == "healthz":
+            return self._handle_healthz(parts[1:])
+        if head == "version":
+            self._send_json(200, json.dumps(version_pkg.get().as_dict()))
+            return 200
+        if head == "metrics":
+            self._send_text(200, apisrv.metrics_registry.render_text(),
+                            ctype="text/plain; version=0.0.4; charset=utf-8")
+            return 200
+        if head == "validate":
+            payload, ok = apisrv.validate_components()
+            self._send_json(200 if ok else 500, json.dumps(payload))
+            return 200 if ok else 500
+        if head != "api":
+            raise errors.new_not_found("path", "/" + "/".join(parts))
+        if len(parts) == 1:
+            self._send_json(200, json.dumps({"versions": list(apisrv.versions)}))
+            return 200
+
+        version = parts[1]
+        if version not in apisrv.versions:
+            raise errors.new_not_found("apiVersion", version)
+        rest = parts[2:]
+
+        watching = query.get("watch") in ("true", "1")
+        if rest and rest[0] == "watch":  # /api/{v}/watch/... prefix form
+            watching = True
+            rest = rest[1:]
+        if rest and rest[0] in ("proxy", "redirect"):
+            return self._handle_proxy_redirect(rest[0], version, rest[1:], query, user)
+
+        # namespace from path (v1-style) or query param (v1beta1-style).
+        # /namespaces/{name}[/finalize] stays the namespaces resource itself;
+        # /namespaces/{ns}/{known-resource}/... scopes the request.
+        namespace = query.get("namespace", "")
+        if rest and rest[0] == "namespaces" and len(rest) >= 3 \
+                and apisrv.is_resource(rest[2]):
+            namespace, rest = rest[1], rest[2:]
+        if not rest:
+            raise errors.new_bad_request("no resource in path")
+        resource = rest[0]
+        self._metric_resource = resource
+        name = rest[1] if len(rest) > 1 else ""
+        subresource = rest[2] if len(rest) > 2 else ""
+
+        label_sel = query.get("labelSelector", query.get("labels", ""))
+        field_sel = query.get("fieldSelector", query.get("fields", ""))
+        rv = query.get("resourceVersion", "")
+
+        if watching:
+            if method != "GET":
+                raise errors.new_bad_request("watch requires GET")
+            watcher = apisrv.master.dispatch(
+                "watch", resource, namespace=namespace,
+                label_selector=label_sel, field_selector=field_sel,
+                resource_version=rv, user=user)
+            self._stream_watch(watcher, version)
+            return 200
+
+        body_obj = None
+        if method in ("POST", "PUT", "PATCH"):
+            raw = self._read_body()
+            if method == "PATCH":
+                return self._handle_patch(version, resource, namespace, name,
+                                          subresource, raw, user)
+            if raw:
+                try:
+                    body_obj = apisrv.scheme.decode(
+                        raw, default_version=version)
+                except Exception as e:
+                    raise errors.new_bad_request(f"cannot decode body: {e}")
+
+        verb = {"GET": "get" if name else "list", "POST": "create",
+                "PUT": "update", "DELETE": "delete"}[method]
+        out = apisrv.master.dispatch(
+            verb, resource, namespace=namespace, name=name, body=body_obj,
+            subresource=subresource, label_selector=label_sel,
+            field_selector=field_sel, user=user)
+        code = 201 if verb == "create" else 200
+        if out is None:
+            ok = api.Status(status=api.StatusSuccess, code=code)
+            self._send_json(code, apisrv.scheme.encode(ok, version))
+        else:
+            self._send_json(code, apisrv.scheme.encode(out, version))
+        return code
+
+    def _handle_patch(self, version, resource, namespace, name, subresource,
+                      raw: bytes, user) -> int:
+        """JSON merge patch: read-modify-write through the codec
+        (ref: resthandler.go PatchResource:205)."""
+        apisrv = self.server.api  # type: ignore[attr-defined]
+        if not name:
+            raise errors.new_bad_request("PATCH requires a resource name")
+        try:
+            patch = json.loads(raw.decode("utf-8"))
+        except Exception as e:
+            raise errors.new_bad_request(f"cannot decode patch: {e}")
+        current = apisrv.master.dispatch("get", resource, namespace=namespace,
+                                         name=name, user=user)
+        wire = json.loads(apisrv.scheme.encode(current, version))
+        merged = _merge_patch(wire, patch)
+        try:
+            obj = apisrv.scheme.decode(json.dumps(merged), default_version=version)
+        except Exception as e:
+            raise errors.new_bad_request(f"patched object invalid: {e}")
+        out = apisrv.master.dispatch("update", resource, namespace=namespace,
+                                     name=name, body=obj,
+                                     subresource=subresource, user=user)
+        self._send_json(200, apisrv.scheme.encode(out, version))
+        return 200
+
+    def _handle_healthz(self, subpath) -> int:
+        if subpath and subpath[0] == "ping":
+            self._send_text(200, "ok")
+            return 200
+        self._send_text(200, "ok")
+        return 200
+
+    # ----- watch streaming (ref: pkg/apiserver/watch.go:62-142) ----------
+
+    def _write_chunk(self, data: bytes):
+        self.wfile.write(f"{len(data):x}\r\n".encode("ascii"))
+        self.wfile.write(data)
+        self.wfile.write(b"\r\n")
+        self.wfile.flush()
+
+    def _stream_watch(self, watcher: watchpkg.Watcher, version: str):
+        apisrv = self.server.api  # type: ignore[attr-defined]
+        apisrv.track_watcher(watcher)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        try:
+            for ev in watcher:
+                try:
+                    obj_wire = json.loads(apisrv.scheme.encode(ev.object, version))
+                except Exception:
+                    obj_wire = {"kind": "Status", "status": "Failure",
+                                "message": "encode error"}
+                frame = json.dumps({"type": ev.type, "object": obj_wire})
+                self._write_chunk(frame.encode("utf-8") + b"\n")
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, socket.timeout):
+            pass
+        finally:
+            watcher.stop()
+            apisrv.untrack_watcher(watcher)
+            self.close_connection = True
+
+    # ----- proxy / redirect (ref: pkg/apiserver/{proxy,redirect}.go) -----
+
+    def _handle_proxy_redirect(self, mode: str, version: str, rest, query, user) -> int:
+        apisrv = self.server.api  # type: ignore[attr-defined]
+        namespace = query.get("namespace", "")
+        if rest and rest[0] == "namespaces" and len(rest) >= 3:
+            namespace, rest = rest[1], rest[2:]
+        if len(rest) < 2:
+            raise errors.new_bad_request(f"{mode} needs /{{resource}}/{{name}}")
+        resource, name, tail = rest[0], rest[1], rest[2:]
+        location = apisrv.resource_location(resource, namespace, name, user)
+        if location is None:
+            raise errors.new_not_found(resource, name)
+        target = f"http://{location}/" + "/".join(tail)
+        if mode == "redirect":
+            self.send_response(307)
+            self.send_header("Location", target)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return 307
+        try:
+            with urllib.request.urlopen(target, timeout=10) as resp:
+                body = resp.read()
+                self.send_response(resp.status)
+                self.send_header("Content-Type",
+                                 resp.headers.get("Content-Type", "text/plain"))
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return resp.status
+        except Exception as e:
+            raise errors.new_internal_error(f"proxy to {target} failed: {e}")
+
+
+class APIServer:
+    """The serving front half of the master (ref: master.go:398-490 route
+    installation + cmd/kube-apiserver). Wraps a Master with HTTP."""
+
+    def __init__(self, master, host: str = "127.0.0.1", port: int = 0,
+                 authenticator=None, request_log=None, ssl_context=None,
+                 metrics_registry: Optional[metrics_pkg.Registry] = None):
+        self.master = master
+        self.scheme = master.scheme
+        self.versions = tuple(master.scheme.versions())
+        self.default_version = master.scheme.default_version
+        self.authenticator = authenticator
+        self.request_log = request_log
+        self.metrics_registry = metrics_registry or metrics_pkg.Registry()
+        # ref: apiserver.go:40-61 request count + latency instrumentation
+        self.metric_requests = self.metrics_registry.counter(
+            "apiserver_request_count", "Counter of apiserver requests",
+            ("verb", "resource", "client", "code"))
+        self.metric_latency = self.metrics_registry.histogram(
+            "apiserver_request_latencies_seconds", "Request latency",
+            ("verb", "resource"), buckets=metrics_pkg.APISERVER_BUCKETS)
+        self._watchers: set = set()
+        self._watch_lock = threading.Lock()
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        if ssl_context is not None:
+            self._httpd.socket = ssl_context.wrap_socket(
+                self._httpd.socket, server_side=True)
+        self._httpd.api = self  # type: ignore[attr-defined]
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle --------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def base_url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "APIServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        kwargs={"poll_interval": 0.05},
+                                        daemon=True, name="apiserver-http")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        with self._watch_lock:
+            watchers = list(self._watchers)
+        for w in watchers:
+            w.stop()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def is_resource(self, name: str) -> bool:
+        try:
+            self.master._registry(name)
+            return True
+        except Exception:
+            return False
+
+    def track_watcher(self, w) -> None:
+        with self._watch_lock:
+            self._watchers.add(w)
+
+    def untrack_watcher(self, w) -> None:
+        with self._watch_lock:
+            self._watchers.discard(w)
+
+    # -- cluster validation (ref: master.go:516-551) ----------------------
+
+    def validate_components(self) -> Tuple[Dict[str, Any], bool]:
+        statuses: Dict[str, Any] = {}
+        ok = True
+        try:
+            self.master.dispatch("list", "namespaces")
+            statuses["store"] = {"healthy": True}
+        except Exception as e:
+            statuses["store"] = {"healthy": False, "error": repr(e)}
+            ok = False
+        return statuses, ok
+
+    # -- resource locations (ref: pod/rest.go, service/rest.go,
+    #    minion ResourceLocation) -----------------------------------------
+
+    def resource_location(self, resource: str, namespace: str, name: str,
+                          user=None) -> Optional[str]:
+        if resource in ("pods", "pod"):
+            pod = self.master.dispatch("get", "pods", namespace=namespace,
+                                       name=name, user=user)
+            ip = getattr(pod.status, "pod_ip", "") or getattr(pod.status, "host", "")
+            return ip or None
+        if resource in ("services", "service"):
+            eps = self.master.dispatch("get", "endpoints", namespace=namespace,
+                                       name=name, user=user)
+            endpoints = list(getattr(eps, "endpoints", []) or [])
+            if not endpoints:
+                return None
+            # ref: service/rest.go ResourceLocation — pick an endpoint
+            return endpoints[hash(name) % len(endpoints)]
+        if resource in ("nodes", "minions", "node"):
+            node = self.master.dispatch("get", "nodes", name=name, user=user)
+            return getattr(node.metadata, "name", None)
+        return None

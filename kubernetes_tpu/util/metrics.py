@@ -1,0 +1,176 @@
+"""Prometheus-style metrics: counters, gauges, histograms + text exposition.
+
+Rebuild of the reference's Prometheus instrumentation seam — apiserver
+request count/latency (ref: pkg/apiserver/apiserver.go:40-87) and kubelet
+operation latencies (ref: pkg/kubelet/metrics/metrics.go:31-84) — without the
+external prometheus client library: a small registry whose ``render_text()``
+emits the Prometheus text exposition format served at ``/metrics``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["Counter", "Gauge", "Histogram", "Registry", "default_registry",
+           "DEFAULT_BUCKETS", "APISERVER_BUCKETS"]
+
+# ref: apiserver.go:60-61 — the expected request-latency envelope, in seconds.
+APISERVER_BUCKETS = (0.125, 0.25, 0.5, 1.0, 2.0, 4.0, 8.0)
+DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+def _fmt_labels(label_names: Sequence[str], label_values: Tuple[str, ...],
+                extra: str = "") -> str:
+    pairs = [f'{k}="{v}"' for k, v in zip(label_names, label_values)]
+    if extra:
+        pairs.append(extra)
+    return "{" + ",".join(pairs) + "}" if pairs else ""
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, label_names: Sequence[str] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(label_names)
+        self._lock = threading.Lock()
+
+
+class Counter(_Metric):
+    typ = "counter"
+
+    def __init__(self, name, help_, label_names=()):
+        super().__init__(name, help_, label_names)
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def inc(self, *label_values: str, by: float = 1.0) -> None:
+        key = tuple(str(v) for v in label_values)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + by
+
+    def value(self, *label_values: str) -> float:
+        return self._values.get(tuple(str(v) for v in label_values), 0.0)
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.typ}"]
+        with self._lock:
+            items = sorted(self._values.items())
+        if not items and not self.label_names:
+            items = [((), 0.0)]
+        for key, v in items:
+            out.append(f"{self.name}{_fmt_labels(self.label_names, key)} {_num(v)}")
+        return out
+
+
+class Gauge(Counter):
+    typ = "gauge"
+
+    def set(self, value: float, *label_values: str) -> None:
+        key = tuple(str(v) for v in label_values)
+        with self._lock:
+            self._values[key] = float(value)
+
+    def dec(self, *label_values: str, by: float = 1.0) -> None:
+        self.inc(*label_values, by=-by)
+
+
+class Histogram(_Metric):
+    typ = "histogram"
+
+    def __init__(self, name, help_, label_names=(), buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help_, label_names)
+        self.buckets = tuple(sorted(buckets))
+        # per label-set: (bucket counts, total count, sum)
+        self._series: Dict[Tuple[str, ...], Tuple[List[int], int, float]] = {}
+
+    def observe(self, value: float, *label_values: str) -> None:
+        key = tuple(str(v) for v in label_values)
+        with self._lock:
+            counts, n, total = self._series.get(
+                key, ([0] * len(self.buckets), 0, 0.0))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            self._series[key] = (counts, n + 1, total + value)
+
+    def count(self, *label_values: str) -> int:
+        s = self._series.get(tuple(str(v) for v in label_values))
+        return s[1] if s else 0
+
+    def quantile(self, q: float, *label_values: str) -> Optional[float]:
+        """Approximate quantile from bucket boundaries (upper bound)."""
+        s = self._series.get(tuple(str(v) for v in label_values))
+        if not s or s[1] == 0:
+            return None
+        counts, n, _ = s
+        rank = q * n
+        for i, b in enumerate(self.buckets):
+            if counts[i] >= rank:
+                return b
+        return float("inf")
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.typ}"]
+        with self._lock:
+            items = sorted((k, (list(c), n, t)) for k, (c, n, t) in self._series.items())
+        for key, (counts, n, total) in items:
+            for b, c in zip(self.buckets, counts):
+                out.append(f"{self.name}_bucket"
+                           f"{_fmt_labels(self.label_names, key, f'le=\"{_num(b)}\"')} {c}")
+            out.append(f"{self.name}_bucket"
+                       f"{_fmt_labels(self.label_names, key, 'le=\"+Inf\"')} {n}")
+            out.append(f"{self.name}_sum{_fmt_labels(self.label_names, key)} {_num(total)}")
+            out.append(f"{self.name}_count{_fmt_labels(self.label_names, key)} {n}")
+        return out
+
+
+def _num(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+class Registry:
+    """Named metric registry; render_text() is the /metrics payload."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def counter(self, name, help_="", label_names=()) -> Counter:
+        return self._get_or_make(name, Counter, help_, label_names)
+
+    def gauge(self, name, help_="", label_names=()) -> Gauge:
+        return self._get_or_make(name, Gauge, help_, label_names)
+
+    def histogram(self, name, help_="", label_names=(),
+                  buckets=DEFAULT_BUCKETS) -> Histogram:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Histogram(name, help_, label_names, buckets)
+                self._metrics[name] = m
+            return m  # type: ignore[return-value]
+
+    def _get_or_make(self, name, cls, help_, label_names):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help_, label_names)
+                self._metrics[name] = m
+            return m
+
+    def render_text(self) -> str:
+        with self._lock:
+            metrics = [self._metrics[k] for k in sorted(self._metrics)]
+        lines: List[str] = []
+        for m in metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+_default = Registry()
+
+
+def default_registry() -> Registry:
+    return _default
