@@ -42,8 +42,6 @@ from kubernetes_tpu.util import metrics as metrics_pkg
 
 __all__ = ["APIServer"]
 
-READONLY_VERBS = {"GET"}
-
 
 def _merge_patch(target: Any, patch: Any) -> Any:
     """RFC 7386 JSON merge patch (ref: resthandler.go:205 PatchResource)."""
@@ -130,9 +128,12 @@ class _Handler(BaseHTTPRequestHandler):
         code = 200
         verb_label = method.lower()
         self._metric_resource = (parts + ["", "", ""])[2]
+        # Always drain the body up front: unread bytes would desync the
+        # keep-alive connection (next request parses them as a request line).
+        raw_body = self._read_body()
         try:
             user = self._authenticate(apisrv)
-            code = self._dispatch_path(method, parts, query, user)
+            code = self._dispatch_path(method, parts, query, user, raw_body)
         except errors.StatusError as e:
             code = e.code
             self._send_status_error(e, self._version_of(parts))
@@ -173,7 +174,8 @@ class _Handler(BaseHTTPRequestHandler):
             raise errors.new_unauthorized()
         return info
 
-    def _dispatch_path(self, method: str, parts, query: Dict[str, str], user) -> int:
+    def _dispatch_path(self, method: str, parts, query: Dict[str, str], user,
+                       raw_body: bytes = b"") -> int:
         apisrv = self.server.api  # type: ignore[attr-defined]
 
         if not parts:
@@ -233,6 +235,8 @@ class _Handler(BaseHTTPRequestHandler):
         if watching:
             if method != "GET":
                 raise errors.new_bad_request("watch requires GET")
+            if name:  # single-object watch scopes by name
+                field_sel = f"metadata.name={name}"
             watcher = apisrv.master.dispatch(
                 "watch", resource, namespace=namespace,
                 label_selector=label_sel, field_selector=field_sel,
@@ -242,14 +246,13 @@ class _Handler(BaseHTTPRequestHandler):
 
         body_obj = None
         if method in ("POST", "PUT", "PATCH"):
-            raw = self._read_body()
             if method == "PATCH":
                 return self._handle_patch(version, resource, namespace, name,
-                                          subresource, raw, user)
-            if raw:
+                                          subresource, raw_body, user)
+            if raw_body:
                 try:
                     body_obj = apisrv.scheme.decode(
-                        raw, default_version=version)
+                        raw_body, default_version=version)
                 except Exception as e:
                     raise errors.new_bad_request(f"cannot decode body: {e}")
 
@@ -346,6 +349,9 @@ class _Handler(BaseHTTPRequestHandler):
         if location is None:
             raise errors.new_not_found(resource, name)
         target = f"http://{location}/" + "/".join(tail)
+        fwd_query = {k: v for k, v in query.items() if k != "namespace"}
+        if fwd_query:  # forward the original query string (ref: proxy.go)
+            target += "?" + urllib.parse.urlencode(fwd_query)
         if mode == "redirect":
             self.send_response(307)
             self.send_header("Location", target)

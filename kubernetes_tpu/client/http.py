@@ -17,7 +17,7 @@ import threading
 import urllib.error
 import urllib.parse
 import urllib.request
-from typing import Any, Dict, Optional
+from typing import Any, Dict, NoReturn, Optional
 
 from kubernetes_tpu import watch as watchpkg
 from kubernetes_tpu.api import errors
@@ -68,6 +68,21 @@ class HTTPTransport:
             url += "?" + urllib.parse.urlencode(q)
         return url
 
+    def _raise_status_error(self, raw: bytes, code: int) -> NoReturn:
+        """Decode an error body into a StatusError (ref: restclient.go
+        transformResponse); fall back to a generic Status on opaque bodies."""
+        try:
+            status = self.scheme.decode(raw, default_version=self.version)
+            if isinstance(status, api.Status):
+                raise errors.from_status(status) from None
+        except errors.StatusError:
+            raise
+        except Exception:
+            pass
+        raise errors.StatusError(api.Status(
+            status=api.StatusFailure, code=code,
+            message=raw.decode("utf-8", "replace"))) from None
+
     def _open(self, url: str, method: str, body: Optional[bytes] = None,
               timeout: Optional[float] = None):
         req = urllib.request.Request(url, data=body, method=method,
@@ -75,18 +90,7 @@ class HTTPTransport:
         try:
             return urllib.request.urlopen(req, timeout=timeout or self.timeout)
         except urllib.error.HTTPError as e:
-            raw = e.read()
-            try:
-                status = self.scheme.decode(raw, default_version=self.version)
-                if isinstance(status, api.Status):
-                    raise errors.from_status(status) from None
-            except errors.StatusError:
-                raise
-            except Exception:
-                pass
-            raise errors.StatusError(api.Status(
-                status=api.StatusFailure, code=e.code,
-                message=raw.decode("utf-8", "replace") or str(e))) from None
+            self._raise_status_error(e.read(), e.code)
 
     # -- the transport seam ------------------------------------------------
 
@@ -127,8 +131,9 @@ class HTTPTransport:
         # watch from another thread must shutdown() the socket to unblock the
         # reader — HTTPResponse.close() would deadlock against it.
         parsed = urllib.parse.urlsplit(url)
-        conn = http.client.HTTPConnection(parsed.hostname, parsed.port,
-                                          timeout=24 * 3600.0)
+        conn_cls = (http.client.HTTPSConnection if parsed.scheme == "https"
+                    else http.client.HTTPConnection)
+        conn = conn_cls(parsed.hostname, parsed.port, timeout=24 * 3600.0)
         path = parsed.path + ("?" + parsed.query if parsed.query else "")
         headers = {k: v for k, v in self._headers.items()
                    if k.lower() != "content-type"}
@@ -137,17 +142,7 @@ class HTTPTransport:
         if resp.status >= 400:
             raw = resp.read()
             conn.close()
-            try:
-                status = self.scheme.decode(raw, default_version=self.version)
-                if isinstance(status, api.Status):
-                    raise errors.from_status(status)
-            except errors.StatusError:
-                raise
-            except Exception:
-                pass
-            raise errors.StatusError(api.Status(
-                status=api.StatusFailure, code=resp.status,
-                message=raw.decode("utf-8", "replace")))
+            self._raise_status_error(raw, resp.status)
         stopped = threading.Event()
 
         def on_stop(_w):

@@ -20,9 +20,14 @@ APISERVER_BUCKETS = (0.125, 0.25, 0.5, 1.0, 2.0, 4.0, 8.0)
 DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
 
+def _escape(v: str) -> str:
+    """Prometheus label-value escaping: backslash, quote, newline."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
 def _fmt_labels(label_names: Sequence[str], label_values: Tuple[str, ...],
                 extra: str = "") -> str:
-    pairs = [f'{k}="{v}"' for k, v in zip(label_names, label_values)]
+    pairs = [f'{k}="{_escape(v)}"' for k, v in zip(label_names, label_values)]
     if extra:
         pairs.append(extra)
     return "{" + ",".join(pairs) + "}" if pairs else ""
@@ -150,6 +155,7 @@ class Registry:
             if m is None:
                 m = Histogram(name, help_, label_names, buckets)
                 self._metrics[name] = m
+            self._check(m, Histogram, label_names)
             return m  # type: ignore[return-value]
 
     def _get_or_make(self, name, cls, help_, label_names):
@@ -158,7 +164,15 @@ class Registry:
             if m is None:
                 m = cls(name, help_, label_names)
                 self._metrics[name] = m
+            self._check(m, cls, label_names)
             return m
+
+    @staticmethod
+    def _check(m, cls, label_names):
+        if type(m) is not cls or m.label_names != tuple(label_names):
+            raise ValueError(
+                f"metric {m.name!r} already registered as {type(m).__name__}"
+                f"{m.label_names}, requested {cls.__name__}{tuple(label_names)}")
 
     def render_text(self) -> str:
         with self._lock:

@@ -97,6 +97,33 @@ class TestCRUD:
             body={"metadata": {"labels": {"b": "2"}}})
         assert out.metadata.labels == {"a": "1", "b": "2"}
 
+    def test_keepalive_survives_delete_with_body(self, server):
+        # unread request bodies must be drained or the next request on the
+        # same keep-alive connection desyncs
+        import http.client
+        conn = http.client.HTTPConnection("127.0.0.1", server.port)
+        conn.request("DELETE", "/api/v1/namespaces/default/pods/nope",
+                     body=b'{"kind":"DeleteOptions"}')
+        r1 = conn.getresponse()
+        r1.read()
+        assert r1.status == 404
+        conn.request("GET", "/api/v1/namespaces/default/pods")
+        r2 = conn.getresponse()
+        assert r2.status == 200  # connection still in sync
+        r2.read()
+        conn.close()
+
+    def test_single_object_watch_scoped_by_name(self, client):
+        client.pods().create(make_pod("target"))
+        w = client.transport.request("watch", "pods", namespace="default",
+                                     name="other")
+        try:
+            client.pods().create(make_pod("other"))
+            ev = w.next_event(timeout=5)
+            assert ev.object.metadata.name == "other"
+        finally:
+            w.stop()
+
     def test_status_error_shape(self, server):
         # raw HTTP: 404 carries an encoded api.Status (ref: resthandler.go)
         url = server.base_url + "/api/v1/namespaces/default/pods/nope"
