@@ -134,6 +134,14 @@ class Client:
     def __init__(self, transport):
         self.transport = transport
 
+    def resource(self, resource: str, namespace: str = "") -> "_ResourceClient":
+        """Generic accessor by resource name — the seam kubectl's
+        Builder/Visitor pipeline uses (ref: pkg/kubectl/resource/helper.go)."""
+        special = {"pods": _PodsClient, "namespaces": _NamespacesClient,
+                   "resourcequotas": _ResourceQuotasClient}
+        cls = special.get(resource, _ResourceClient)
+        return cls(self.transport, resource, namespace)
+
     def pods(self, namespace: str = api.NamespaceDefault) -> _PodsClient:
         return _PodsClient(self.transport, "pods", namespace)
 

@@ -33,11 +33,25 @@ class HTTPTransport:
     Password,BearerToken})."""
 
     def __init__(self, base_url: str, scheme=None, version: str = "",
-                 auth: Optional[tuple] = None, timeout: float = 30.0):
+                 auth: Optional[tuple] = None, timeout: float = 30.0,
+                 ca_cert: str = "", client_cert: str = "", client_key: str = "",
+                 insecure_skip_tls_verify: bool = False):
         self.base_url = base_url.rstrip("/")
         self.scheme = scheme or default_scheme
         self.version = version or self.scheme.default_version
         self.timeout = timeout
+        self.ssl_context = None
+        if base_url.startswith("https") or ca_cert or client_cert \
+                or insecure_skip_tls_verify:
+            import ssl
+            ctx = ssl.create_default_context(
+                cafile=ca_cert or None)
+            if insecure_skip_tls_verify:
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
+            if client_cert:
+                ctx.load_cert_chain(client_cert, client_key or None)
+            self.ssl_context = ctx
         self._headers: Dict[str, str] = {"Content-Type": "application/json"}
         if auth is not None:
             if auth[0] == "basic":
@@ -88,7 +102,8 @@ class HTTPTransport:
         req = urllib.request.Request(url, data=body, method=method,
                                      headers=dict(self._headers))
         try:
-            return urllib.request.urlopen(req, timeout=timeout or self.timeout)
+            return urllib.request.urlopen(req, timeout=timeout or self.timeout,
+                                          context=self.ssl_context)
         except urllib.error.HTTPError as e:
             self._raise_status_error(e.read(), e.code)
 

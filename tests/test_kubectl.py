@@ -1,0 +1,410 @@
+"""kubectl layer tests (model: pkg/kubectl/cmd/*_test.go — commands run
+against a scriptable factory; here against a real in-process master, which
+is strictly stronger)."""
+
+import io
+import json
+import textwrap
+
+import pytest
+import yaml
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.latest import scheme
+from kubernetes_tpu.api.quantity import Quantity
+from kubernetes_tpu.apiserver.master import Master
+from kubernetes_tpu.client.client import Client, InProcessTransport
+from kubernetes_tpu.kubectl.cmd import Factory, run_kubectl
+from kubernetes_tpu.kubectl.printers import (HumanReadablePrinter, JSONPathPrinter,
+                                             JSONPrinter, YAMLPrinter, printer_for)
+from kubernetes_tpu.kubectl.resource import Builder, ResourceError, resolve_resource
+from kubernetes_tpu.kubectl import generators
+
+
+@pytest.fixture()
+def cluster():
+    master = Master()
+    client = Client(InProcessTransport(master))
+    out, err = io.StringIO(), io.StringIO()
+    factory = Factory(client, out=out, err=err)
+    return master, client, factory, out, err
+
+
+def kubectl(factory, *argv, stdin=""):
+    if stdin:
+        factory.stdin = io.StringIO(stdin)
+    return run_kubectl(list(argv), factory)
+
+
+def pod_yaml(name, image="nginx", ns=""):
+    doc = {"kind": "Pod", "apiVersion": "v1",
+           "metadata": {"name": name},
+           "spec": {"containers": [{"name": "c", "image": image}]}}
+    if ns:
+        doc["metadata"]["namespace"] = ns
+    return yaml.safe_dump(doc)
+
+
+# ---------------------------------------------------------------------------
+# resolve + Builder
+# ---------------------------------------------------------------------------
+
+def test_resource_aliases():
+    assert resolve_resource("po") == "pods"
+    assert resolve_resource("rc") == "replicationcontrollers"
+    assert resolve_resource("services") == "services"
+    assert resolve_resource("minions") == "nodes"
+    with pytest.raises(ResourceError):
+        resolve_resource("bogus")
+
+
+def test_builder_parses_multidoc_yaml(tmp_path):
+    f = tmp_path / "objs.yaml"
+    f.write_text(pod_yaml("a") + "---\n" + pod_yaml("b"))
+    infos = Builder(scheme).filename(str(f)).infos()
+    assert [i.name for i in infos] == ["a", "b"]
+    assert all(i.resource == "pods" for i in infos)
+    assert infos[0].namespace == "default"  # defaulted
+
+
+def test_builder_parses_json_and_list_kind(tmp_path):
+    doc = {"kind": "PodList", "apiVersion": "v1",
+           "items": [json.loads(json.dumps(
+               {"kind": "Pod", "metadata": {"name": f"p{i}"},
+                "spec": {"containers": []}})) for i in range(3)]}
+    f = tmp_path / "list.json"
+    f.write_text(json.dumps(doc))
+    infos = Builder(scheme).filename(str(f)).infos()
+    assert [i.name for i in infos] == ["p0", "p1", "p2"]
+
+
+def test_builder_directory_and_missing(tmp_path):
+    (tmp_path / "a.yaml").write_text(pod_yaml("a"))
+    (tmp_path / "b.json").write_text(
+        json.dumps({"kind": "Pod", "metadata": {"name": "b"}, "spec": {}}))
+    infos = Builder(scheme).filename(str(tmp_path)).infos()
+    assert sorted(i.name for i in infos) == ["a", "b"]
+    with pytest.raises(ResourceError):
+        Builder(scheme).filename(str(tmp_path / "nope.yaml")).infos()
+
+
+def test_builder_resource_name_grammar(cluster):
+    _, client, factory, out, _ = cluster
+    client.pods("default").create(api.Pod(
+        metadata=api.ObjectMeta(name="web"),
+        spec=api.PodSpec(containers=[api.Container(name="c", image="i")])))
+    infos = Builder(scheme).resource_type_or_name("pods", "web").infos(client)
+    assert infos[0].name == "web"
+    infos = Builder(scheme).resource_type_or_name("pods/web").infos(client)
+    assert infos[0].name == "web"
+    infos = Builder(scheme).resource_type_or_name("pods").infos(client)
+    assert [i.name for i in infos] == ["web"]
+    with pytest.raises(ResourceError):
+        Builder(scheme).resource_type_or_name("pods", "pods/web").infos(client)
+
+
+# ---------------------------------------------------------------------------
+# printers
+# ---------------------------------------------------------------------------
+
+def _mkpod(name="web", phase="Running"):
+    return api.Pod(metadata=api.ObjectMeta(name=name, namespace="default",
+                                           labels={"app": "web"}),
+                   spec=api.PodSpec(host="node-1", containers=[
+                       api.Container(name="c1", image="img1"),
+                       api.Container(name="c2", image="img2")]),
+                   status=api.PodStatus(phase=phase, pod_ip="10.1.2.3"))
+
+
+def test_human_printer_pod_columns():
+    out = io.StringIO()
+    HumanReadablePrinter().print_obj(_mkpod(), out)
+    lines = out.getvalue().splitlines()
+    # columns ref: resource_printer.go:231
+    assert lines[0].split() == ["POD", "IP", "CONTAINER(S)", "IMAGE(S)",
+                                "HOST", "LABELS", "STATUS", "CREATED"]
+    assert "web" in lines[1] and "10.1.2.3" in lines[1] and "app=web" in lines[1]
+    assert lines[2].strip().startswith("c2")  # extra containers on own row
+
+
+def test_human_printer_list_and_unknown():
+    out = io.StringIO()
+    HumanReadablePrinter().print_obj(
+        api.PodList(items=[_mkpod("a"), _mkpod("b")]), out)
+    body = out.getvalue()
+    assert body.count("POD") == 1 and "a" in body and "b" in body
+    with pytest.raises(ValueError):
+        HumanReadablePrinter().print_obj(object(), io.StringIO())
+
+
+def test_json_yaml_printers_round_trip():
+    pod = _mkpod()
+    out = io.StringIO()
+    JSONPrinter(scheme).print_obj(pod, out)
+    wire = json.loads(out.getvalue())
+    assert wire["metadata"]["name"] == "web"
+    out = io.StringIO()
+    YAMLPrinter(scheme).print_obj(pod, out)
+    assert yaml.safe_load(out.getvalue())["metadata"]["name"] == "web"
+
+
+def test_jsonpath_printer():
+    out = io.StringIO()
+    JSONPathPrinter(scheme, "{.metadata.name} on {.spec.host}").print_obj(
+        _mkpod(), out)
+    assert out.getvalue().strip() == "web on node-1"
+    out = io.StringIO()
+    JSONPathPrinter(scheme, "{.spec.containers[*].image}").print_obj(
+        _mkpod(), out)
+    assert out.getvalue().strip() == "img1 img2"
+
+
+def test_printer_for_validation():
+    with pytest.raises(ValueError):
+        printer_for("template", scheme)
+    with pytest.raises(ValueError):
+        printer_for("bogus", scheme)
+
+
+# ---------------------------------------------------------------------------
+# generators
+# ---------------------------------------------------------------------------
+
+def test_generate_rc_defaults():
+    rc = generators.generate_rc("web", "nginx", replicas=3, port=80)
+    assert rc.spec.selector == {"run": "web"}
+    assert rc.spec.template.metadata.labels == {"run": "web"}
+    assert rc.spec.template.spec.containers[0].ports[0].container_port == 80
+
+
+def test_generate_service_validation():
+    with pytest.raises(ValueError):
+        generators.generate_service("s", {}, 80)
+    with pytest.raises(ValueError):
+        generators.generate_service("s", {"a": "b"}, 0)
+    svc = generators.generate_service("s", {"a": "b"}, 80, container_port=8080)
+    assert svc.spec.container_port == 8080
+
+
+# ---------------------------------------------------------------------------
+# commands end-to-end against an in-process master
+# ---------------------------------------------------------------------------
+
+def test_create_get_delete_cycle(cluster, tmp_path):
+    _, client, factory, out, err = cluster
+    f = tmp_path / "pod.yaml"
+    f.write_text(pod_yaml("web"))
+    assert kubectl(factory, "create", "-f", str(f)) == 0, err.getvalue()
+    assert "web" in out.getvalue()
+
+    out.truncate(0); out.seek(0)
+    assert kubectl(factory, "get", "pods") == 0
+    assert "web" in out.getvalue() and "POD" in out.getvalue()
+
+    out.truncate(0); out.seek(0)
+    assert kubectl(factory, "get", "pods", "web", "-o", "json") == 0
+    assert json.loads(out.getvalue())["metadata"]["name"] == "web"
+
+    assert kubectl(factory, "delete", "pods", "web") == 0
+    assert client.pods("default").list().items == []
+
+
+def test_create_from_stdin(cluster):
+    _, client, factory, out, err = cluster
+    assert kubectl(factory, "create", "-f", "-", stdin=pod_yaml("sin")) == 0, \
+        err.getvalue()
+    assert client.pods("default").get("sin").metadata.name == "sin"
+
+
+def test_get_unknown_resource_fails(cluster):
+    _, _, factory, out, err = cluster
+    assert kubectl(factory, "get", "bogus") == 1
+    assert "unknown resource" in err.getvalue()
+
+
+def test_update_command(cluster, tmp_path):
+    _, client, factory, out, err = cluster
+    f = tmp_path / "pod.yaml"
+    f.write_text(pod_yaml("web"))
+    kubectl(factory, "create", "-f", str(f))
+    pod = client.pods("default").get("web")
+    wire = scheme.encode_to_wire(pod)
+    wire["metadata"]["labels"] = {"tier": "fe"}
+    f.write_text(yaml.safe_dump(wire))
+    assert kubectl(factory, "update", "-f", str(f)) == 0, err.getvalue()
+    assert client.pods("default").get("web").metadata.labels == {"tier": "fe"}
+
+
+def test_label_command(cluster, tmp_path):
+    _, client, factory, out, err = cluster
+    f = tmp_path / "pod.yaml"
+    f.write_text(pod_yaml("web"))
+    kubectl(factory, "create", "-f", str(f))
+    assert kubectl(factory, "label", "pods", "web", "color=red") == 0
+    assert client.pods("default").get("web").metadata.labels["color"] == "red"
+    # conflict without --overwrite (ref: cmd/label.go)
+    assert kubectl(factory, "label", "pods", "web", "color=blue") == 1
+    assert kubectl(factory, "label", "--overwrite", "pods", "web",
+                   "color=blue") == 0
+    assert client.pods("default").get("web").metadata.labels["color"] == "blue"
+    assert kubectl(factory, "label", "pods", "web", "color-") == 0
+    assert "color" not in client.pods("default").get("web").metadata.labels
+
+
+def test_run_and_expose(cluster):
+    _, client, factory, out, err = cluster
+    assert kubectl(factory, "run-container", "web", "--image=nginx",
+                   "--replicas=2", "--port=80") == 0, err.getvalue()
+    rc = client.replication_controllers("default").get("web")
+    assert rc.spec.replicas == 2
+    assert kubectl(factory, "expose", "web", "--port=80") == 0, err.getvalue()
+    svc = client.services("default").get("web")
+    assert svc.spec.selector == {"run": "web"}
+    assert svc.spec.portal_ip  # allocated by the registry
+
+
+def test_resize_and_stop(cluster):
+    _, client, factory, out, err = cluster
+    kubectl(factory, "run-container", "web", "--image=nginx", "--replicas=2")
+    assert kubectl(factory, "resize", "rc", "web", "--replicas=5") == 0
+    assert client.replication_controllers("default").get("web").spec.replicas == 5
+    # stop: resize to 0 then delete; status.replicas==0 must be observed —
+    # update status the way the replication manager would
+    rcs = client.replication_controllers("default")
+
+    import threading
+
+    def settle():
+        import time
+        for _ in range(100):
+            try:
+                rc = rcs.get("web")
+            except Exception:
+                return
+            if rc.status.replicas != rc.spec.replicas:
+                rc.status.replicas = rc.spec.replicas
+                try:
+                    rcs.update(rc)
+                except Exception:
+                    pass
+            time.sleep(0.01)
+
+    t = threading.Thread(target=settle, daemon=True)
+    t.start()
+    assert kubectl(factory, "stop", "rc", "web") == 0, err.getvalue()
+    import pytest as _pytest
+    from kubernetes_tpu.api import errors
+    with _pytest.raises(errors.StatusError):
+        rcs.get("web")
+
+
+def test_describe_pod_and_service(cluster, tmp_path):
+    _, client, factory, out, err = cluster
+    f = tmp_path / "pod.yaml"
+    f.write_text(pod_yaml("web"))
+    kubectl(factory, "create", "-f", str(f))
+    assert kubectl(factory, "describe", "pods", "web") == 0, err.getvalue()
+    assert "Name:\tweb" in out.getvalue()
+
+
+def test_version_and_api_versions(cluster):
+    _, _, factory, out, _ = cluster
+    assert kubectl(factory, "version") == 0
+    assert "Client Version" in out.getvalue()
+    out.truncate(0); out.seek(0)
+    assert kubectl(factory, "api-versions") == 0
+    assert "v1" in out.getvalue()
+
+
+def test_config_commands(cluster, tmp_path, monkeypatch):
+    _, _, factory, out, err = cluster
+    cfg = tmp_path / "kubeconfig"
+    assert kubectl(factory, "config", "set-cluster", "local",
+                   "--server=http://127.0.0.1:8080",
+                   "--kubeconfig", str(cfg)) == 0, err.getvalue()
+    assert kubectl(factory, "config", "set-credentials", "admin",
+                   "--token=sekret", "--kubeconfig", str(cfg)) == 0
+    assert kubectl(factory, "config", "set-context", "dev", "--cluster=local",
+                   "--user=admin", "--kubeconfig", str(cfg)) == 0
+    assert kubectl(factory, "config", "use-context", "dev",
+                   "--kubeconfig", str(cfg)) == 0
+    assert kubectl(factory, "config", "view", "--kubeconfig", str(cfg)) == 0
+    data = yaml.safe_load(out.getvalue())
+    assert data["current-context"] == "dev"
+
+    from kubernetes_tpu.client import clientcmd
+    loaded = clientcmd.load_config(str(cfg), env={})
+    cl, user, ns = loaded.resolve()
+    assert cl.server == "http://127.0.0.1:8080"
+    assert user.token == "sekret"
+    assert ns == "default"
+
+
+def test_kubeconfig_merging(tmp_path):
+    from kubernetes_tpu.client import clientcmd
+    a = tmp_path / "a"
+    b = tmp_path / "b"
+    a.write_text(yaml.safe_dump({
+        "clusters": [{"name": "c1", "cluster": {"server": "http://a"}}],
+        "contexts": [{"name": "x", "context": {"cluster": "c1"}}],
+        "current-context": ""}))
+    b.write_text(yaml.safe_dump({
+        "clusters": [{"name": "c1", "cluster": {"server": "http://b"}},
+                     {"name": "c2", "cluster": {"server": "http://b2"}}],
+        "current-context": "x"}))
+    cfg = clientcmd.load_config(env={"KUBECONFIG": f"{a}{__import__('os').pathsep}{b}"},
+                                home=str(tmp_path))
+    # earlier file wins per key; later fills gaps (ref: loader.go)
+    assert cfg.clusters["c1"].server == "http://a"
+    assert cfg.clusters["c2"].server == "http://b2"
+    assert cfg.current_context == "x"
+
+
+def test_rolling_update(cluster, tmp_path):
+    master, client, factory, out, err = cluster
+    # old RC with 2 replicas
+    kubectl(factory, "run-container", "web", "--image=nginx:1.0",
+            "--replicas=2", "-l", "app=web,version=v1")
+
+    # status settles in the background, standing in for the RC manager
+    import threading
+    import time as _time
+    stop = threading.Event()
+
+    def settle():
+        while not stop.is_set():
+            for name in ("web", "web-v2"):
+                try:
+                    rc = client.replication_controllers("default").get(name)
+                except Exception:
+                    continue
+                if rc.status.replicas != rc.spec.replicas:
+                    rc.status.replicas = rc.spec.replicas
+                    try:
+                        client.replication_controllers("default").update(rc)
+                    except Exception:
+                        pass
+            _time.sleep(0.01)
+
+    t = threading.Thread(target=settle, daemon=True)
+    t.start()
+    try:
+        newrc = {"kind": "ReplicationController", "apiVersion": "v1",
+                 "metadata": {"name": "web-v2"},
+                 "spec": {"replicas": 2,
+                          "selector": {"app": "web", "version": "v2"},
+                          "template": {
+                              "metadata": {"labels": {"app": "web",
+                                                      "version": "v2"}},
+                              "spec": {"containers": [
+                                  {"name": "c", "image": "nginx:2.0"}]}}}}
+        f = tmp_path / "rc.yaml"
+        f.write_text(yaml.safe_dump(newrc))
+        assert kubectl(factory, "rolling-update", "web", "-f", str(f),
+                       "--timeout=10") == 0, err.getvalue()
+    finally:
+        stop.set()
+        t.join(timeout=1)
+    final = client.replication_controllers("default").get("web")
+    assert final.spec.template.spec.containers[0].image == "nginx:2.0"
+    assert final.spec.replicas == 2
