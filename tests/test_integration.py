@@ -40,7 +40,15 @@ def make_rc(name, replicas, labels=None):
 
 class TestReplicationControllerE2E:
     def test_rc_pods_scheduled_and_running(self, cluster):
-        """ref: runReplicationControllerTest — create RC, wait all Running."""
+        """ref: runReplicationControllerTest — create RC, wait all Running.
+
+        A service selecting the pods makes ServiceSpreadingPriority apply;
+        without one the node choice is a pure random tie-break (both nodes
+        score equal) and "pods land on both nodes" would not be guaranteed —
+        all four can legitimately land on one node with probability 1/8."""
+        cluster.client.services().create(api.Service(
+            metadata=api.ObjectMeta(name="web", namespace="default"),
+            spec=api.ServiceSpec(port=80, selector={"app": "web"})))
         cluster.client.replication_controllers().create(make_rc("web", 4))
         assert cluster.wait_pods_running(4, label_selector="app=web")
         pods = cluster.client.pods().list(label_selector="app=web").items
