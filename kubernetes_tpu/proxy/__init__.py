@@ -1,0 +1,9 @@
+"""Service data plane — userspace L4 proxy (ref: pkg/proxy/).
+
+- ``roundrobin`` — LoadBalancerRR endpoint selection with session affinity
+- ``proxier``    — per-service listener sockets relaying to endpoints
+- ``config``     — watch-driven service/endpoints config distribution
+"""
+
+from kubernetes_tpu.proxy.proxier import Proxier  # noqa: F401
+from kubernetes_tpu.proxy.roundrobin import LoadBalancerRR  # noqa: F401

@@ -1,0 +1,342 @@
+"""kube-proxy data plane tests (model: pkg/proxy/proxier_test.go and
+roundrobin_test.go — real sockets against local echo backends)."""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.proxy.proxier import IPTABLES_PROXY_CHAIN, Proxier
+from kubernetes_tpu.proxy.roundrobin import (ErrMissingEndpoints,
+                                             ErrMissingServiceEntry,
+                                             LoadBalancerRR)
+from kubernetes_tpu.util.iptables import FakeIPTables, TableNAT
+
+
+def mk_endpoints(name, eps, ns="default"):
+    return api.Endpoints(
+        metadata=api.ObjectMeta(name=name, namespace=ns),
+        endpoints=[api.Endpoint(ip=ip, port=port) for ip, port in eps])
+
+
+# ---------------------------------------------------------------------------
+# LoadBalancerRR (ref: roundrobin_test.go)
+# ---------------------------------------------------------------------------
+
+class TestLoadBalancerRR:
+    def test_missing_service_and_endpoints(self):
+        lb = LoadBalancerRR()
+        with pytest.raises(ErrMissingServiceEntry):
+            lb.next_endpoint("default/none")
+        lb.new_service("default/none")
+        with pytest.raises(ErrMissingEndpoints):
+            lb.next_endpoint("default/none")
+
+    def test_round_robin_rotation(self):
+        lb = LoadBalancerRR()
+        lb.on_update([mk_endpoints("web", [("10.0.0.1", 80),
+                                           ("10.0.0.2", 80),
+                                           ("10.0.0.3", 80)])])
+        got = [lb.next_endpoint("default/web") for _ in range(6)]
+        assert got[:3] == got[3:]
+        assert sorted(set(got)) == ["10.0.0.1:80", "10.0.0.2:80", "10.0.0.3:80"]
+
+    def test_update_resets_rotation_and_removal_clears(self):
+        lb = LoadBalancerRR()
+        lb.on_update([mk_endpoints("web", [("10.0.0.1", 80)])])
+        assert lb.next_endpoint("default/web") == "10.0.0.1:80"
+        lb.on_update([mk_endpoints("web", [("10.0.0.2", 80)])])
+        assert lb.next_endpoint("default/web") == "10.0.0.2:80"
+        # service absent from full-state update -> endpoints cleared
+        lb.on_update([])
+        with pytest.raises(ErrMissingEndpoints):
+            lb.next_endpoint("default/web")
+
+    def test_session_affinity(self):
+        now = [0.0]
+        lb = LoadBalancerRR(clock=lambda: now[0])
+        lb.new_service("default/web", api.AffinityClientIP, ttl_seconds=10)
+        lb.on_update([mk_endpoints("web", [("10.0.0.1", 80),
+                                           ("10.0.0.2", 80)])])
+        first = lb.next_endpoint("default/web", "1.2.3.4")
+        # same client sticks; different client rotates
+        assert lb.next_endpoint("default/web", "1.2.3.4") == first
+        other = lb.next_endpoint("default/web", "5.6.7.8")
+        assert other != first
+        assert lb.next_endpoint("default/web", "1.2.3.4") == first
+        # TTL expiry purges the affinity entry; the next call re-affinitizes
+        # from the rotation rather than the remembered endpoint
+        now[0] = 100.0
+        lb.clean_up_stale_sessions("default/web")
+        assert "1.2.3.4" not in lb._services["default/web"].affinity_map
+        again = lb.next_endpoint("default/web", "1.2.3.4")
+        assert lb.next_endpoint("default/web", "1.2.3.4") == again  # sticky anew
+
+    def test_affinity_purged_when_endpoint_removed(self):
+        lb = LoadBalancerRR()
+        lb.new_service("default/web", api.AffinityClientIP)
+        lb.on_update([mk_endpoints("web", [("10.0.0.1", 80),
+                                           ("10.0.0.2", 80)])])
+        first = lb.next_endpoint("default/web", "1.2.3.4")
+        survivor = "10.0.0.2:80" if first == "10.0.0.1:80" else "10.0.0.1:80"
+        ip, _, port = survivor.rpartition(":")
+        lb.on_update([mk_endpoints("web", [(ip, int(port))])])
+        assert lb.next_endpoint("default/web", "1.2.3.4") == survivor
+
+
+# ---------------------------------------------------------------------------
+# Proxier with real sockets (ref: proxier_test.go echo servers)
+# ---------------------------------------------------------------------------
+
+def tcp_echo_server(prefix: bytes):
+    """Echo server returning prefix+data; -> (port, closer)."""
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+
+    def run():
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            def handle(c):
+                try:
+                    while True:
+                        data = c.recv(4096)
+                        if not data:
+                            return
+                        c.sendall(prefix + data)
+                finally:
+                    c.close()
+            threading.Thread(target=handle, args=(conn,), daemon=True).start()
+
+    threading.Thread(target=run, daemon=True).start()
+    return srv.getsockname()[1], srv.close
+
+
+def udp_echo_server(prefix: bytes):
+    srv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    srv.bind(("127.0.0.1", 0))
+
+    def run():
+        while True:
+            try:
+                data, addr = srv.recvfrom(4096)
+            except OSError:
+                return
+            srv.sendto(prefix + data, addr)
+
+    threading.Thread(target=run, daemon=True).start()
+    return srv.getsockname()[1], srv.close
+
+
+def mk_service(name, port, protocol=api.ProtocolTCP, portal_ip="10.0.0.10",
+               affinity=api.AffinityNone):
+    return api.Service(
+        metadata=api.ObjectMeta(name=name, namespace="default"),
+        spec=api.ServiceSpec(port=port, protocol=protocol,
+                             portal_ip=portal_ip, selector={"app": name},
+                             session_affinity=affinity))
+
+
+def tcp_call(port, payload=b"hi", timeout=5.0):
+    with socket.create_connection(("127.0.0.1", port), timeout=timeout) as s:
+        s.sendall(payload)
+        return s.recv(4096)
+
+
+@pytest.fixture()
+def proxier():
+    p = Proxier(iptables=FakeIPTables())
+    yield p
+    p.stop()
+
+
+class TestProxier:
+    def test_tcp_proxy_round_robin(self, proxier):
+        p1, c1 = tcp_echo_server(b"a:")
+        p2, c2 = tcp_echo_server(b"b:")
+        try:
+            proxier.lb.on_update([mk_endpoints("web", [("127.0.0.1", p1),
+                                                       ("127.0.0.1", p2)])])
+            proxier.on_update([mk_service("web", 80)])
+            port = proxier.proxy_port_of("default", "web")
+            assert port
+            got = {tcp_call(port) for _ in range(4)}
+            assert got == {b"a:hi", b"b:hi"}
+        finally:
+            c1(); c2()
+
+    def test_tcp_retry_skips_dead_endpoint(self, proxier):
+        p1, c1 = tcp_echo_server(b"live:")
+        # reserve a dead port
+        dead = socket.socket()
+        dead.bind(("127.0.0.1", 0))
+        dead_port = dead.getsockname()[1]
+        dead.close()
+        try:
+            proxier.lb.on_update([mk_endpoints("web",
+                                               [("127.0.0.1", dead_port),
+                                                ("127.0.0.1", p1)])])
+            proxier.on_update([mk_service("web", 80)])
+            port = proxier.proxy_port_of("default", "web")
+            assert tcp_call(port) == b"live:hi"
+        finally:
+            c1()
+
+    def test_udp_proxy(self, proxier):
+        p1, c1 = udp_echo_server(b"u:")
+        try:
+            proxier.lb.on_update([mk_endpoints("dns", [("127.0.0.1", p1)])])
+            proxier.on_update([mk_service("dns", 53, protocol=api.ProtocolUDP)])
+            port = proxier.proxy_port_of("default", "dns")
+            cli = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            cli.settimeout(5.0)
+            cli.sendto(b"ping", ("127.0.0.1", port))
+            data, _ = cli.recvfrom(4096)
+            assert data == b"u:ping"
+            cli.close()
+        finally:
+            c1()
+
+    def test_service_removal_closes_proxy(self, proxier):
+        p1, c1 = tcp_echo_server(b"x:")
+        try:
+            proxier.lb.on_update([mk_endpoints("web", [("127.0.0.1", p1)])])
+            proxier.on_update([mk_service("web", 80)])
+            port = proxier.proxy_port_of("default", "web")
+            assert tcp_call(port) == b"x:hi"
+            proxier.on_update([])  # full state without the service
+            assert proxier.proxy_port_of("default", "web") is None
+            with pytest.raises(OSError):
+                tcp_call(port, timeout=0.5)
+        finally:
+            c1()
+
+    def test_portal_rules_installed_and_removed(self, proxier):
+        ipt = proxier.iptables
+        proxier.on_update([mk_service("web", 80)])
+        rules = ipt.rules(TableNAT, IPTABLES_PROXY_CHAIN)
+        assert len(rules) == 1
+        rule = rules[0]
+        assert "-d" in rule and "10.0.0.10/32" in rule
+        assert "--dport" in rule and "80" in rule
+        assert "REDIRECT" in rule
+        proxier.on_update([])
+        assert ipt.rules(TableNAT, IPTABLES_PROXY_CHAIN) == []
+
+    def test_portal_change_restarts_proxy(self, proxier):
+        p1, c1 = tcp_echo_server(b"x:")
+        try:
+            proxier.lb.on_update([mk_endpoints("web", [("127.0.0.1", p1)])])
+            proxier.on_update([mk_service("web", 80)])
+            old_port = proxier.proxy_port_of("default", "web")
+            svc = mk_service("web", 81)  # portal port changed
+            proxier.on_update([svc])
+            new_port = proxier.proxy_port_of("default", "web")
+            assert tcp_call(new_port) == b"x:hi"
+            rules = proxier.iptables.rules(TableNAT, IPTABLES_PROXY_CHAIN)
+            assert any("81" in r for r in rules)
+            assert not any(("--dport", "80") ==
+                           (r[r.index("--dport")], r[r.index("--dport") + 1])
+                           for r in rules if "--dport" in r)
+        finally:
+            c1()
+
+    def test_dead_affinitized_endpoint_does_not_pin_client(self, proxier):
+        """Retry resets the affinity entry so a client stuck to a dead
+        endpoint fails over (ref: proxier.go sessionAffinityReset)."""
+        p1, c1 = tcp_echo_server(b"live:")
+        dead = socket.socket()
+        dead.bind(("127.0.0.1", 0))
+        dead_port = dead.getsockname()[1]
+        dead.close()
+        try:
+            proxier.lb.new_service("default/web", api.AffinityClientIP)
+            proxier.lb.on_update([mk_endpoints("web",
+                                               [("127.0.0.1", dead_port),
+                                                ("127.0.0.1", p1)])])
+            # pin this client to the dead endpoint
+            assert proxier.lb.next_endpoint("default/web", "127.0.0.1") == \
+                f"127.0.0.1:{dead_port}"
+            proxier.on_update([mk_service("web", 80,
+                                          affinity=api.AffinityClientIP)])
+            port = proxier.proxy_port_of("default", "web")
+            assert tcp_call(port) == b"live:hi"
+        finally:
+            c1()
+
+    def test_affinity_change_updates_balancer_without_restart(self, proxier):
+        p1, c1 = tcp_echo_server(b"a:")
+        try:
+            proxier.lb.on_update([mk_endpoints("web", [("127.0.0.1", p1)])])
+            proxier.on_update([mk_service("web", 80)])
+            port = proxier.proxy_port_of("default", "web")
+            proxier.on_update([mk_service("web", 80,
+                                          affinity=api.AffinityClientIP)])
+            # no socket restart...
+            assert proxier.proxy_port_of("default", "web") == port
+            # ...but the balancer saw the new affinity type
+            assert proxier.lb._services["default/web"].affinity_type == \
+                api.AffinityClientIP
+        finally:
+            c1()
+
+    def test_session_affinity_through_proxy(self, proxier):
+        p1, c1 = tcp_echo_server(b"a:")
+        p2, c2 = tcp_echo_server(b"b:")
+        try:
+            proxier.lb.on_update([mk_endpoints("web", [("127.0.0.1", p1),
+                                                       ("127.0.0.1", p2)])])
+            proxier.on_update([mk_service("web", 80,
+                                          affinity=api.AffinityClientIP)])
+            port = proxier.proxy_port_of("default", "web")
+            got = {tcp_call(port) for _ in range(4)}
+            assert len(got) == 1  # all connections from 127.0.0.1 stick
+        finally:
+            c1(); c2()
+
+
+class TestProxyConfig:
+    def test_watch_driven_updates(self):
+        """Service/endpoints watches drive the proxier end-to-end
+        (ref: pkg/proxy/config/config_test.go)."""
+        from kubernetes_tpu.apiserver.master import Master
+        from kubernetes_tpu.client.client import Client, InProcessTransport
+        from kubernetes_tpu.proxy.config import EndpointsConfig, ServiceConfig
+
+        master = Master()
+        client = Client(InProcessTransport(master))
+        proxier = Proxier(iptables=FakeIPTables())
+        svc_cfg = ServiceConfig(client, [proxier.on_update]).run()
+        ep_cfg = EndpointsConfig(client, [proxier.lb.on_update]).run()
+        p1, c1 = tcp_echo_server(b"w:")
+        try:
+            client.services("default").create(mk_service("web", 80))
+            client.endpoints("default").create(
+                mk_endpoints("web", [("127.0.0.1", p1)]))
+            deadline = time.monotonic() + 5
+            port = None
+            while time.monotonic() < deadline:
+                port = proxier.proxy_port_of("default", "web")
+                if port and proxier.lb.endpoints_of("default/web"):
+                    break
+                time.sleep(0.05)
+            assert port, "proxier never saw the service"
+            assert tcp_call(port) == b"w:hi"
+            client.services("default").delete("web")
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if proxier.proxy_port_of("default", "web") is None:
+                    break
+                time.sleep(0.05)
+            assert proxier.proxy_port_of("default", "web") is None
+        finally:
+            c1()
+            svc_cfg.stop()
+            ep_cfg.stop()
+            proxier.stop()
