@@ -49,7 +49,8 @@ class Kubelet:
     def __init__(self, hostname: str, runtime: ContainerRuntime,
                  client=None, recorder=None,
                  resync_period: float = 2.0,
-                 gc_policy: Optional[GCPolicy] = None):
+                 gc_policy: Optional[GCPolicy] = None,
+                 volume_mgr=None):
         self.hostname = hostname
         self.runtime = runtime
         self.client = client
@@ -58,6 +59,9 @@ class Kubelet:
         self.status_manager = StatusManager(client)
         self.pod_workers = PodWorkers(self.sync_pod)
         self.container_gc = ContainerGC(runtime, gc_policy or GCPolicy())
+        # volume plugin manager (ref: kubelet.go volumePluginMgr); None
+        # means this kubelet runs without a volumes dir (pure-fake tests)
+        self.volume_mgr = volume_mgr
         self._stop = threading.Event()
         self._lock = threading.Lock()
         self._desired: Dict[str, api.Pod] = {}   # uid -> pod
@@ -153,6 +157,13 @@ class Kubelet:
                     pass
         self.pod_workers.forget_non_existing(set(desired))
         self.container_gc.collect(live_uids=set(desired))
+        # tear down volumes of departed pods (ref: cleanupOrphanedVolumes
+        # :1523-1556)
+        if self.volume_mgr is not None:
+            try:
+                self.volume_mgr.cleanup_orphaned_volumes(list(desired))
+            except Exception:
+                pass  # crash-only: volume gc must not break the sync loop
 
     # ------------------------------------------------------------------
     # mirror pods for static (file-source) pods (ref: pod_manager.go,
@@ -206,6 +217,16 @@ class Kubelet:
             self.runtime.start_container(cid)
             infra = self.runtime.inspect_container(cid)
             records = self._pod_records(uid)
+
+        # 1.5 mount external volumes before any container starts
+        # (ref: kubelet.go mountExternalVolumes call in syncPod :1440)
+        if self.volume_mgr is not None and pod.spec.volumes:
+            try:
+                self.volume_mgr.mount_volumes(pod)
+            except Exception as e:
+                self._reject(pod, "FailedMount",
+                             f"Unable to mount volumes for pod: {e}")
+                return
 
         # 2. per-container reconcile (ref: computePodContainerChanges:1252)
         for container in pod.spec.containers:

@@ -1,0 +1,185 @@
+"""Volume plugin tests (model: pkg/volume/*/..._test.go — each plugin's
+CanSupport + SetUp/TearDown against a temp rootdir, fakes for
+mount/attach)."""
+
+import base64
+import os
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.volume.plugins import (FakeDiskManager, FakeMounter,
+                                           VolumeHost, VolumePluginMgr,
+                                           escape_plugin_name,
+                                           new_default_plugin_mgr)
+
+
+def mkpod(uid="uid-1", volumes=()):
+    return api.Pod(metadata=api.ObjectMeta(name="p", namespace="default",
+                                           uid=uid),
+                   spec=api.PodSpec(volumes=list(volumes)))
+
+
+def vol(name, **src):
+    return api.Volume(name=name, source=api.VolumeSource(**src))
+
+
+@pytest.fixture()
+def mgr(tmp_path):
+    return new_default_plugin_mgr(str(tmp_path), mounter=FakeMounter(),
+                                  disk_manager=FakeDiskManager(),
+                                  git_exec=lambda args, cwd: None)
+
+
+def test_escape_plugin_name():
+    assert escape_plugin_name("kubernetes.io/empty-dir") == \
+        "kubernetes.io~empty-dir"
+
+
+def test_find_plugin_dispatch(mgr):
+    cases = [
+        (vol("a", empty_dir=api.EmptyDirVolumeSource()), "kubernetes.io/empty-dir"),
+        (vol("b", host_path=api.HostPathVolumeSource(path="/x")), "kubernetes.io/host-path"),
+        (vol("c", git_repo=api.GitRepoVolumeSource(repository="r")), "kubernetes.io/git-repo"),
+        (vol("d", secret=api.SecretVolumeSource(secret_name="s")), "kubernetes.io/secret"),
+        (vol("e", nfs=api.NFSVolumeSource(server="h", path="/p")), "kubernetes.io/nfs"),
+        (vol("f", gce_persistent_disk=api.GCEPersistentDiskVolumeSource(pd_name="pd")),
+         "kubernetes.io/gce-pd"),
+    ]
+    for v, expected in cases:
+        assert mgr.find_plugin(v).name == expected
+    with pytest.raises(ValueError):
+        mgr.find_plugin(vol("none"))
+
+
+def test_empty_dir_setup_teardown(mgr, tmp_path):
+    pod = mkpod(volumes=[vol("scratch", empty_dir=api.EmptyDirVolumeSource())])
+    builders = mgr.mount_volumes(pod)
+    path = builders["scratch"].get_path()
+    assert os.path.isdir(path)
+    assert "kubernetes.io~empty-dir" in path and "uid-1" in path
+    plugin = mgr.find_plugin_by_name("kubernetes.io/empty-dir")
+    plugin.new_cleaner("scratch", "uid-1").tear_down()
+    assert not os.path.exists(path)
+
+
+def test_host_path_passthrough(mgr, tmp_path):
+    target = tmp_path / "hostdata"
+    target.mkdir()
+    pod = mkpod(volumes=[vol("h", host_path=api.HostPathVolumeSource(
+        path=str(target)))])
+    builders = mgr.mount_volumes(pod)
+    assert builders["h"].get_path() == str(target)
+    # teardown never deletes host dirs
+    mgr.find_plugin_by_name("kubernetes.io/host-path") \
+       .new_cleaner("h", "uid-1").tear_down()
+    assert target.exists()
+
+
+def test_git_repo_clone_commands(tmp_path):
+    calls = []
+    mgr = new_default_plugin_mgr(str(tmp_path),
+                                 git_exec=lambda args, cwd: calls.append((args, cwd)))
+    pod = mkpod(volumes=[vol("src", git_repo=api.GitRepoVolumeSource(
+        repository="https://example.com/repo.git", revision="abc123"))])
+    builders = mgr.mount_volumes(pod)
+    assert calls[0][0] == ["git", "clone", "https://example.com/repo.git", "."]
+    assert calls[1][0] == ["git", "checkout", "abc123"]
+    assert calls[0][1] == builders["src"].get_path()
+    # idempotent resync: non-empty dir -> no second clone
+    (tmp_path / "marker").touch()
+    open(os.path.join(builders["src"].get_path(), "f"), "w").close()
+    mgr.mount_volumes(pod)
+    assert len(calls) == 2
+
+
+def test_secret_volume_writes_decoded_files(tmp_path):
+    class FakeSecrets:
+        def __init__(self, secret):
+            self._s = secret
+        def secrets(self, ns):
+            outer = self
+            class _S:
+                def get(self, name):
+                    return outer._s
+            return _S()
+
+    secret = api.Secret(metadata=api.ObjectMeta(name="creds"),
+                        data={"user": base64.b64encode(b"admin").decode(),
+                              "plain": "not-base64!!"})
+    mgr = new_default_plugin_mgr(str(tmp_path),
+                                 kubelet_client=FakeSecrets(secret))
+    pod = mkpod(volumes=[vol("creds", secret=api.SecretVolumeSource(
+        secret_name="creds"))])
+    builders = mgr.mount_volumes(pod)
+    path = builders["creds"].get_path()
+    assert open(os.path.join(path, "user"), "rb").read() == b"admin"
+    assert open(os.path.join(path, "plain"), "rb").read() == b"not-base64!!"
+
+
+def test_nfs_mounts_and_unmounts(tmp_path):
+    mounter = FakeMounter()
+    mgr = new_default_plugin_mgr(str(tmp_path), mounter=mounter)
+    pod = mkpod(volumes=[vol("data", nfs=api.NFSVolumeSource(
+        server="fileserver", path="/exports", read_only=True))])
+    builders = mgr.mount_volumes(pod)
+    path = builders["data"].get_path()
+    assert mounter.mounts[path] == ("fileserver:/exports", "nfs", ("ro",))
+    mgr.find_plugin_by_name("kubernetes.io/nfs") \
+       .new_cleaner("data", "uid-1").tear_down()
+    assert path not in mounter.mounts
+
+
+def test_gce_pd_attach_then_mount(tmp_path):
+    disks = FakeDiskManager()
+    mounter = FakeMounter()
+    mgr = new_default_plugin_mgr(str(tmp_path), disk_manager=disks,
+                                 mounter=mounter)
+    pod = mkpod(volumes=[vol("pd", gce_persistent_disk=
+        api.GCEPersistentDiskVolumeSource(pd_name="disk-1", fs_type="ext4"))])
+    builders = mgr.mount_volumes(pod)
+    assert "disk-1" in disks.attached
+    path = builders["pd"].get_path()
+    src, fstype, _ = mounter.mounts[path]
+    assert src.endswith("google-disk-1") and fstype == "ext4"
+    # attach happens before mount (ref: gce_pd.go SetUp ordering)
+    assert disks.log[0][0] == "attach"
+    assert mounter.log[0][0] == "mount"
+
+
+def test_cleanup_orphaned_volumes(mgr, tmp_path):
+    active = mkpod(uid="live", volumes=[vol("a", empty_dir=api.EmptyDirVolumeSource())])
+    gone = mkpod(uid="dead", volumes=[vol("b", empty_dir=api.EmptyDirVolumeSource())])
+    mgr.mount_volumes(active)
+    mgr.mount_volumes(gone)
+    removed = mgr.cleanup_orphaned_volumes(["live"])
+    assert removed == 1
+    assert not (tmp_path / "pods" / "dead").exists()
+    assert (tmp_path / "pods" / "live").exists()
+
+
+def test_kubelet_mounts_volumes_during_sync(tmp_path):
+    """Kubelet integration: syncPod mounts, sync_pods GCs orphans
+    (ref: kubelet.go syncPod :1440 + cleanupOrphanedVolumes)."""
+    from kubernetes_tpu.kubelet.kubelet import Kubelet
+    from kubernetes_tpu.kubelet.runtime import FakeRuntime
+
+    mgr = new_default_plugin_mgr(str(tmp_path))
+    kubelet = Kubelet("node-1", FakeRuntime(), volume_mgr=mgr)
+    pod = api.Pod(
+        metadata=api.ObjectMeta(name="p", namespace="default", uid="u-1"),
+        spec=api.PodSpec(
+            volumes=[vol("scratch", empty_dir=api.EmptyDirVolumeSource())],
+            containers=[api.Container(name="c", image="img")]))
+    kubelet.sync_pods([pod])
+    import time
+    deadline = time.monotonic() + 5
+    vol_path = tmp_path / "pods" / "u-1" / "volumes" / \
+        "kubernetes.io~empty-dir" / "scratch"
+    while time.monotonic() < deadline and not vol_path.is_dir():
+        time.sleep(0.02)
+    assert vol_path.is_dir()
+    # pod removed -> volume GC'd on next sync
+    kubelet.sync_pods([])
+    assert not (tmp_path / "pods" / "u-1").exists()
+    kubelet.stop()
