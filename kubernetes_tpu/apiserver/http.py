@@ -378,8 +378,11 @@ class APIServer:
 
     def __init__(self, master, host: str = "127.0.0.1", port: int = 0,
                  authenticator=None, request_log=None, ssl_context=None,
-                 metrics_registry: Optional[metrics_pkg.Registry] = None):
+                 metrics_registry: Optional[metrics_pkg.Registry] = None,
+                 node_locator=None, kubelet_port: int = 10250):
         self.master = master
+        self.node_locator = node_locator
+        self.kubelet_port = kubelet_port
         self.scheme = master.scheme
         self.versions = tuple(master.scheme.versions())
         self.default_version = master.scheme.default_version
@@ -476,8 +479,18 @@ class APIServer:
             if not endpoints:
                 return None
             # ref: service/rest.go ResourceLocation — pick an endpoint
-            return endpoints[hash(name) % len(endpoints)]
+            ep = endpoints[hash(name) % len(endpoints)]
+            return f"{ep.ip}:{ep.port}"
         if resource in ("nodes", "minions", "node"):
             node = self.master.dispatch("get", "nodes", name=name, user=user)
-            return getattr(node.metadata, "name", None)
+            if node is None:
+                return None
+            if self.node_locator is not None:
+                # harness/deployment hook: node name -> "host:port" of its
+                # kubelet server (ref: minion registry ResourceLocation via
+                # client.ConnectionInfoGetter)
+                return self.node_locator(name)
+            addrs = getattr(node.status, "addresses", []) or []
+            host = addrs[0].address if addrs else node.metadata.name
+            return f"{host}:{self.kubelet_port}"
         return None

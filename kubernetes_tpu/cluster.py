@@ -49,6 +49,7 @@ class ClusterConfig:
     kubelet_resync: float = 0.5
     node_poll_period: float = 0.5
     static_pod_dirs: Dict[str, str] = field(default_factory=dict)  # node -> dir
+    kubelet_http: bool = False      # start a KubeletServer per node
 
 
 class _NodeHandle:
@@ -60,6 +61,7 @@ class _NodeHandle:
         self.config = config
         self.sources = sources
         self.healthy = True  # flipped by tests to simulate node death
+        self.server = None   # KubeletServer when ClusterConfig.kubelet_http
 
 
 class Cluster:
@@ -125,7 +127,45 @@ class Cluster:
             for src in handle.sources:
                 src.run()
             handle.kubelet.run(handle.config)
+            if self.config.kubelet_http:
+                from kubernetes_tpu.kubelet.server import KubeletServer
+                handle.server = KubeletServer(handle.kubelet).start()
         return self
+
+    def node_locator(self, name: str):
+        """node name -> kubelet server "host:port" — plug into
+        APIServer(node_locator=...) so /proxy/nodes/<n>/... resolves."""
+        handle = self.nodes.get(name)
+        if handle is None or handle.server is None:
+            return None
+        return f"127.0.0.1:{handle.server.port}"
+
+    def pod_logs(self, namespace: str, name: str, container: str = "") -> str:
+        """Fetch container logs from the owning node's kubelet server, the
+        path kubectl log takes (ref: kubectl/cmd/log.go via
+        /proxy/minions/<host>/containerLogs/...)."""
+        import urllib.request
+
+        pod = self.client.pods(namespace).get(name)
+        host = pod.spec.host or pod.status.host
+        if not host or host not in self.nodes:
+            raise RuntimeError(f"pod {namespace}/{name} is not bound")
+        handle = self.nodes[host]
+        container = container or pod.spec.containers[0].name
+        if handle.server is None:
+            raise RuntimeError("kubelet HTTP servers not enabled "
+                               "(ClusterConfig.kubelet_http)")
+        url = (f"http://127.0.0.1:{handle.server.port}"
+               f"/containerLogs/{namespace}/{name}/{container}")
+        with urllib.request.urlopen(url, timeout=5) as r:
+            return r.read().decode()
+
+    def kubectl_factory(self, out=None, err=None):
+        """A kubectl Factory bound to this cluster (in-process client +
+        kubelet log source)."""
+        from kubernetes_tpu.kubectl.cmd import Factory
+        return Factory(self.client, out=out, err=err,
+                       pod_logs=self.pod_logs)
 
     def stop(self) -> None:
         if self._scheduler is not None:
@@ -136,6 +176,8 @@ class Cluster:
             for src in handle.sources:
                 src.stop()
             handle.kubelet.stop()
+            if handle.server is not None:
+                handle.server.stop()
 
     # ------------------------------------------------------------------
     # test helpers (ref: integration.go podsOnMinions / waitForPodRunning)

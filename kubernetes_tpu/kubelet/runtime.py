@@ -119,6 +119,10 @@ class ContainerRuntime:
     def exec_in_container(self, container_id: str, cmd: List[str]) -> Tuple[int, str]:
         raise NotImplementedError
 
+    def container_logs(self, container_id: str, tail: int = 0) -> str:
+        """ref: dockertools GetKubeletDockerContainerLogs."""
+        raise NotImplementedError
+
 
 class FakeRuntime(ContainerRuntime):
     """In-memory runtime double (ref: FakeDockerClient).
@@ -140,6 +144,7 @@ class FakeRuntime(ContainerRuntime):
         self.call_log: List[tuple] = []
         self.errors: Dict[str, Exception] = {}
         self.exec_results: Dict[tuple, Tuple[int, str]] = {}
+        self.logs: Dict[str, str] = {}  # container id -> accumulated output
 
     # -- helpers ------------------------------------------------------------
     def _called(self, op: str, detail: str = "") -> None:
@@ -235,6 +240,20 @@ class FakeRuntime(ContainerRuntime):
             p = c.parsed
             key = (p[0] if p else c.name, tuple(cmd))
             return self.exec_results.get(key, (0, ""))
+
+    def container_logs(self, container_id: str, tail: int = 0) -> str:
+        with self._lock:
+            self._called("logs", container_id)
+            text = self.logs.get(container_id, "")
+            if tail > 0:
+                lines = text.splitlines(keepends=True)
+                text = "".join(lines[-tail:])
+            return text
+
+    def append_log(self, container_id: str, text: str) -> None:
+        """Test convenience: accumulate synthetic container output."""
+        with self._lock:
+            self.logs[container_id] = self.logs.get(container_id, "") + text
 
     # -- test conveniences ---------------------------------------------------
     def kill_container_of(self, pod_uid: str, container_name: str,

@@ -139,6 +139,7 @@ class _UDPProxy:
             except OSError:
                 with self.lock:
                     self.clients.pop(addr, None)
+                    self.last_seen.pop(addr, None)
         self._close_all()
 
     def _backend_for(self, addr) -> Optional[socket.socket]:
@@ -182,6 +183,7 @@ class _UDPProxy:
         with self.lock:
             if self.clients.get(addr) is backend:
                 del self.clients[addr]
+                self.last_seen.pop(addr, None)
         backend.close()
 
     def _close_all(self):
@@ -189,6 +191,7 @@ class _UDPProxy:
             for s in self.clients.values():
                 s.close()
             self.clients.clear()
+            self.last_seen.clear()
 
 
 class Proxier:

@@ -1,0 +1,227 @@
+"""Kubelet HTTP server tests (model: pkg/kubelet/server_test.go — a fake
+HostInterface behind a real HTTP listener)."""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.kubelet.kubelet import Kubelet
+from kubernetes_tpu.kubelet.runtime import FakeRuntime
+from kubernetes_tpu.kubelet.server import KubeletServer
+from kubernetes_tpu.kubelet.stats import (ContainerStats, FakeStatsProvider,
+                                          ProcStatsProvider)
+
+
+def mkpod(name="web", uid="u-1"):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace="default", uid=uid),
+        spec=api.PodSpec(containers=[api.Container(name="c", image="img")]))
+
+
+@pytest.fixture()
+def server(tmp_path):
+    runtime = FakeRuntime()
+    kubelet = Kubelet("node-1", runtime)
+    stats = FakeStatsProvider()
+    srv = KubeletServer(kubelet, stats=stats, log_dir=str(tmp_path)).start()
+    yield srv, kubelet, runtime, stats, tmp_path
+    srv.stop()
+    kubelet.stop()
+
+
+def get(srv, path, timeout=5.0):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}{path}", timeout=timeout) as r:
+        return r.status, r.read()
+
+
+def wait_for_container(runtime, uid, name, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for r in runtime.list_containers():
+            if r.parsed and r.parsed[3] == uid and r.parsed[0] == name:
+                return r
+        time.sleep(0.02)
+    raise AssertionError(f"container {name} for {uid} never appeared")
+
+
+def test_healthz_and_404(server):
+    srv, *_ = server
+    assert get(srv, "/healthz") == (200, b"ok")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        get(srv, "/bogus")
+    assert e.value.code == 404
+
+
+def test_pods_and_pod_info(server):
+    srv, kubelet, runtime, *_ = server
+    kubelet.sync_pods([mkpod()])
+    wait_for_container(runtime, "u-1", "c")
+    status, body = get(srv, "/pods")
+    assert status == 200
+    wire = json.loads(body)
+    assert wire["kind"] == "PodList"
+    assert wire["items"][0]["metadata"]["name"] == "web"
+    assert wire["items"][0]["status"]["phase"] == "Running"
+
+    status, body = get(srv, "/podInfo?podID=web&podNamespace=default")
+    assert status == 200
+    assert json.loads(body)["phase"] == "Running"
+    with pytest.raises(urllib.error.HTTPError) as e:
+        get(srv, "/podInfo?podID=none&podNamespace=default")
+    assert e.value.code == 404
+    with pytest.raises(urllib.error.HTTPError) as e:
+        get(srv, "/podInfo")
+    assert e.value.code == 400
+
+
+def test_spec_and_stats(server):
+    srv, kubelet, runtime, stats, _ = server
+    status, body = get(srv, "/spec/")
+    info = json.loads(body)
+    assert info["num_cores"] == 4 and info["memory_capacity"] == 8 << 30
+
+    status, body = get(srv, "/stats/")
+    assert json.loads(body)["memory"]["usage_bytes"] == 1 << 30
+
+    kubelet.sync_pods([mkpod()])
+    wait_for_container(runtime, "u-1", "c")
+    stats.containers[("u-1", "c")] = ContainerStats(
+        timestamp=2.0, memory_usage_bytes=123)
+    status, body = get(srv, "/stats/default/web/u-1/c")
+    assert json.loads(body)["memory"]["usage_bytes"] == 123
+    # short form resolves uid through the pod
+    status, body = get(srv, "/stats/default/web/c")
+    assert json.loads(body)["memory"]["usage_bytes"] == 123
+
+
+def test_proc_stats_provider_reads_proc():
+    p = ProcStatsProvider()
+    mi = p.machine_info()
+    assert mi.num_cores >= 1
+    assert mi.memory_capacity_bytes > 0
+    ns = p.node_stats()
+    assert ns.memory_usage_bytes > 0
+
+
+def test_logs_endpoint_and_traversal_guard(server, tmp_path):
+    srv, *_ = server
+    (tmp_path / "kubelet.log").write_text("hello log\n")
+    status, body = get(srv, "/logs/")
+    assert b"kubelet.log" in body
+    status, body = get(srv, "/logs/kubelet.log")
+    assert body == b"hello log\n"
+    with pytest.raises(urllib.error.HTTPError) as e:
+        get(srv, "/logs/../../../etc/passwd")
+    assert e.value.code in (403, 404)
+
+
+def test_logs_traversal_guard_sibling_prefix(tmp_path):
+    """A sibling dir sharing the log dir's string prefix must not leak."""
+    logdir = tmp_path / "kubelet"
+    logdir.mkdir()
+    sibling = tmp_path / "kubelet-private"
+    sibling.mkdir()
+    (sibling / "secret.txt").write_text("secret")
+    kubelet = Kubelet("n", FakeRuntime())
+    srv = KubeletServer(kubelet, log_dir=str(logdir)).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get(srv, "/logs/../kubelet-private/secret.txt")
+        assert e.value.code == 403
+    finally:
+        srv.stop()
+        kubelet.stop()
+
+
+def test_container_logs_and_run(server):
+    srv, kubelet, runtime, *_ = server
+    kubelet.sync_pods([mkpod()])
+    rec = wait_for_container(runtime, "u-1", "c")
+    runtime.append_log(rec.id, "line1\nline2\nline3\n")
+    status, body = get(srv, "/containerLogs/default/web/c")
+    assert body == b"line1\nline2\nline3\n"
+    status, body = get(srv, "/containerLogs/default/web/c?tail=1")
+    assert body == b"line3\n"
+
+    runtime.exec_results[("c", ("echo", "hi"))] = (0, "hi\n")
+    status, body = get(srv, "/run/default/web/c?cmd=echo+hi")
+    assert status == 200 and body == b"hi\n"
+
+
+def test_port_forward_tunnel(server):
+    """101 upgrade then raw byte relay (ref: server.go handlePortForward)."""
+    srv, kubelet, runtime, *_ = server
+    # backend the "pod" listens on
+    backend = socket.socket()
+    backend.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    backend.bind(("127.0.0.1", 0))
+    backend.listen(1)
+    bport = backend.getsockname()[1]
+
+    def echo():
+        conn, _ = backend.accept()
+        data = conn.recv(4096)
+        conn.sendall(b"pf:" + data)
+        conn.close()
+
+    threading.Thread(target=echo, daemon=True).start()
+    srv._dial = lambda pod, port: socket.create_connection(
+        ("127.0.0.1", bport), timeout=5)
+    kubelet.sync_pods([mkpod()])
+    wait_for_container(runtime, "u-1", "c")
+
+    s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+    s.sendall(b"POST /portForward/default/web?port=80 HTTP/1.1\r\n"
+              b"Host: x\r\nContent-Length: 0\r\n\r\n")
+    # read the 101 response header block
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        buf += s.recv(1024)
+    assert b"101" in buf.split(b"\r\n")[0]
+    s.sendall(b"ping")
+    got = s.recv(1024)
+    assert got == b"pf:ping"
+    s.close()
+    backend.close()
+
+
+def test_metrics_endpoint(server):
+    srv, *_ = server
+    srv.metrics.counter("kubelet_sync_total", "syncs").inc()
+    status, body = get(srv, "/metrics")
+    assert status == 200
+    assert b"kubelet_sync_total" in body
+
+
+def test_kubectl_log_through_cluster():
+    """kubectl log -> cluster pod_logs -> kubelet server -> runtime
+    (ref: kubectl/cmd/log.go path through the node's read-only API)."""
+    import io
+
+    from kubernetes_tpu.cluster import Cluster, ClusterConfig
+    from kubernetes_tpu.kubectl.cmd import run_kubectl
+
+    cluster = Cluster(ClusterConfig(num_nodes=1, kubelet_http=True)).start()
+    try:
+        cluster.client.pods("default").create(mkpod())
+        # bind directly — no scheduler needed for one node
+        cluster.client.pods("default").bind(api.Binding(
+            metadata=api.ObjectMeta(name="web", namespace="default"),
+            pod_name="web", host="node-0"))
+        handle = cluster.nodes["node-0"]
+        rec = wait_for_container(handle.runtime, "u-1", "c")
+        handle.runtime.append_log(rec.id, "container says hi\n")
+
+        out, err = io.StringIO(), io.StringIO()
+        factory = cluster.kubectl_factory(out=out, err=err)
+        assert run_kubectl(["log", "web"], factory) == 0, err.getvalue()
+        assert out.getvalue() == "container says hi\n"
+    finally:
+        cluster.stop()
