@@ -1,0 +1,19 @@
+"""Cloud provider abstraction (ref: pkg/cloudprovider/cloud.go:26-80).
+
+``Interface`` exposes optional capability getters — ``tcp_load_balancer()``,
+``instances()``, ``zones()``, ``clusters()`` — each returning the capability
+object or None, exactly like the reference's (T, bool) pairs. Providers:
+
+- ``FakeCloud``   (ref: pkg/cloudprovider/fake/) — scriptable double
+- ``LocalCloud``  — a real provider for single-machine deployments: the
+  instance list is localhost, load balancers are kube-proxy portals
+
+The registry (``register_provider``/``get_provider``) mirrors
+pkg/cloudprovider/plugins.go.
+"""
+
+from kubernetes_tpu.cloudprovider.cloud import (Clusters, FakeCloud,  # noqa: F401
+                                                Instances, Interface,
+                                                LocalCloud, TCPLoadBalancer,
+                                                Zone, Zones, get_provider,
+                                                register_provider)

@@ -29,6 +29,8 @@ class ControllerManagerConfig:
     pod_eviction_timeout: float = 30.0
     static_nodes: List[api.Node] = field(default_factory=list)
     node_prober: Optional[Callable[[api.Node], bool]] = None
+    cloud: object = None            # cloudprovider.Interface
+    match_re: str = ".*"            # cloud instance filter (ref: --minion_regexp)
 
 
 class ControllerManager:
@@ -39,7 +41,8 @@ class ControllerManager:
         self.endpoints = EndpointsController(client)
         self.nodes = NodeController(
             client, static_nodes=c.static_nodes, node_prober=c.node_prober,
-            pod_eviction_timeout=c.pod_eviction_timeout)
+            pod_eviction_timeout=c.pod_eviction_timeout,
+            cloud=c.cloud, match_re=c.match_re)
         self.namespaces = NamespaceController(client)
         self.quotas = ResourceQuotaController(client)
 

@@ -37,12 +37,19 @@ class NodeController:
     def __init__(self, client, static_nodes: Optional[List[api.Node]] = None,
                  node_prober: Optional[Callable[[api.Node], bool]] = None,
                  pod_eviction_timeout: float = 30.0,
-                 register_retry_count: int = 10):
+                 register_retry_count: int = 10,
+                 cloud=None, match_re: str = ".*",
+                 default_capacity: Optional[dict] = None):
         self.client = client
         self.static_nodes = static_nodes or []
         self.node_prober = node_prober or (lambda node: True)
         self.pod_eviction_timeout = pod_eviction_timeout
         self.register_retry_count = register_retry_count
+        # cloud provider (ref: nodecontroller.go cloud + matchRE flags);
+        # with a cloud and no static nodes, the instance list is authoritative
+        self.cloud = cloud
+        self.match_re = match_re
+        self.default_capacity = default_capacity or {}
         self._stop = threading.Event()
         # name -> monotonic time the node was first seen not-ready
         self._not_ready_since: Dict[str, float] = {}
@@ -60,6 +67,56 @@ class NodeController:
                     if attempt == self.register_retry_count - 1:
                         raise
                     time.sleep(0.05)
+
+    # -- cloud node discovery (ref: SyncCloud :208 + CloudNodes :248) -------
+    def cloud_nodes(self) -> List[api.Node]:
+        """Build Node objects from the cloud instance list."""
+        instances = self.cloud.instances() if self.cloud else None
+        if instances is None:
+            return []
+        out = []
+        for name in instances.list_instances(self.match_re):
+            spec = instances.get_node_resources(name)
+            node = api.Node(metadata=api.ObjectMeta(name=name),
+                            spec=spec or api.NodeSpec(
+                                capacity=dict(self.default_capacity)))
+            addrs = instances.node_addresses(name)
+            if addrs:
+                node.status.addresses = [
+                    api.NodeAddress(type="LegacyHostIP", address=addrs[0])]
+            out.append(node)
+        return out
+
+    def sync_cloud_nodes(self) -> None:
+        """Reconcile registered nodes against the cloud's instance set
+        (ref: nodecontroller.go SyncCloud: create new, delete departed +
+        their pods)."""
+        if self.cloud is None:
+            return
+        if self.static_nodes:
+            # static list and cloud discovery are mutually exclusive — the
+            # cloud set would otherwise "reconcile away" the static nodes
+            # every tick (ref: nodecontroller.go Run chooses one mode)
+            return
+        matches = {n.metadata.name: n for n in self.cloud_nodes()}
+        registered = self.client.nodes().list().items
+        known = {n.metadata.name for n in registered}
+        for name, node in matches.items():
+            if name not in known:
+                try:
+                    self.client.nodes().create(node)
+                except errors.StatusError:
+                    pass
+        for node in registered:
+            name = node.metadata.name
+            if name not in matches:
+                try:
+                    self.client.nodes().delete(name)
+                except errors.StatusError as e:
+                    if not errors.is_not_found(e):
+                        continue  # transient failure: node still registered,
+                        # do NOT orphan-delete its pods
+                self.delete_pods(name)
 
     # -- health sync (ref: SyncNodeStatus + DoCheck :312-397) ---------------
     def sync_node_status(self) -> None:
@@ -162,7 +219,11 @@ class NodeController:
             self.register_nodes()
         except Exception:
             pass  # registration retries exhausted; health loop still runs
-        run_periodic(self.sync_node_status, period, "node-controller", self._stop)
+
+        def tick():
+            self.sync_cloud_nodes()
+            self.sync_node_status()
+        run_periodic(tick, period, "node-controller", self._stop)
         return self
 
     def stop(self) -> None:

@@ -1,0 +1,181 @@
+"""Cloud provider + credential provider tests (model:
+pkg/cloudprovider/fake usage in nodecontroller_test.go and
+pkg/credentialprovider/keyring_test.go)."""
+
+import base64
+import json
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.quantity import Quantity
+from kubernetes_tpu.apiserver.master import Master, MasterConfig
+from kubernetes_tpu.client.client import Client, InProcessTransport
+from kubernetes_tpu.cloudprovider import (FakeCloud, LocalCloud, Zone,
+                                          get_provider)
+from kubernetes_tpu.controllers.node import NodeController
+from kubernetes_tpu.credentialprovider import (DockerConfig, DockerConfigEntry,
+                                               DockerKeyring, EnvProvider,
+                                               FileProvider)
+
+
+def mk_client(cloud=None):
+    master = Master(MasterConfig(cloud=cloud))
+    return Client(InProcessTransport(master)), master
+
+
+class TestCloudInterface:
+    def test_registry(self):
+        assert get_provider("fake") is not None
+        assert get_provider("local") is not None
+        assert get_provider("nope") is None
+
+    def test_local_cloud_lists_self(self):
+        import socket
+        cloud = LocalCloud()
+        assert cloud.instances().list_instances() == [socket.gethostname()]
+        assert cloud.zones().get_zone().region == "local"
+        assert cloud.tcp_load_balancer() is None
+
+    def test_fake_cloud_records_calls(self):
+        cloud = FakeCloud(machines=["m1", "m2"])
+        assert cloud.instances().list_instances("m.*") == ["m1", "m2"]
+        assert cloud.instances().list_instances("m1") == ["m1"]
+        cloud.tcp_load_balancer().create_tcp_load_balancer(
+            "lb", "r", "1.2.3.4", 80, ["m1"])
+        host, exists = cloud.get_tcp_load_balancer("lb", "r")
+        assert exists and host == "1.2.3.4"
+        assert ("create-lb", "lb", "r", "1.2.3.4", 80, ("m1",)) in cloud.calls
+
+
+class TestCloudNodeSync:
+    def test_cloud_nodes_registered_and_departed_deleted(self):
+        client, _ = mk_client()
+        cloud = FakeCloud(machines=["cloud-1", "cloud-2"],
+                          node_resources=api.NodeSpec(
+                              capacity={"cpu": Quantity("4")}))
+        nc = NodeController(client, cloud=cloud)
+        nc.sync_cloud_nodes()
+        names = sorted(n.metadata.name for n in client.nodes().list().items)
+        assert names == ["cloud-1", "cloud-2"]
+        node = client.nodes().get("cloud-1")
+        assert str(node.spec.capacity["cpu"]) == "4"
+
+        # instance goes away -> node deleted, its pods evicted
+        client.pods("default").create(api.Pod(
+            metadata=api.ObjectMeta(name="p", namespace="default"),
+            spec=api.PodSpec(host="cloud-2",
+                             containers=[api.Container(name="c", image="i")])))
+        cloud.machines.remove("cloud-2")
+        nc.sync_cloud_nodes()
+        names = [n.metadata.name for n in client.nodes().list().items]
+        assert names == ["cloud-1"]
+        assert client.pods("default").list().items == []
+
+    def test_match_re_filters_instances(self):
+        client, _ = mk_client()
+        cloud = FakeCloud(machines=["prod-1", "dev-1"])
+        nc = NodeController(client, cloud=cloud, match_re="prod-.*")
+        nc.sync_cloud_nodes()
+        names = [n.metadata.name for n in client.nodes().list().items]
+        assert names == ["prod-1"]
+
+
+class TestServiceExternalLB:
+    def test_external_lb_created_and_deleted(self):
+        cloud = FakeCloud(machines=["m1"], zone=Zone("z", "region-1"))
+        client, _ = mk_client(cloud=cloud)
+        client.nodes().create(api.Node(metadata=api.ObjectMeta(name="m1")))
+        client.services("default").create(api.Service(
+            metadata=api.ObjectMeta(name="web", namespace="default"),
+            spec=api.ServiceSpec(port=80, selector={"a": "b"},
+                                 create_external_load_balancer=True,
+                                 public_ips=["9.9.9.9"])))
+        assert "web" in cloud.balancers
+        ip, port, hosts = cloud.balancers["web"]
+        assert (ip, port, hosts) == ("9.9.9.9", 80, ["m1"])
+        client.services("default").delete("web")
+        assert "web" not in cloud.balancers
+
+    def test_lb_failure_rolls_back_service(self):
+        cloud = FakeCloud()
+        cloud.err = RuntimeError("quota")
+        client, _ = mk_client(cloud=cloud)
+        from kubernetes_tpu.api import errors
+        with pytest.raises(errors.StatusError):
+            client.services("default").create(api.Service(
+                metadata=api.ObjectMeta(name="web", namespace="default"),
+                spec=api.ServiceSpec(port=80, selector={"a": "b"},
+                                     create_external_load_balancer=True)))
+        assert client.services("default").list().items == []
+        # portal IP was released: the next service gets the first IP again
+        svc = client.services("default").create(api.Service(
+            metadata=api.ObjectMeta(name="web2", namespace="default"),
+            spec=api.ServiceSpec(port=80, selector={"a": "b"})))
+        assert svc.spec.portal_ip.endswith(".1")
+
+
+class TestDockerKeyring:
+    def test_config_entry_auth_round_trip(self):
+        entry = DockerConfigEntry(username="u", password="p", email="e@x")
+        wire = entry.to_wire()
+        decoded = DockerConfigEntry.from_wire(wire)
+        assert (decoded.username, decoded.password) == ("u", "p")
+
+    def test_dockercfg_file_and_configjson(self, tmp_path):
+        auth = base64.b64encode(b"user:pass").decode()
+        flat = tmp_path / ".dockercfg"
+        flat.write_text(json.dumps({
+            "https://gcr.io": {"auth": auth, "email": "e@x"}}))
+        cfg = DockerConfig.from_file(str(flat))
+        assert cfg["gcr.io"].username == "user"
+
+        nested = tmp_path / "config.json"
+        nested.write_text(json.dumps({"auths": {
+            "quay.io": {"username": "q", "password": "w"}}}))
+        cfg = DockerConfig.from_file(str(nested))
+        assert cfg["quay.io"].password == "w"
+
+    def test_keyring_longest_match(self):
+        keyring = DockerKeyring()
+        cfg = DockerConfig()
+        cfg["gcr.io"] = DockerConfigEntry(username="broad")
+        cfg["gcr.io/project"] = DockerConfigEntry(username="narrow")
+        keyring.add(cfg)
+        entry, found = keyring.lookup("gcr.io/project/image:v1")
+        assert found and entry.username == "narrow"
+        entry, found = keyring.lookup("gcr.io/other/image")
+        assert found and entry.username == "broad"
+        entry, found = keyring.lookup("quay.io/image")
+        assert not found
+
+    def test_lookup_is_segment_bounded(self):
+        """"gcr.io/proj" creds must not leak to gcr.io/proj-other images."""
+        keyring = DockerKeyring()
+        cfg = DockerConfig()
+        cfg["gcr.io/proj"] = DockerConfigEntry(username="proj")
+        keyring.add(cfg)
+        entry, found = keyring.lookup("gcr.io/proj/image")
+        assert found and entry.username == "proj"
+        _, found = keyring.lookup("gcr.io/proj-other/image")
+        assert not found
+
+    def test_bare_image_maps_to_docker_hub(self):
+        keyring = DockerKeyring()
+        cfg = DockerConfig()
+        cfg["index.docker.io"] = DockerConfigEntry(username="hub")
+        keyring.add(cfg)
+        entry, found = keyring.lookup("nginx")
+        assert found and entry.username == "hub"
+
+    def test_env_provider(self):
+        p = EnvProvider(env={"REGISTRY_AUTH_GCR_IO": "alice:s3cret"})
+        assert p.enabled()
+        cfg = p.provide()
+        assert cfg["gcr.io"].username == "alice"
+        assert not EnvProvider(env={}).enabled()
+
+    def test_file_provider_missing_files(self, tmp_path):
+        p = FileProvider(paths=[str(tmp_path / "nope")])
+        assert not p.enabled()
+        assert p.provide() == {}
