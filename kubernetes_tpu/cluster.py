@@ -50,6 +50,7 @@ class ClusterConfig:
     node_poll_period: float = 0.5
     static_pod_dirs: Dict[str, str] = field(default_factory=dict)  # node -> dir
     kubelet_http: bool = False      # start a KubeletServer per node
+    batch_scheduler: bool = False   # tpu-batch wave scheduler instead of serial
 
 
 class _NodeHandle:
@@ -122,7 +123,12 @@ class Cluster:
             algorithm_override=self.config.algorithm_override,
             recorder=EventRecorder(self.client, api.EventSource(
                 component=api.DefaultSchedulerName)))
-        self._scheduler = Scheduler(sched_config).run()
+        if self.config.batch_scheduler:
+            from kubernetes_tpu.scheduler.tpu_batch import BatchScheduler
+            self._scheduler = BatchScheduler(
+                sched_config, self.scheduler_factory, self.client).run()
+        else:
+            self._scheduler = Scheduler(sched_config).run()
         for handle in self.nodes.values():
             for src in handle.sources:
                 src.run()

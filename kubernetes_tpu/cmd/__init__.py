@@ -1,0 +1,9 @@
+"""Server binaries (ref: cmd/* — thin flag wrappers around app.Server
+structs).
+
+Each module has ``NAME_server(argv) -> int`` runnable via
+``python -m kubernetes_tpu.cmd.<name>``; ``hyperkube`` dispatches to any of
+them by first argument (ref: cmd/hyperkube), and ``standalone`` runs the
+whole control plane plus N kubelets in one process
+(ref: cmd/kubernetes/kubernetes.go).
+"""

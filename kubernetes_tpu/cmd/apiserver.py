@@ -1,0 +1,97 @@
+"""kube-apiserver binary (ref: cmd/kube-apiserver/app/server.go:107-153).
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+from typing import List, Optional
+
+__all__ = ["apiserver_server", "main"]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="kube-apiserver", exit_on_error=False)
+    p.add_argument("--address", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--portal-net", "--portal_net", default="10.0.0.0/24")
+    p.add_argument("--admission-control", "--admission_control",
+                   default="NamespaceAutoProvision,NamespaceLifecycle,"
+                           "LimitRanger,ResourceQuota")
+    p.add_argument("--token-auth-file", "--token_auth_file", default="")
+    p.add_argument("--basic-auth-file", "--basic_auth_file", default="")
+    p.add_argument("--authorization-policy-file",
+                   "--authorization_policy_file", default="")
+    p.add_argument("--cloud-provider", "--cloud_provider", default="")
+    p.add_argument("--event-ttl", "--event_ttl", type=float, default=3600.0)
+    p.add_argument("--kubelet-port", "--kubelet_port", type=int, default=10250)
+    return p
+
+
+def build_server(opts, ready_event: Optional[threading.Event] = None):
+    from kubernetes_tpu.apiserver.http import APIServer
+    from kubernetes_tpu.apiserver.master import Master, MasterConfig
+    from kubernetes_tpu.cloudprovider import get_provider
+
+    from kubernetes_tpu import auth as authpkg
+
+    authenticators = []
+    if opts.token_auth_file:
+        with open(opts.token_auth_file) as f:
+            authenticators.append(authpkg.load_token_file(f.read()))
+    if opts.basic_auth_file:
+        with open(opts.basic_auth_file) as f:
+            authenticators.append(authpkg.BasicAuthAuthenticator(
+                authpkg.load_password_file(f.read())))
+    authenticator = (authpkg.UnionAuthenticator(*authenticators)
+                     if authenticators else None)
+    authorizer = None
+    if opts.authorization_policy_file:
+        from kubernetes_tpu.auth.abac import ABACAuthorizer
+        with open(opts.authorization_policy_file) as f:
+            authorizer = ABACAuthorizer.from_text(f.read())
+
+    master = Master(MasterConfig(
+        portal_net=opts.portal_net,
+        admission_control=tuple(
+            x for x in opts.admission_control.split(",") if x),
+        authorizer=authorizer,
+        event_ttl_seconds=opts.event_ttl,
+        cloud=get_provider(opts.cloud_provider) if opts.cloud_provider else None,
+    ))
+    return APIServer(master, host=opts.address, port=opts.port,
+                     authenticator=authenticator,
+                     kubelet_port=opts.kubelet_port)
+
+
+def apiserver_server(argv: List[str],
+                     ready: Optional[threading.Event] = None,
+                     stop: Optional[threading.Event] = None) -> int:
+    try:
+        opts = build_parser().parse_args(argv)
+    except argparse.ArgumentError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    srv = build_server(opts)
+    srv.start()
+    print(f"kube-apiserver listening on {srv.base_url}", file=sys.stderr)
+    if ready is not None:
+        ready.set()
+    stop = stop or threading.Event()
+    try:
+        stop.wait()
+    except KeyboardInterrupt:
+        pass
+    srv.stop()
+    return 0
+
+
+def main() -> int:
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
+    return apiserver_server(sys.argv[1:])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,18 @@
+"""kubectl binary (ref: cmd/kubectl/kubectl.go — delegates to the cmd
+tree)."""
+
+from __future__ import annotations
+
+import sys
+
+from kubernetes_tpu.kubectl.cmd import main as kubectl_main
+
+__all__ = ["main"]
+
+
+def main() -> int:
+    return kubectl_main()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,120 @@
+"""kubelet binary (ref: cmd/kubelet/app/server.go RunKubelet:324).
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+from typing import List, Optional
+
+__all__ = ["kubelet_server", "main"]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="kubelet", exit_on_error=False)
+    p.add_argument("--api-servers", "--api_servers",
+                   default="http://127.0.0.1:8080")
+    p.add_argument("--hostname-override", "--hostname_override", default="")
+    p.add_argument("--address", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=10250)
+    p.add_argument("--root-dir", "--root_dir", default="/var/lib/kubelet")
+    p.add_argument("--config", default="",
+                   help="static pod manifest dir (file source)")
+    p.add_argument("--manifest-url", "--manifest_url", default="")
+    p.add_argument("--sync-frequency", "--sync_frequency",
+                   type=float, default=10.0)
+    p.add_argument("--register-node", "--register_node", action="store_true",
+                   help="create our Node object on startup")
+    p.add_argument("--node-cpu", default="4")
+    p.add_argument("--node-memory", default="8Gi")
+    return p
+
+
+def build_kubelet(opts):
+    import socket
+
+    from kubernetes_tpu.api import types as api
+    from kubernetes_tpu.client.client import Client
+    from kubernetes_tpu.client.http import HTTPTransport
+    from kubernetes_tpu.client.record import EventRecorder
+    from kubernetes_tpu.kubelet.config import (ApiserverSource, FileSource,
+                                               HTTPSource, PodConfig)
+    from kubernetes_tpu.kubelet.kubelet import Kubelet
+    from kubernetes_tpu.kubelet.runtime import FakeRuntime
+    from kubernetes_tpu.kubelet.server import KubeletServer
+    from kubernetes_tpu.volume.plugins import new_default_plugin_mgr
+
+    hostname = opts.hostname_override or socket.gethostname()
+    client = Client(HTTPTransport(opts.api_servers))
+    recorder = EventRecorder(client, api.EventSource(component="kubelet",
+                                                     host=hostname))
+    # the runtime seam: this image has no Docker daemon — FakeRuntime fills
+    # the dockertools slot (a real runtime drops in behind ContainerRuntime)
+    runtime = FakeRuntime()
+    volume_mgr = new_default_plugin_mgr(opts.root_dir, kubelet_client=client)
+    kubelet = Kubelet(hostname, runtime, client=client, recorder=recorder,
+                      resync_period=opts.sync_frequency,
+                      volume_mgr=volume_mgr)
+
+    pod_config = PodConfig()
+    sources = [ApiserverSource(pod_config, client, hostname)]
+    if opts.config:
+        sources.append(FileSource(pod_config, opts.config, hostname,
+                                  period=opts.sync_frequency))
+    if opts.manifest_url:
+        sources.append(HTTPSource(pod_config, opts.manifest_url, hostname,
+                                  period=opts.sync_frequency))
+
+    if opts.register_node:
+        from kubernetes_tpu.api.quantity import Quantity
+        try:
+            client.nodes().create(api.Node(
+                metadata=api.ObjectMeta(name=hostname),
+                spec=api.NodeSpec(capacity={
+                    api.ResourceCPU: Quantity(opts.node_cpu),
+                    api.ResourceMemory: Quantity(opts.node_memory)})))
+        except Exception:
+            pass  # already exists / apiserver racing
+
+    server = KubeletServer(kubelet, host=opts.address, port=opts.port)
+    return kubelet, pod_config, sources, server
+
+
+def kubelet_server(argv: List[str],
+                   ready: Optional[threading.Event] = None,
+                   stop: Optional[threading.Event] = None) -> int:
+    try:
+        opts = build_parser().parse_args(argv)
+    except argparse.ArgumentError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    kubelet, pod_config, sources, server = build_kubelet(opts)
+    for src in sources:
+        src.run()
+    kubelet.run(pod_config)
+    server.start()
+    print(f"kubelet {kubelet.hostname} serving on "
+          f"{opts.address}:{server.port}", file=sys.stderr)
+    if ready is not None:
+        ready.set()
+    stop = stop or threading.Event()
+    try:
+        stop.wait()
+    except KeyboardInterrupt:
+        pass
+    server.stop()
+    for src in sources:
+        src.stop()
+    kubelet.stop()
+    return 0
+
+
+def main() -> int:
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
+    return kubelet_server(sys.argv[1:])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,87 @@
+"""kube-scheduler binary (ref: plugin/cmd/kube-scheduler/app/server.go:74-102).
+
+``--algorithm tpu-batch`` swaps the serial scheduleOne driver for the TPU
+wave scheduler (the framework's flagship path); the default provider keeps
+the serial reference semantics.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+from typing import List, Optional
+
+__all__ = ["scheduler_server", "main"]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="kube-scheduler", exit_on_error=False)
+    p.add_argument("--master", default="http://127.0.0.1:8080")
+    p.add_argument("--algorithm-provider", "--algorithm_provider",
+                   default="DefaultProvider")
+    p.add_argument("--policy-config-file", "--policy_config_file", default="")
+    p.add_argument("--algorithm", default="serial",
+                   choices=["serial", "tpu-batch"])
+    p.add_argument("--wave-period", type=float, default=0.05,
+                   help="tpu-batch: max wait to accumulate a wave")
+    return p
+
+
+def build_scheduler(opts):
+    from kubernetes_tpu.api import types as api
+    from kubernetes_tpu.client.client import Client
+    from kubernetes_tpu.client.http import HTTPTransport
+    from kubernetes_tpu.client.record import EventRecorder
+    from kubernetes_tpu.scheduler import plugins as schedplugins
+    from kubernetes_tpu.scheduler.driver import ConfigFactory, Scheduler
+
+    client = Client(HTTPTransport(opts.master))
+    recorder = EventRecorder(client, api.EventSource(
+        component=api.DefaultSchedulerName))
+    factory = ConfigFactory(client)
+
+    policy = None
+    if opts.policy_config_file:
+        with open(opts.policy_config_file) as f:
+            policy = schedplugins.load_policy(f.read())
+    config = factory.create(provider=opts.algorithm_provider,
+                            policy=policy, recorder=recorder)
+    if opts.algorithm == "tpu-batch":
+        from kubernetes_tpu.scheduler.tpu_batch import BatchScheduler
+        return factory, BatchScheduler(config, factory, client,
+                                       wave_linger_s=opts.wave_period)
+    return factory, Scheduler(config)
+
+
+def scheduler_server(argv: List[str],
+                     ready: Optional[threading.Event] = None,
+                     stop: Optional[threading.Event] = None) -> int:
+    try:
+        opts = build_parser().parse_args(argv)
+    except argparse.ArgumentError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    factory, sched = build_scheduler(opts)
+    sched.run()
+    print(f"kube-scheduler running ({opts.algorithm})", file=sys.stderr)
+    if ready is not None:
+        ready.set()
+    stop = stop or threading.Event()
+    try:
+        stop.wait()
+    except KeyboardInterrupt:
+        pass
+    sched.stop()
+    factory.stop()
+    return 0
+
+
+def main() -> int:
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
+    return scheduler_server(sys.argv[1:])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

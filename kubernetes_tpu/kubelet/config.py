@@ -25,7 +25,7 @@ from kubernetes_tpu.api.latest import scheme as default_scheme
 from kubernetes_tpu.client.cache import Reflector, Store
 from kubernetes_tpu.controllers.util import run_periodic
 
-__all__ = ["PodUpdate", "PodConfig", "FileSource", "ApiserverSource",
+__all__ = ["PodUpdate", "PodConfig", "FileSource", "HTTPSource", "ApiserverSource",
            "ConfigSourceAnnotation"]
 
 SET = "SET"
@@ -69,6 +69,22 @@ class PodConfig:
             return sorted(self._per_source)
 
 
+def _apply_static_pod_defaults(pod: api.Pod, source: str,
+                               hostname: str) -> api.Pod:
+    """Static pod normalization shared by the file and URL sources: default
+    namespace, ``-<hostname>`` name suffix, deterministic uid, pinned host,
+    source annotation (ref: config/file.go + http.go applyDefaults)."""
+    if not pod.metadata.namespace:
+        pod.metadata.namespace = api.NamespaceDefault
+    if not pod.metadata.name.endswith("-" + hostname):
+        pod.metadata.name = f"{pod.metadata.name}-{hostname}"
+    if not pod.metadata.uid:
+        pod.metadata.uid = f"{source}-{pod.metadata.namespace}-{pod.metadata.name}"
+    pod.spec.host = hostname
+    pod.metadata.annotations[ConfigSourceAnnotation] = source
+    return pod
+
+
 class FileSource:
     """Static pods from a directory of JSON manifests (ref: config/file.go:41).
 
@@ -99,15 +115,7 @@ class FileSource:
                 continue  # a bad manifest must not poison the others
             if not isinstance(obj, api.Pod):
                 continue
-            if not obj.metadata.namespace:
-                obj.metadata.namespace = api.NamespaceDefault
-            if not obj.metadata.name.endswith("-" + self.hostname):
-                obj.metadata.name = f"{obj.metadata.name}-{self.hostname}"
-            if not obj.metadata.uid:
-                obj.metadata.uid = f"file-{obj.metadata.namespace}-{obj.metadata.name}"
-            obj.spec.host = self.hostname
-            obj.metadata.annotations[ConfigSourceAnnotation] = "file"
-            pods.append(obj)
+            pods.append(_apply_static_pod_defaults(obj, "file", self.hostname))
         return pods
 
     def sync(self) -> None:
@@ -115,6 +123,45 @@ class FileSource:
 
     def run(self) -> "FileSource":
         run_periodic(self.sync, self.period, "file-source", self._stop)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+class HTTPSource:
+    """Static pods from a manifest URL (ref: config/http.go:41): GET the
+    URL each period; the body is one Pod or a PodList manifest."""
+
+    def __init__(self, config: PodConfig, url: str, hostname: str,
+                 period: float = 5.0, scheme=None):
+        self.config = config
+        self.url = url
+        self.hostname = hostname
+        self.period = period
+        self.scheme = scheme or default_scheme
+        self._stop = threading.Event()
+
+    def read_once(self) -> Optional[List[api.Pod]]:
+        """None on fetch/decode failure (keep last state); [] is a
+        legitimately empty manifest (tear static pods down)."""
+        import urllib.request
+        try:
+            with urllib.request.urlopen(self.url, timeout=10) as r:
+                obj = self.scheme.decode(r.read())
+        except Exception:
+            return None
+        pods = obj.items if isinstance(obj, api.PodList) else [obj]
+        return [_apply_static_pod_defaults(p, "http", self.hostname)
+                for p in pods if isinstance(p, api.Pod)]
+
+    def sync(self) -> None:
+        pods = self.read_once()
+        if pods is not None:
+            self.config.merge("http", pods)
+
+    def run(self) -> "HTTPSource":
+        run_periodic(self.sync, self.period, "http-source", self._stop)
         return self
 
     def stop(self) -> None:

@@ -172,8 +172,9 @@ class Kubelet:
     def _ensure_mirror_pod(self, pod: api.Pod) -> None:
         if self.client is None:
             return
-        if pod.metadata.annotations.get(ConfigSourceAnnotation) != "file":
-            return
+        if pod.metadata.annotations.get(ConfigSourceAnnotation) \
+                not in ("file", "http"):
+            return  # only static pods get mirrors (ref: pod_manager.go)
         ns = pod.metadata.namespace or api.NamespaceDefault
         try:
             self.client.pods(ns).get(pod.metadata.name)

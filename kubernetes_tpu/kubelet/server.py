@@ -198,8 +198,12 @@ class _Handler(BaseHTTPRequestHandler):
         pod, rec = self._resolve_container(rest)
         if pod is None or rec is None:
             return self._send_text(404, "container not found\n")
-        raw = query.get("cmd") or query.get("command") or ""
-        cmd = raw.split() if raw else []
+        # repeated cmd= params are argv entries (ref: server.go handleRun);
+        # a single spaced value is whitespace-split as a convenience
+        multi = urllib.parse.parse_qs(urllib.parse.urlsplit(self.path).query)
+        cmd = multi.get("cmd") or multi.get("command") or []
+        if len(cmd) == 1 and " " in cmd[0]:
+            cmd = cmd[0].split()
         if not cmd:
             return self._send_text(400, "missing cmd\n")
         code, output = self.ks.runtime.exec_in_container(rec.id, cmd)

@@ -81,6 +81,10 @@ class Scheme:
         if not self.recognizes(version, kind):
             raise NotRegisteredError(f"kind {kind!r} not registered in version {version!r}")
         wire = to_wire(obj)
+        if kind.endswith("List") and "items" not in wire:
+            # omitempty drops empty lists, but List kinds must always carry
+            # items on the wire — clients index .items unconditionally
+            wire["items"] = []
         enc, _ = self._transforms.get((version, kind), (None, None))
         if enc is not None:
             wire = enc(wire)

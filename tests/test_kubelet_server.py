@@ -153,6 +153,11 @@ def test_container_logs_and_run(server):
     runtime.exec_results[("c", ("echo", "hi"))] = (0, "hi\n")
     status, body = get(srv, "/run/default/web/c?cmd=echo+hi")
     assert status == 200 and body == b"hi\n"
+    # repeated cmd= params are argv entries with spaces preserved
+    # (ref: server.go handleRun)
+    runtime.exec_results[("c", ("sh", "-c", "echo a b"))] = (0, "a b\n")
+    status, body = get(srv, "/run/default/web/c?cmd=sh&cmd=-c&cmd=echo+a+b")
+    assert status == 200 and body == b"a b\n"
 
 
 def test_port_forward_tunnel(server):
