@@ -1,0 +1,46 @@
+// The pod infra ("pause") container binary.
+//
+// ref: third_party/pause/pause.asm — the reference's only native component:
+// a minimal executable whose sole job is to exist, holding the pod's
+// network/IPC namespaces open while real containers come and go around it
+// (ref: pkg/kubelet/kubelet.go:1025 createPodInfraContainer).
+//
+// The reference issues one bare pause() syscall and exits when any signal
+// arrives. This version keeps the same "do nothing, cheaply" contract but
+// terminates cleanly on SIGINT/SIGTERM (exit 0) so pod teardown is graceful
+// under runtimes that deliver TERM before KILL, and loops on other wakeups
+// (e.g. SIGCHLD when acting as PID 1) instead of dying.
+//
+// Build: `make` here, or `make -C native` from the repo root. Static,
+// no libc-beyond-syscall dependencies in the hot path.
+
+#include <csignal>
+#include <cstdlib>
+#include <unistd.h>
+
+namespace {
+
+volatile sig_atomic_t shutting_down = 0;
+
+void handle_terminate(int) { shutting_down = 1; }
+
+}  // namespace
+
+int main() {
+  struct sigaction sa = {};
+  sa.sa_handler = handle_terminate;
+  sigaction(SIGINT, &sa, nullptr);
+  sigaction(SIGTERM, &sa, nullptr);
+
+  // Reap children if we are PID 1 of the sandbox: ignore SIGCHLD with
+  // SA_NOCLDWAIT so zombies never accumulate.
+  struct sigaction reap = {};
+  reap.sa_handler = SIG_IGN;
+  reap.sa_flags = SA_NOCLDWAIT;
+  sigaction(SIGCHLD, &reap, nullptr);
+
+  while (!shutting_down) {
+    pause();  // sleeps until any signal; zero CPU while parked
+  }
+  return 0;
+}

@@ -1,0 +1,73 @@
+"""Native pause binary tests (model: the reference ships
+third_party/pause as its one native artifact; we build and exercise it)."""
+
+import os
+import shutil
+import signal
+import subprocess
+import time
+
+import pytest
+
+NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native", "pause")
+
+
+@pytest.fixture(scope="module")
+def pause_binary(tmp_path_factory):
+    if shutil.which("g++") is None:
+        pytest.skip("no g++ in this environment")
+    build = tmp_path_factory.mktemp("pause-build")
+    src = os.path.join(NATIVE_DIR, "pause.cc")
+    out = str(build / "pause")
+    subprocess.run(["g++", "-Os", "-static", "-o", out, src],
+                   check=True, capture_output=True)
+    return out
+
+
+def test_pause_builds_small_and_static(pause_binary):
+    # static: no dynamic interpreter
+    out = subprocess.run(["file", pause_binary], capture_output=True,
+                         text=True).stdout if shutil.which("file") else ""
+    if out:
+        assert "static" in out.lower() or "statically" in out.lower()
+    assert os.path.getsize(pause_binary) < 2 << 20  # well under 2MB
+
+
+def test_pause_parks_and_exits_on_term(pause_binary):
+    proc = subprocess.Popen([pause_binary])
+    try:
+        time.sleep(0.3)
+        assert proc.poll() is None, "pause exited on its own"
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=5) == 0  # graceful 0 on TERM
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+def test_pause_survives_sigchld(pause_binary):
+    """As sandbox PID 1 it must not die on child exits."""
+    proc = subprocess.Popen([pause_binary])
+    try:
+        time.sleep(0.2)
+        proc.send_signal(signal.SIGCHLD)
+        time.sleep(0.3)
+        assert proc.poll() is None, "pause died on SIGCHLD"
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=5) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+def test_pause_uses_no_cpu(pause_binary):
+    proc = subprocess.Popen([pause_binary])
+    try:
+        time.sleep(0.5)
+        with open(f"/proc/{proc.pid}/stat") as f:
+            fields = f.read().split()
+        utime, stime = int(fields[13]), int(fields[14])
+        assert utime + stime <= 2  # parked in pause(), ~zero ticks
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=5)
