@@ -1,0 +1,108 @@
+"""Density / churn tests (model: test/e2e/density.go:173-215 — "should
+allow starting 100 pods per node" and "master components can handle many
+short-lived pods"), run against the in-process cluster like
+cmd/integration does for multi-node scenarios."""
+
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.quantity import Quantity
+from kubernetes_tpu.cluster import Cluster, ClusterConfig
+
+
+def mk_rc(name, replicas, image="img"):
+    labels = {"app": name}
+    return api.ReplicationController(
+        metadata=api.ObjectMeta(name=name, namespace="default"),
+        spec=api.ReplicationControllerSpec(
+            replicas=replicas, selector=dict(labels),
+            template=api.PodTemplateSpec(
+                metadata=api.ObjectMeta(labels=dict(labels)),
+                spec=api.PodSpec(containers=[api.Container(
+                    name="c", image=image,
+                    resources=api.ResourceRequirements(
+                        limits={"cpu": Quantity("10m"),
+                                "memory": Quantity("16Mi")}))]))))
+
+
+@pytest.mark.parametrize("pods_per_node", [30, 100])
+def test_density_pods_per_node(pods_per_node):
+    """ref: density.go:201-204 — [pods_per_node] pods/node all reach
+    Running; 2 nodes as in cmd/integration."""
+    cluster = Cluster(ClusterConfig(
+        num_nodes=2, node_cpu="16", node_memory="64Gi",
+        rc_sync_period=0.2, kubelet_resync=0.2)).start()
+    total = pods_per_node * 2
+    try:
+        cluster.client.replication_controllers().create(
+            mk_rc("density", total))
+        t0 = time.monotonic()
+        assert cluster.wait_pods_running(total, label_selector="app=density",
+                                         timeout=60.0), \
+            "density pods never all ran"
+        elapsed = time.monotonic() - t0
+        # every pod landed on a real node and is running there
+        pods = cluster.client.pods().list(label_selector="app=density").items
+        assert len(pods) == total
+        per_node = {}
+        for p in pods:
+            per_node[p.spec.host] = per_node.get(p.spec.host, 0) + 1
+        assert set(per_node) == {"node-0", "node-1"}
+        # spreading keeps the split near even (ref: ServiceSpreading absent
+        # -> LeastRequested balances by resources)
+        assert max(per_node.values()) - min(per_node.values()) <= total // 4
+        print(f"\ndensity: {total} pods Running in {elapsed:.1f}s "
+              f"({total/elapsed:.0f} pods/s) split={per_node}")
+    finally:
+        cluster.stop()
+
+
+def test_master_churn_short_lived_pods():
+    """ref: density.go:206-215 — N threads x M sequential short-lived pods;
+    the master must handle the churn without wedging."""
+    cluster = Cluster(ClusterConfig(num_nodes=2, rc_sync_period=0.2,
+                                    kubelet_resync=0.2)).start()
+    threads, per_thread = 5, 10
+    errors = []
+
+    def churn(tid):
+        try:
+            for i in range(per_thread):
+                name = f"churn-{tid}-{i}"
+                cluster.client.pods("default").create(api.Pod(
+                    metadata=api.ObjectMeta(
+                        name=name, namespace="default",
+                        uid=f"uid-{name}", labels={"churn": str(tid)}),
+                    spec=api.PodSpec(containers=[api.Container(
+                        name="c", image="img")])))
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    pod = cluster.client.pods("default").get(name)
+                    if pod.spec.host:
+                        break
+                    time.sleep(0.02)
+                else:
+                    raise TimeoutError(f"{name} never scheduled")
+                cluster.client.pods("default").delete(name)
+        except Exception as e:
+            errors.append(e)
+
+    try:
+        ts = [threading.Thread(target=churn, args=(tid,))
+              for tid in range(threads)]
+        t0 = time.monotonic()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+        elapsed = time.monotonic() - t0
+        assert not errors, errors[:3]
+        assert cluster.wait_for(
+            lambda: not cluster.client.pods("default").list().items)
+        print(f"\nchurn: {threads * per_thread} short-lived pods in "
+              f"{elapsed:.1f}s")
+    finally:
+        cluster.stop()
