@@ -68,7 +68,9 @@ class Quantity:
     ``Quantity("3e6")``. Arithmetic (+, -, comparison) is exact.
     """
 
-    __slots__ = ("value", "format")
+    # _milli_cache/_int_cache memoize the accessor results (arithmetic
+    # always returns new Quantity objects, so .value never mutates in place)
+    __slots__ = ("value", "format", "_milli_cache", "_int_cache")
 
     def __init__(self, value="0", fmt=None):
         if isinstance(value, Quantity):
@@ -126,15 +128,25 @@ class Quantity:
         return self.value != 0
 
     # -- accessors ----------------------------------------------------------
+    # memoized: the snapshot encoder calls these once per pod-resource per
+    # wave and Fraction arithmetic dominates the host encode profile
     def milli_value(self) -> int:
         """Value scaled by 1000, rounded up (ref: quantity.go MilliValue)."""
-        v = self.value * 1000
-        return -(-v.numerator // v.denominator)  # ceil
+        cached = getattr(self, "_milli_cache", None)
+        if cached is None:
+            v = self.value * 1000
+            cached = -(-v.numerator // v.denominator)  # ceil
+            object.__setattr__(self, "_milli_cache", cached)
+        return cached
 
     def int_value(self) -> int:
         """Value rounded up to the nearest integer (ref: quantity.go Value)."""
-        v = self.value
-        return -(-v.numerator // v.denominator)
+        cached = getattr(self, "_int_cache", None)
+        if cached is None:
+            v = self.value
+            cached = -(-v.numerator // v.denominator)
+            object.__setattr__(self, "_int_cache", cached)
+        return cached
 
     def to_float(self) -> float:
         return float(self.value)

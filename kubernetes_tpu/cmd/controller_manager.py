@@ -41,7 +41,7 @@ def build_manager(opts):
                                                     ControllerManagerConfig)
 
     if opts.machines and opts.cloud_provider:
-        raise SystemExit("--machines and --cloud-provider are mutually "
+        raise ValueError("--machines and --cloud-provider are mutually "
                          "exclusive (static list vs cloud discovery)")
     client = Client(HTTPTransport(opts.master))
     static_nodes = [
@@ -63,10 +63,10 @@ def controller_manager_server(argv: List[str],
                               stop: Optional[threading.Event] = None) -> int:
     try:
         opts = build_parser().parse_args(argv)
-    except argparse.ArgumentError as e:
+        manager = build_manager(opts)
+    except (argparse.ArgumentError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
-    manager = build_manager(opts)
     manager.run()
     print("kube-controller-manager running", file=sys.stderr)
     if ready is not None:

@@ -36,9 +36,8 @@ def _proxy(argv):
 
 
 def _kubectl(argv):
-    from kubernetes_tpu.client.clientcmd import client_from_config
-    from kubernetes_tpu.kubectl.cmd import Factory, run_kubectl
-    return run_kubectl(argv, Factory(client_from_config()))
+    from kubernetes_tpu.kubectl.cmd import main as kubectl_main
+    return kubectl_main(argv)
 
 
 def _standalone(argv):

@@ -68,15 +68,35 @@ def build_kubelet(opts):
                                   period=opts.sync_frequency))
 
     if opts.register_node:
+        from kubernetes_tpu.api import errors
         from kubernetes_tpu.api.quantity import Quantity
-        try:
-            client.nodes().create(api.Node(
+
+        def register():
+            node = api.Node(
                 metadata=api.ObjectMeta(name=hostname),
                 spec=api.NodeSpec(capacity={
                     api.ResourceCPU: Quantity(opts.node_cpu),
-                    api.ResourceMemory: Quantity(opts.node_memory)})))
-        except Exception:
-            pass  # already exists / apiserver racing
+                    api.ResourceMemory: Quantity(opts.node_memory)}))
+            # keep retrying: the apiserver routinely comes up after the
+            # kubelet in a multi-process boot (ref: NodeController
+            # RegisterNodes retry loop)
+            import time as _time
+            while True:
+                try:
+                    client.nodes().create(node)
+                    return
+                except errors.StatusError as e:
+                    if errors.is_already_exists(e):
+                        return
+                    print(f"kubelet: node registration rejected: {e}",
+                          file=sys.stderr)
+                except Exception as e:
+                    print(f"kubelet: apiserver unreachable, retrying "
+                          f"registration: {e}", file=sys.stderr)
+                _time.sleep(1.0)
+
+        threading.Thread(target=register, daemon=True,
+                         name="kubelet-register").start()
 
     server = KubeletServer(kubelet, host=opts.address, port=opts.port)
     return kubelet, pod_config, sources, server

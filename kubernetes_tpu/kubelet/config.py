@@ -151,7 +151,15 @@ class HTTPSource:
                 obj = self.scheme.decode(r.read())
         except Exception:
             return None
-        pods = obj.items if isinstance(obj, api.PodList) else [obj]
+        if isinstance(obj, api.PodList):
+            pods = list(obj.items)
+        elif isinstance(obj, api.Pod):
+            pods = [obj]
+        else:
+            # decoded but wrong kind (misconfigured URL serving some other
+            # object): an error, not an empty manifest — keep last state
+            # (ref: config/http.go rejects unknown types)
+            return None
         return [_apply_static_pod_defaults(p, "http", self.hostname)
                 for p in pods if isinstance(p, api.Pod)]
 

@@ -78,7 +78,10 @@ class _Handler(BaseHTTPRequestHandler):
         self._drain()
         parsed = urllib.parse.urlsplit(self.path)
         parts = [p for p in parsed.path.split("/") if p]
-        query = dict(urllib.parse.parse_qsl(parsed.query))
+        # parse once; handlers get the single-value view, _handle_run the
+        # multi-value one (repeated cmd= params are argv entries)
+        self._multi_query = urllib.parse.parse_qs(parsed.query)
+        query = {k: v[-1] for k, v in self._multi_query.items()}
         try:
             self._dispatch(method, parts, query)
         except BrokenPipeError:
@@ -200,7 +203,7 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send_text(404, "container not found\n")
         # repeated cmd= params are argv entries (ref: server.go handleRun);
         # a single spaced value is whitespace-split as a convenience
-        multi = urllib.parse.parse_qs(urllib.parse.urlsplit(self.path).query)
+        multi = self._multi_query
         cmd = multi.get("cmd") or multi.get("command") or []
         if len(cmd) == 1 and " " in cmd[0]:
             cmd = cmd[0].split()

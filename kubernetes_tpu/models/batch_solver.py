@@ -17,23 +17,33 @@ call over a dense (pending_pods x nodes) problem:
   truncation, same float32 spread rounding, same FNV-1a-mod-count tie-break
   over nodes in list order.
 
-Everything is static-shaped, integer/float32 only (int64 enabled for byte
-capacities), no data-dependent Python control flow — XLA compiles the whole
-wave to a single TPU program. Sharding over the node axis for multi-chip is
-layered on in kubernetes_tpu.parallel.mesh without changing this module.
+TPU dtype strategy: v5e has no native int64 — every wide i64 op is emulated
+as multiple i32 ops. Byte capacities exceed int32, but floor division and
+integer comparison are invariant under a common scaling, so the encoder
+divides all memory values by their collective gcd; when the scaled wave fits
+int32 (it virtually always does — Mi-granular quantities reduce 64Gi to
+65536) the whole scan runs native int32, falling back to int64 otherwise.
+Host-port / PD sets ride as packed uint32 bitmask words instead of [N, K]
+bool planes, so conflict checks are W-word AND+reduce instead of K-lane ops.
+
+Everything is static-shaped, no data-dependent Python control flow — XLA
+compiles the whole wave to a single TPU program. Sharding over the node axis
+for multi-chip is layered on in kubernetes_tpu.parallel.mesh without
+changing this module.
 """
 
 from __future__ import annotations
 
 import functools
+import math
 from typing import NamedTuple, Optional, Tuple
 
 import jax
 
 
 def ensure_x64() -> None:
-    """Byte capacities need int64; without x64, jnp silently downcasts to
-    int32 and 8Gi capacities wrap. Called at the array-creation boundary
+    """The int64 fallback path needs x64; without it jnp silently downcasts
+    and 8Gi byte capacities wrap. Called at the array-creation boundary
     (snapshot_to_inputs) rather than at import so merely importing this
     module does not flip process-global dtype semantics."""
     if not jax.config.jax_enable_x64:
@@ -54,12 +64,15 @@ from kubernetes_tpu.ops.kernels import (
 
 __all__ = ["solve", "solve_jit", "SolverInputs", "decisions_to_names"]
 
-NEG = -1  # masked score sentinel (scores are always >= 0); plain int so the
-# module can be imported before x64 is enabled without freezing an int32
+NEG = -1  # masked score sentinel (scores are always >= 0)
+
+_I32_HEADROOM = (2**31 - 1) // 10  # calculate_score multiplies by 10
 
 
 class SolverInputs(NamedTuple):
-    """Device-ready arrays (see ClusterSnapshot for shapes/meaning)."""
+    """Device-ready arrays (see ClusterSnapshot for shapes/meaning).
+    Resource arrays are int32 when the gcd-scaled wave fits, else int64;
+    port/pd sets are packed uint32 bitmask words."""
 
     cap_cpu: jnp.ndarray
     cap_mem: jnp.ndarray
@@ -68,15 +81,15 @@ class SolverInputs(NamedTuple):
     fit_exceeded: jnp.ndarray
     score_used_cpu: jnp.ndarray
     score_used_mem: jnp.ndarray
-    node_ports: jnp.ndarray
+    node_ports: jnp.ndarray      # [N, Wp] u32 packed
     node_sel: jnp.ndarray
-    node_pds: jnp.ndarray
+    node_pds: jnp.ndarray        # [N, Wd] u32 packed
     node_extra_ok: jnp.ndarray
     req_cpu: jnp.ndarray
     req_mem: jnp.ndarray
-    pod_ports: jnp.ndarray
+    pod_ports: jnp.ndarray       # [P, Wp] u32 packed
     pod_sel: jnp.ndarray
-    pod_pds: jnp.ndarray
+    pod_pds: jnp.ndarray         # [P, Wd] u32 packed
     pod_host_idx: jnp.ndarray
     tie_hi: jnp.ndarray
     tie_lo: jnp.ndarray
@@ -85,21 +98,73 @@ class SolverInputs(NamedTuple):
     group_counts: jnp.ndarray
 
 
+def _pack_bits(a: np.ndarray) -> np.ndarray:
+    """[R, K] bool -> [R, W] uint32 bitmask words (little-endian bits)."""
+    rows, K = a.shape
+    W = max(1, (K + 31) // 32)
+    padded = np.zeros((rows, W * 32), dtype=bool)
+    padded[:, :K] = a
+    weights = (np.uint64(1) << np.arange(32, dtype=np.uint64))
+    words = (padded.reshape(rows, W, 32) * weights).sum(axis=2)
+    return words.astype(np.uint32)
+
+
+def _memory_scale(snap: ClusterSnapshot) -> int:
+    """gcd of every memory value in the wave — dividing them all by it is
+    exact for each comparison and floor division the solver performs."""
+    vals = np.concatenate([snap.cap_mem, snap.fit_used_mem,
+                           snap.score_used_mem, snap.req_mem])
+    vals = vals[vals != 0]
+    if vals.size == 0:
+        return 1
+    return int(np.gcd.reduce(np.abs(vals)))
+
+
+def _fits_i32(*arrays) -> bool:
+    total = 0
+    for a in arrays:
+        if a.size:
+            total = max(total, int(np.abs(a).max()))
+    return total <= _I32_HEADROOM
+
+
 def snapshot_to_inputs(snap: ClusterSnapshot) -> SolverInputs:
     ensure_x64()
+    g = _memory_scale(snap)
+    cap_mem = snap.cap_mem // g
+    fit_used_mem = snap.fit_used_mem // g
+    score_used_mem = snap.score_used_mem // g
+    req_mem = snap.req_mem // g
+
+    # int32 is safe when no running sum can reach 2^31/10: the largest
+    # initial value plus the whole batch's requests bounds every accumulator
+    req_mem_total = np.array([int(req_mem.sum())])
+    req_cpu_total = np.array([int(snap.req_cpu.sum())])
+    use_i32 = _fits_i32(cap_mem, fit_used_mem,
+                        score_used_mem + req_mem_total,
+                        cap_mem + req_mem_total) and \
+        _fits_i32(snap.cap_cpu, snap.fit_used_cpu,
+                  snap.score_used_cpu + req_cpu_total,
+                  snap.cap_cpu + req_cpu_total)
+    rdt = np.int32 if use_i32 else np.int64
+
     return SolverInputs(
-        cap_cpu=jnp.asarray(snap.cap_cpu), cap_mem=jnp.asarray(snap.cap_mem),
-        fit_used_cpu=jnp.asarray(snap.fit_used_cpu),
-        fit_used_mem=jnp.asarray(snap.fit_used_mem),
+        cap_cpu=jnp.asarray(snap.cap_cpu.astype(rdt)),
+        cap_mem=jnp.asarray(cap_mem.astype(rdt)),
+        fit_used_cpu=jnp.asarray(snap.fit_used_cpu.astype(rdt)),
+        fit_used_mem=jnp.asarray(fit_used_mem.astype(rdt)),
         fit_exceeded=jnp.asarray(snap.fit_exceeded),
-        score_used_cpu=jnp.asarray(snap.score_used_cpu),
-        score_used_mem=jnp.asarray(snap.score_used_mem),
-        node_ports=jnp.asarray(snap.node_ports), node_sel=jnp.asarray(snap.node_sel),
-        node_pds=jnp.asarray(snap.node_pds),
+        score_used_cpu=jnp.asarray(snap.score_used_cpu.astype(rdt)),
+        score_used_mem=jnp.asarray(score_used_mem.astype(rdt)),
+        node_ports=jnp.asarray(_pack_bits(snap.node_ports)),
+        node_sel=jnp.asarray(snap.node_sel),
+        node_pds=jnp.asarray(_pack_bits(snap.node_pds)),
         node_extra_ok=jnp.asarray(snap.node_extra_ok),
-        req_cpu=jnp.asarray(snap.req_cpu), req_mem=jnp.asarray(snap.req_mem),
-        pod_ports=jnp.asarray(snap.pod_ports), pod_sel=jnp.asarray(snap.pod_sel),
-        pod_pds=jnp.asarray(snap.pod_pds),
+        req_cpu=jnp.asarray(snap.req_cpu.astype(rdt)),
+        req_mem=jnp.asarray(req_mem.astype(rdt)),
+        pod_ports=jnp.asarray(_pack_bits(snap.pod_ports)),
+        pod_sel=jnp.asarray(snap.pod_sel),
+        pod_pds=jnp.asarray(_pack_bits(snap.pod_pds)),
         pod_host_idx=jnp.asarray(snap.pod_host_idx),
         tie_hi=jnp.asarray(snap.tie_hi), tie_lo=jnp.asarray(snap.tie_lo),
         pod_gid=jnp.asarray(snap.pod_gid),
@@ -112,12 +177,9 @@ def snapshot_to_inputs(snap: ClusterSnapshot) -> SolverInputs:
 def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
               w_equal: int = 0) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Solve one wave. Returns (chosen_node_idx[P] int32 — -1 unschedulable,
-    scores[P] int64 — the winning combined score, -1 if unschedulable)."""
-    if inp.cap_cpu.dtype != jnp.int64:
-        raise TypeError(
-            "solver inputs lost int64 (x64 disabled?) — build them via "
-            "snapshot_to_inputs, which enables jax_enable_x64")
+    scores[P] int32 — the winning combined score, -1 if unschedulable)."""
     N = inp.cap_cpu.shape[0]
+    rdt = inp.cap_cpu.dtype
     arange_n = jnp.arange(N, dtype=jnp.int32)
 
     # ---- batched Filter pre-pass (MXU) -----------------------------------
@@ -131,12 +193,12 @@ def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
 
     # ---- sequential commit scan over pods --------------------------------
     class Carry(NamedTuple):
-        fit_used_cpu: jnp.ndarray    # [N] i64
+        fit_used_cpu: jnp.ndarray    # [N] resource dtype
         fit_used_mem: jnp.ndarray
         score_used_cpu: jnp.ndarray
         score_used_mem: jnp.ndarray
-        ports: jnp.ndarray           # [N, K] bool
-        pds: jnp.ndarray             # [N, K3] bool
+        ports: jnp.ndarray           # [N, Wp] u32 packed
+        pds: jnp.ndarray             # [N, Wd] u32 packed
         counts: jnp.ndarray          # [G, N+1] i32
 
     init = Carry(inp.fit_used_cpu, inp.fit_used_mem,
@@ -155,27 +217,27 @@ def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
         # fit_exceeded is static: committed pending pods always fit, so they
         # never flip a node into the pre-exceeded state.
         res_ok = zero_req | (~inp.fit_exceeded & cpu_ok & mem_ok)
-        # Filter: host ports (predicates.go:326-338)
-        port_conflict = jnp.any(carry.ports & pod_ports[None, :], axis=1)
+        # Filter: host ports (predicates.go:326-338) — packed-word AND
+        port_conflict = jnp.any(carry.ports & pod_ports[None, :] != 0, axis=1)
         # Filter: GCE PD exclusivity (predicates.go:68-83)
-        pd_conflict = jnp.any(carry.pds & pod_pds[None, :], axis=1)
+        pd_conflict = jnp.any(carry.pds & pod_pds[None, :] != 0, axis=1)
 
         feasible = static_row & res_ok & ~port_conflict & ~pd_conflict
 
         # Score: LeastRequested (priorities.go:41-75 — all-pods usage + pod)
         total_cpu = carry.score_used_cpu + req_cpu
         total_mem = carry.score_used_mem + req_mem
-        lr = (_calculate_score(total_cpu, inp.cap_cpu)
-              + _calculate_score(total_mem, inp.cap_mem)) // 2
+        lr = ((_calculate_score(total_cpu, inp.cap_cpu)
+               + _calculate_score(total_mem, inp.cap_mem)) // 2).astype(jnp.int32)
         # Score: ServiceSpreading (spreading.go:37-86)
         safe_gid = jnp.maximum(gid, 0)
         counts_row = carry.counts[safe_gid]          # [N+1]
         max_count = jnp.max(counts_row)
         spread = _spread_score(max_count, counts_row[:N])
-        spread = jnp.where(gid >= 0, spread, jnp.int64(10))  # no service: 10
+        spread = jnp.where(gid >= 0, spread, jnp.int32(10))  # no service: 10
 
-        score = lr * w_lr + spread * w_spread + jnp.int64(w_equal)
-        masked = jnp.where(feasible, score, NEG)
+        score = lr * w_lr + spread * w_spread + jnp.int32(w_equal)
+        masked = jnp.where(feasible, score, jnp.int32(NEG))
 
         # select host (generic_scheduler.go:84-96, deterministic tie-break)
         top, any_feasible, best, cnt = masked_top_count(masked, NEG)
@@ -191,12 +253,14 @@ def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
             fit_used_mem=carry.fit_used_mem + onehot * req_mem,
             score_used_cpu=carry.score_used_cpu + onehot * req_cpu,
             score_used_mem=carry.score_used_mem + onehot * req_mem,
-            ports=carry.ports | (onehot[:, None] & pod_ports[None, :]),
-            pds=carry.pds | (onehot[:, None] & pod_pds[None, :]),
+            ports=carry.ports | jnp.where(onehot[:, None], pod_ports[None, :],
+                                          jnp.uint32(0)),
+            pds=carry.pds | jnp.where(onehot[:, None], pod_pds[None, :],
+                                      jnp.uint32(0)),
             counts=carry.counts + (member[:, None]
                                    * jnp.pad(onehot, (0, 1)).astype(jnp.int32)[None, :]),
         )
-        win_score = jnp.where(any_feasible, top, NEG)
+        win_score = jnp.where(any_feasible, top, jnp.int32(NEG))
         return carry, (chosen, win_score)
 
     xs = (static_mask, inp.req_cpu, inp.req_mem, inp.pod_ports, inp.pod_pds,

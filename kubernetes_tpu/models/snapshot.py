@@ -29,7 +29,6 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from kubernetes_tpu.api import labels as labels_pkg
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.scheduler.generic import fnv1a64, pod_tie_break_key
 from kubernetes_tpu.scheduler.predicates import get_resource_request
@@ -226,23 +225,30 @@ def encode_snapshot(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
     # (spreading.go:44). Group membership of *any* pod (existing or committed)
     # is: same namespace + selector match.
     services = list(services)
-    svc_selectors = [labels_pkg.selector_from_set(s.spec.selector or {})
-                     for s in services]
+    # set-based service selectors reduce to (k,v)-subset checks; doing the
+    # subset test on frozensets directly (instead of Selector.matches per
+    # pod x group) is the encode hot path at 10k-pod waves
+    svc_items = [frozenset((s.spec.selector or {}).items()) for s in services]
     group_ids: Dict[Tuple[str, int], int] = {}
     pod_gid = np.full(P, -1, np.int32)
 
-    def first_service_for(p: api.Pod) -> Optional[int]:
+    def pod_items(p: api.Pod):
+        return set((p.metadata.labels or {}).items())
+
+    pending_items = [pod_items(p) for p in pending_pods]
+
+    def first_service_for(p: api.Pod, items) -> Optional[int]:
         for si, s in enumerate(services):
             if s.metadata.namespace and s.metadata.namespace != p.metadata.namespace:
                 continue
-            if not (s.spec.selector or {}):
+            if not svc_items[si]:
                 continue
-            if svc_selectors[si].matches(p.metadata.labels):
+            if svc_items[si] <= items:
                 return si
         return None
 
     for j, p in enumerate(pending_pods):
-        si = first_service_for(p)
+        si = first_service_for(p, pending_items[j])
         if si is None:
             continue
         key = (p.metadata.namespace, si)
@@ -257,16 +263,18 @@ def encode_snapshot(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
     G = max(1, len(group_ids))
     group_counts = np.zeros((G, N + 1), np.int32)
     pod_group_member = np.zeros((P, G), bool)
-    for (ns, si), g in group_ids.items():
-        sel = svc_selectors[si]
-        for p in existing_pods:
-            if p.metadata.namespace != ns or not sel.matches(p.metadata.labels):
-                continue
-            i = node_index.get(p.status.host, N)  # unknown/unassigned -> slot N
-            group_counts[g, i] += 1
-        for j, p in enumerate(pending_pods):
-            if p.metadata.namespace == ns and sel.matches(p.metadata.labels):
-                pod_group_member[j, g] = True
+    if group_ids:
+        existing_items = [(p, pod_items(p)) for p in existing_pods]
+        for (ns, si), g in group_ids.items():
+            sel = svc_items[si]
+            for p, items in existing_items:
+                if p.metadata.namespace != ns or not sel <= items:
+                    continue
+                i = node_index.get(p.status.host, N)  # unknown host -> slot N
+                group_counts[g, i] += 1
+            for j, p in enumerate(pending_pods):
+                if p.metadata.namespace == ns and sel <= pending_items[j]:
+                    pod_group_member[j, g] = True
 
     return ClusterSnapshot(
         node_names=[n.metadata.name for n in nodes],

@@ -4,6 +4,12 @@ These are the TPU-side twins of the serial scoring functions — each one
 cites the exact reference semantics it reproduces. Kept in ops/ so the
 solver (models/batch_solver.py) reads as orchestration and the kernels are
 individually testable against their serial counterparts.
+
+Dtype policy: kernels follow their input dtypes. The solver feeds int32
+whenever the encoded wave fits (TPU v5e has no native int64 — every i64
+lane op is emulated as multiple i32 ops), falling back to int64 for
+clusters whose byte capacities don't reduce. Scores are always small
+(0..10 x weights) and returned in the resource dtype.
 """
 
 from __future__ import annotations
@@ -18,10 +24,15 @@ __all__ = ["calculate_score", "spread_score", "u64_mod_small",
 def calculate_score(requested: jnp.ndarray, capacity: jnp.ndarray) -> jnp.ndarray:
     """LeastRequested per-dimension score: integer ((cap-req)*10)//cap with 0
     on zero or exceeded capacity (ref: pkg/scheduler/priorities.go:27-37;
-    serial twin kubernetes_tpu.scheduler.priorities.calculate_score)."""
+    serial twin kubernetes_tpu.scheduler.priorities.calculate_score).
+
+    Exact in any integer dtype wide enough for capacity*10: floor division
+    is invariant under the common scaling the encoder applies."""
     safe_cap = jnp.where(capacity == 0, 1, capacity)
-    score = ((capacity - requested) * 10) // safe_cap
-    return jnp.where((capacity == 0) | (requested > capacity), 0, score).astype(jnp.int64)
+    ten = jnp.asarray(10, capacity.dtype)
+    score = ((capacity - requested) * ten) // safe_cap
+    zero = jnp.asarray(0, capacity.dtype)
+    return jnp.where((capacity == 0) | (requested > capacity), zero, score)
 
 
 def spread_score(total: jnp.ndarray, counts: jnp.ndarray) -> jnp.ndarray:
@@ -30,13 +41,17 @@ def spread_score(total: jnp.ndarray, counts: jnp.ndarray) -> jnp.ndarray:
     serial twin kubernetes_tpu.scheduler.priorities.spread_score_f32)."""
     div = (total - counts).astype(jnp.float32) / total.astype(jnp.float32)
     fscore = jnp.float32(10) * div
-    return jnp.where(total > 0, fscore.astype(jnp.int64), jnp.int64(10))
+    return jnp.where(total > 0, fscore.astype(jnp.int32), jnp.int32(10))
 
 
 def u64_mod_small(hi: jnp.ndarray, lo: jnp.ndarray, m: jnp.ndarray) -> jnp.ndarray:
     """(hi*2^32 + lo) % m using only int64 ops (m < 2^31 so every partial
     product fits). The tie-break hash is FNV-1a-64 computed host-side and
-    shipped as (hi, lo) int64 halves — TPU has no native u64 modulo."""
+    shipped as (hi, lo) int64 halves — TPU has no native u64 modulo.
+    Scalar per scan step, so the emulated-i64 cost is negligible."""
+    hi = hi.astype(jnp.int64)
+    lo = lo.astype(jnp.int64)
+    m = m.astype(jnp.int64)
     two32_mod = jnp.int64(1 << 32) % m
     return ((hi % m) * two32_mod + lo % m) % m
 
@@ -46,14 +61,14 @@ def masked_top_count(masked_scores: jnp.ndarray, sentinel) -> tuple:
     the vector form of sort-desc + getBestHosts
     (ref: generic_scheduler.go:84-112)."""
     top = jnp.max(masked_scores)
-    any_valid = top > sentinel
+    any_valid = top > jnp.asarray(sentinel, masked_scores.dtype)
     best = masked_scores == top
-    count = jnp.maximum(jnp.sum(best.astype(jnp.int64)), 1)
+    count = jnp.maximum(jnp.sum(best.astype(jnp.int32)), 1)
     return top, any_valid, best, count
 
 
 def select_kth_true(mask: jnp.ndarray, k: jnp.ndarray) -> jnp.ndarray:
     """Index of the (k+1)-th True in mask, in index order — the deterministic
     replacement for the reference's rand.Int()%len(bestHosts) choice."""
-    cum = jnp.cumsum(mask.astype(jnp.int64))
-    return jnp.argmax((cum == k + 1) & mask).astype(jnp.int32)
+    cum = jnp.cumsum(mask.astype(jnp.int32))
+    return jnp.argmax((cum == k.astype(jnp.int32) + 1) & mask).astype(jnp.int32)
