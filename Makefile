@@ -1,0 +1,25 @@
+# Build/test entry points (ref: the reference's root Makefile wrapping
+# hack/*.sh).
+
+.PHONY: all test bench bench-smoke native ui clean
+
+all: native ui
+
+test:
+	hack/test.sh
+
+bench:
+	hack/benchmark.sh
+
+bench-smoke:
+	hack/benchmark.sh --smoke
+
+native:
+	$(MAKE) -C native
+
+ui:
+	python hack/embed-ui.py
+
+clean:
+	$(MAKE) -C native clean
+	find . -name __pycache__ -type d -prune -exec rm -rf {} +

@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Bring up a local all-in-one cluster (ref: cluster/kube-up.sh + hack's
+# local-up-cluster; the cloud provider scripts' slot — gce/aws/azure — is
+# filled by the 'local' provider since this framework targets TPU pods,
+# not cloud VMs).
+#
+# Usage: cluster/local-up.sh [port] [nodes]
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+PORT="${1:-8080}"
+NODES="${2:-2}"
+
+echo "Starting kubernetes-tpu standalone: apiserver :${PORT}, ${NODES} nodes"
+echo "  dashboard: http://127.0.0.1:${PORT}/ui/"
+echo "  kubectl:   python -m kubernetes_tpu.cmd.hyperkube kubectl --namespace default get pods"
+exec python -m kubernetes_tpu.cmd.standalone --port "${PORT}" --nodes "${NODES}"

@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Bring up the control plane as SEPARATE processes — apiserver,
+# controller-manager, scheduler, one kubelet — wired only through HTTP,
+# the way the reference deploys its binaries (ref: cluster/saltbase
+# service layout). Ctrl-C tears everything down.
+#
+# Usage: cluster/multi-process-up.sh [port]
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+PORT="${1:-8080}"
+MASTER="http://127.0.0.1:${PORT}"
+PIDS=()
+cleanup() { kill "${PIDS[@]}" 2>/dev/null || true; }
+trap cleanup EXIT INT TERM
+
+python -m kubernetes_tpu.cmd.apiserver --port "${PORT}" &
+PIDS+=($!)
+sleep 1
+python -m kubernetes_tpu.cmd.controller_manager --master "${MASTER}" &
+PIDS+=($!)
+python -m kubernetes_tpu.cmd.scheduler --master "${MASTER}" &
+PIDS+=($!)
+python -m kubernetes_tpu.cmd.kubelet --api-servers "${MASTER}" \
+    --hostname-override "$(hostname)" --register-node --port 10250 \
+    --root-dir /tmp/kubelet-tpu &
+PIDS+=($!)
+
+echo "control plane up: ${MASTER} (Ctrl-C to stop)"
+wait

@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Run the scheduler benchmark (ref: hack/benchmark-go.sh).
+# --smoke forces CPU + small shapes; default runs the full 10k x 5k wave
+# on whatever accelerator jax finds.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python bench.py "$@"

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Run the unit/integration suite (ref: hack/test-go.sh). Like the
+# reference's KUBE_TEST_API_VERSIONS loop, the suite can be run once per
+# external API version: TEST_API_VERSIONS=v1,v1beta1 hack/test.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+VERSIONS="${TEST_API_VERSIONS:-v1}"
+rc=0
+for v in ${VERSIONS//,/ }; do
+    echo "=== test run with KUBE_TEST_API_VERSION=${v} ==="
+    KUBE_TEST_API_VERSION="$v" python -m pytest tests/ -q "$@" || rc=$?
+done
+exit "$rc"

@@ -180,9 +180,24 @@ class _Handler(BaseHTTPRequestHandler):
 
         if not parts:
             self._send_json(200, json.dumps(
-                {"paths": ["/api", "/healthz", "/metrics", "/validate", "/version"]}))
+                {"paths": ["/api", "/healthz", "/metrics", "/ui/",
+                           "/validate", "/version"]}))
             return 200
         head = parts[0]
+        if head in ("ui", "static"):  # ref: pkg/ui served at /static/
+            if method != "GET":
+                raise errors.new_method_not_supported("asset", method)
+            from kubernetes_tpu.ui import asset
+            found = asset("/".join(parts[1:]))
+            if found is None:
+                raise errors.new_not_found("asset", "/".join(parts[1:]))
+            body, ctype = found
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return 200
         if head == "healthz":
             return self._handle_healthz(parts[1:])
         if head == "version":

@@ -45,6 +45,11 @@ def _standalone(argv):
     return standalone_server(argv)
 
 
+def _version_change(argv):
+    from kubernetes_tpu.cmd.version_change import version_change
+    return version_change(argv)
+
+
 SERVERS = {
     "apiserver": _apiserver,
     "kube-apiserver": _apiserver,
@@ -58,6 +63,8 @@ SERVERS = {
     "kubectl": _kubectl,
     "standalone": _standalone,
     "kubernetes": _standalone,
+    "version-change": _version_change,
+    "kube-version-change": _version_change,
 }
 
 

@@ -1,0 +1,10 @@
+"""Embedded web UI (ref: pkg/ui/datafile.go — go-bindata-embedded static
+assets served at /static/; source under www/).
+
+``asset(path)`` returns (bytes, content_type) for an embedded file; the
+apiserver mounts the set at /ui/. The dashboard is a single self-contained
+page polling the JSON API — the spiritual successor of www/app's cluster
+view, small enough to embed as the reference embeds its build output.
+"""
+
+from kubernetes_tpu.ui.datafile import ASSETS, asset  # noqa: F401

@@ -1,0 +1,99 @@
+"""Web UI serving + version-change tool + deploy script sanity
+(model: the reference ships pkg/ui datafile serving and
+cmd/kube-version-change with basic round-trip coverage)."""
+
+import io
+import json
+import os
+import stat
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.apiserver.http import APIServer
+from kubernetes_tpu.apiserver.master import Master
+
+ROOT = os.path.join(os.path.dirname(__file__), "..")
+
+
+@pytest.fixture()
+def http_server():
+    srv = APIServer(Master(), port=0).start()
+    yield srv
+    srv.stop()
+
+
+def test_ui_served(http_server):
+    base = http_server.base_url
+    with urllib.request.urlopen(base + "/ui/", timeout=5) as r:
+        body = r.read()
+        assert r.headers["Content-Type"].startswith("text/html")
+        assert b"dashboard" in body
+    # /static/ alias (ref: pkg/ui served at /static/)
+    with urllib.request.urlopen(base + "/static/index.html", timeout=5) as r:
+        assert b"dashboard" in r.read()
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(base + "/ui/missing.js", timeout=5)
+    assert e.value.code == 404
+
+
+def test_ui_listed_in_root_paths(http_server):
+    with urllib.request.urlopen(http_server.base_url + "/", timeout=5) as r:
+        assert "/ui/" in json.loads(r.read())["paths"]
+
+
+def test_datafile_matches_www():
+    """The embedded datafile must be regenerated when www/ changes."""
+    from kubernetes_tpu.ui import asset
+    with open(os.path.join(ROOT, "www", "index.html"), "rb") as f:
+        src = f.read()
+    embedded, ctype = asset("index.html")
+    assert embedded == src, "run hack/embed-ui.py: datafile is stale"
+    assert ctype == "text/html"
+
+
+def test_version_change_round_trip():
+    from kubernetes_tpu.cmd.version_change import version_change
+
+    pod_v1 = {"kind": "Pod", "apiVersion": "v1",
+              "metadata": {"name": "x", "namespace": "d",
+                           "labels": {"a": "b"}},
+              "spec": {"containers": [{"name": "c", "image": "i"}]}}
+    out = io.StringIO()
+    rc = version_change(["--version", "v1beta1"],
+                        stdin=io.StringIO(json.dumps(pod_v1)), stdout=out)
+    assert rc == 0
+    beta = json.loads(out.getvalue())
+    assert beta["apiVersion"] == "v1beta1"
+    assert beta["id"] == "x"          # v1beta1 flattens metadata, name -> id
+    assert "metadata" not in beta
+
+    # and back
+    out2 = io.StringIO()
+    rc = version_change(["--version", "v1"],
+                        stdin=io.StringIO(json.dumps(beta)), stdout=out2)
+    assert rc == 0
+    v1 = json.loads(out2.getvalue())
+    assert v1["metadata"]["name"] == "x"
+    assert v1["metadata"]["labels"] == {"a": "b"}
+
+
+def test_version_change_bad_input():
+    from kubernetes_tpu.cmd.version_change import version_change
+    out = io.StringIO()
+    rc = version_change([], stdin=io.StringIO('{"kind": "Nope"}'), stdout=out)
+    assert rc == 1
+
+
+def test_hyperkube_knows_version_change():
+    from kubernetes_tpu.cmd.hyperkube import SERVERS
+    assert "version-change" in SERVERS and "kube-version-change" in SERVERS
+
+
+def test_deploy_scripts_executable():
+    for rel in ("cluster/local-up.sh", "cluster/multi-process-up.sh",
+                "hack/test.sh", "hack/benchmark.sh"):
+        path = os.path.join(ROOT, rel)
+        assert os.path.exists(path), rel
+        assert os.stat(path).st_mode & stat.S_IXUSR, f"{rel} not executable"
