@@ -17,7 +17,11 @@ __all__ = ["scheme", "VERSIONS", "LATEST_VERSION", "new_scheme"]
 
 LATEST_VERSION = "v1"
 OLDEST_VERSION = "v1beta1"
-VERSIONS = ("v1", "v1beta1")
+# v1beta2 shares v1beta1's flattened-metadata wire shape — in the reference
+# the two differ only in minor defaulting (ref: pkg/api/v1beta2/ is
+# generated from v1beta1 with small deltas); v1beta3 introduced the nested
+# metadata that became v1, which is our "v1" here.
+VERSIONS = ("v1", "v1beta1", "v1beta2")
 
 _ALL_KINDS = (
     api.Pod, api.PodList,
@@ -83,9 +87,11 @@ def new_scheme() -> Scheme:
     s = Scheme(default_version=LATEST_VERSION)
     s.add_known_types("v1", *_ALL_KINDS)
     s.add_known_types("v1beta1", *_ALL_KINDS)
+    s.add_known_types("v1beta2", *_ALL_KINDS)
     for t in _ALL_KINDS:
         kind = getattr(t, "kind", t.__name__) or t.__name__
         s.add_conversion("v1beta1", kind, _v1beta1_encode, _v1beta1_decode)
+        s.add_conversion("v1beta2", kind, _v1beta1_encode, _v1beta1_decode)
     return s
 
 

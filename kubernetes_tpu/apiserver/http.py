@@ -123,6 +123,9 @@ class _Handler(BaseHTTPRequestHandler):
         apisrv = self.server.api  # type: ignore[attr-defined]
         started = time.monotonic()
         parsed = urllib.parse.urlsplit(self.path)
+        # handlers use the single-value view; the node/pod proxy forwards
+        # the raw pairs so repeated params (exec argv) survive
+        self._raw_query_pairs = urllib.parse.parse_qsl(parsed.query)
         query = {k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()}
         parts = [p for p in parsed.path.split("/") if p]
         code = 200
@@ -364,9 +367,12 @@ class _Handler(BaseHTTPRequestHandler):
         if location is None:
             raise errors.new_not_found(resource, name)
         target = f"http://{location}/" + "/".join(tail)
-        fwd_query = {k: v for k, v in query.items() if k != "namespace"}
-        if fwd_query:  # forward the original query string (ref: proxy.go)
-            target += "?" + urllib.parse.urlencode(fwd_query)
+        # forward the original query pairs (ref: proxy.go) — repeated keys
+        # (e.g. exec's cmd= argv) must survive verbatim
+        fwd_pairs = [(k, v) for k, v in getattr(self, "_raw_query_pairs", [])
+                     if k != "namespace"]
+        if fwd_pairs:
+            target += "?" + urllib.parse.urlencode(fwd_pairs)
         if mode == "redirect":
             self.send_response(307)
             self.send_header("Location", target)
@@ -374,17 +380,21 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             return 307
         try:
-            with urllib.request.urlopen(target, timeout=10) as resp:
-                body = resp.read()
-                self.send_response(resp.status)
-                self.send_header("Content-Type",
-                                 resp.headers.get("Content-Type", "text/plain"))
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-                return resp.status
+            resp = urllib.request.urlopen(target, timeout=10)
+        except urllib.error.HTTPError as e:
+            resp = e  # backend errors relay verbatim (exec exit!=0 is a 500)
         except Exception as e:
             raise errors.new_internal_error(f"proxy to {target} failed: {e}")
+        with resp:
+            body = resp.read()
+            status = resp.status if hasattr(resp, "status") else resp.code
+            self.send_response(status)
+            self.send_header("Content-Type",
+                             resp.headers.get("Content-Type", "text/plain"))
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return status
 
 
 class APIServer:

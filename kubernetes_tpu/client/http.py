@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import base64
 import http.client
+import os
 import json
 import socket
 import threading
@@ -38,7 +39,10 @@ class HTTPTransport:
                  insecure_skip_tls_verify: bool = False):
         self.base_url = base_url.rstrip("/")
         self.scheme = scheme or default_scheme
-        self.version = version or self.scheme.default_version
+        # KUBE_TEST_API_VERSION runs the whole suite over a chosen wire
+        # version (ref: hack/test-go.sh KUBE_TEST_API_VERSIONS loop)
+        self.version = version or os.environ.get("KUBE_TEST_API_VERSION", "") \
+            or self.scheme.default_version
         self.timeout = timeout
         self.ssl_context = None
         if base_url.startswith("https") or ca_cert or client_cert \

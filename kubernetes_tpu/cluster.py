@@ -166,12 +166,39 @@ class Cluster:
         with urllib.request.urlopen(url, timeout=5) as r:
             return r.read().decode()
 
+    def pod_exec(self, namespace: str, name: str, container: str,
+                 command) -> tuple:
+        """-> (exit_code, output) through the owning node's /run endpoint
+        (kubectl exec path); nonzero exit arrives as a 500 whose body is
+        the command output."""
+        import urllib.error
+        import urllib.parse
+        import urllib.request
+
+        pod = self.client.pods(namespace).get(name)
+        host = pod.spec.host or pod.status.host
+        handle = self.nodes.get(host)
+        if handle is None or handle.server is None:
+            raise RuntimeError("exec needs kubelet HTTP servers "
+                               "(ClusterConfig.kubelet_http)")
+        container = container or pod.spec.containers[0].name
+        qs = urllib.parse.urlencode([("cmd", c) for c in command])
+        url = (f"http://127.0.0.1:{handle.server.port}"
+               f"/run/{namespace}/{name}/{container}?{qs}")
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                return 0, r.read().decode()
+        except urllib.error.HTTPError as e:
+            return 1, e.read().decode()
+
     def kubectl_factory(self, out=None, err=None):
         """A kubectl Factory bound to this cluster (in-process client +
-        kubelet log source)."""
+        kubelet log/exec/port-forward sources)."""
         from kubernetes_tpu.kubectl.cmd import Factory
         return Factory(self.client, out=out, err=err,
-                       pod_logs=self.pod_logs)
+                       pod_logs=self.pod_logs,
+                       pod_exec=self.pod_exec,
+                       node_locator=self.node_locator)
 
     def stop(self) -> None:
         if self._scheduler is not None:

@@ -40,7 +40,10 @@ class Factory:
 
     def __init__(self, client, scheme=None, mapper=None,
                  out=None, err=None, stdin=None,
-                 pod_logs: Optional[Callable[[str, str, str], str]] = None):
+                 pod_logs: Optional[Callable[[str, str, str], str]] = None,
+                 pod_exec: Optional[Callable] = None,
+                 node_locator: Optional[Callable[[str], Optional[str]]] = None,
+                 apiserver_url: str = ""):
         self.client = client
         self.scheme = scheme or default_scheme
         self.mapper = mapper or default_rest_mapper()
@@ -48,6 +51,12 @@ class Factory:
         self.err = err or sys.stderr
         self.stdin = stdin or sys.stdin
         self._pod_logs = pod_logs
+        self._pod_exec = pod_exec
+        self._node_locator = node_locator
+        # base URL of the API server, for proxy/exec-over-HTTP; derived
+        # from an HTTPTransport when not given explicitly
+        self.apiserver_url = apiserver_url or \
+            getattr(getattr(client, "transport", None), "base_url", "")
 
     def builder(self, ns: str = "") -> Builder:
         b = Builder(self.scheme, self.mapper)
@@ -56,13 +65,67 @@ class Factory:
         return b
 
     def pod_logs(self, namespace: str, name: str, container: str = "") -> str:
-        """Wired to the node's log endpoint by the cluster harness
+        """Wired to the node's log endpoint by the cluster harness, or via
+        the apiserver node proxy over HTTP
         (ref: kubectl/cmd/log.go fetches via apiserver /proxy/minions/...)."""
-        if self._pod_logs is None:
-            raise KubectlError(
-                "log: no node log source configured (requires a running "
-                "cluster with kubelet read-only servers)")
-        return self._pod_logs(namespace, name, container)
+        if self._pod_logs is not None:
+            return self._pod_logs(namespace, name, container)
+        if self.apiserver_url:
+            import urllib.request
+            pod = self.client.resource("pods", namespace).get(name)
+            host = pod.spec.host or pod.status.host
+            if not host:
+                raise KubectlError(f"pod {name} is not scheduled")
+            container = container or pod.spec.containers[0].name
+            url = (f"{self.apiserver_url}/api/{self.scheme.default_version}"
+                   f"/proxy/nodes/{host}/containerLogs/{namespace}/{name}/"
+                   f"{container}")
+            with urllib.request.urlopen(url, timeout=10) as r:
+                return r.read().decode()
+        raise KubectlError(
+            "log: no node log source configured (requires a running "
+            "cluster with kubelet read-only servers)")
+
+    def pod_exec(self, namespace: str, name: str, container: str,
+                 command) -> tuple:
+        """-> (exit_code, output). ref: kubectl/cmd/exec.go — runs through
+        the node's /run endpoint (the SPDY-exec slot), reached via the
+        apiserver node proxy; a nonzero exit arrives as a 500 whose body is
+        still the command output."""
+        if self._pod_exec is not None:
+            return self._pod_exec(namespace, name, container, command)
+        if self.apiserver_url:
+            import urllib.error
+            import urllib.parse
+            import urllib.request
+            pod = self.client.resource("pods", namespace).get(name)
+            host = pod.spec.host or pod.status.host
+            if not host:
+                raise KubectlError(f"pod {name} is not scheduled")
+            container = container or pod.spec.containers[0].name
+            qs = urllib.parse.urlencode([("cmd", c) for c in command])
+            url = (f"{self.apiserver_url}/api/{self.scheme.default_version}"
+                   f"/proxy/nodes/{host}/run/{namespace}/{name}/"
+                   f"{container}?{qs}")
+            try:
+                with urllib.request.urlopen(url, timeout=30) as r:
+                    return 0, r.read().decode()
+            except urllib.error.HTTPError as e:
+                return 1, e.read().decode()
+        raise KubectlError("exec: no node exec path configured")
+
+    def kubelet_address(self, namespace: str, pod_name: str) -> tuple:
+        """-> (host, "addr:port" of its kubelet) for port-forward."""
+        pod = self.client.resource("pods", namespace).get(pod_name)
+        host = pod.spec.host or pod.status.host
+        if not host:
+            raise KubectlError(f"pod {pod_name} is not scheduled")
+        if self._node_locator is not None:
+            loc = self._node_locator(host)
+            if loc:
+                return host, loc
+        raise KubectlError(
+            "port-forward: no kubelet locator configured")
 
 
 def _build_parser() -> argparse.ArgumentParser:
@@ -149,6 +212,26 @@ def _build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--filename", "-f", required=True)
     sp.add_argument("--update-period", type=float, default=0.0)
     sp.add_argument("--timeout", type=float, default=60.0)
+
+    sp = sub.add_parser("exec", exit_on_error=False)
+    sp.add_argument("--pod", "-p", default="")
+    sp.add_argument("--container", "-c", default="")
+    sp.add_argument("words", nargs="*",
+                    help="[POD] -- COMMAND [args...] (v0 form: -p POD CMD)")
+
+    sp = sub.add_parser("port-forward", exit_on_error=False)
+    sp.add_argument("--pod", "-p", default="")
+    sp.add_argument("words", nargs="+",
+                    help="[POD] LOCAL_PORT:POD_PORT [...]")
+    sp.add_argument("--once", action="store_true",
+                    help="serve one connection then exit (tests)")
+
+    sp = sub.add_parser("proxy", exit_on_error=False)
+    sp.add_argument("--port", type=int, default=8001)
+    sp.add_argument("--www", default="", help="ignored; parity flag")
+    sp.add_argument("--api-prefix", default="/api")
+    sp.add_argument("--once", action="store_true",
+                    help="serve one request then exit (tests)")
 
     sub.add_parser("version", exit_on_error=False)
     sub.add_parser("api-versions", exit_on_error=False)
@@ -405,6 +488,191 @@ def _cmd_rolling_update(f: Factory, ns: str, opts) -> int:
     return 0
 
 
+def _cmd_exec(f: Factory, ns: str, opts) -> int:
+    """ref: cmd/exec.go — `exec -p POD -c CONTAINER CMD...` or
+    `exec POD -- CMD...`."""
+    words = list(opts.words)
+    pod = opts.pod
+    if not pod:
+        if not words:
+            raise KubectlError("exec: pod name required")
+        pod = words.pop(0)
+    if not words:
+        raise KubectlError("exec: command required")
+    code, out = f.pod_exec(ns or "default", pod, opts.container, words)
+    f.out.write(out)
+    return 0 if code == 0 else 1
+
+
+def _cmd_port_forward(f: Factory, ns: str, opts) -> int:
+    """ref: cmd/portforward.go — local listener tunneling to the pod's port
+    through the kubelet's stream-upgrade endpoint."""
+    import socket
+    import threading
+
+    from kubernetes_tpu.util.stream import relay_bidirectional
+
+    words = list(opts.words)
+    pod = opts.pod
+    if not pod:
+        pod = words.pop(0)
+    if not words:
+        raise KubectlError("port-forward: PORT or LOCAL:POD mapping required")
+    mappings = []
+    for w in words:
+        local_s, _, remote_s = w.partition(":")
+        local_port = int(local_s)
+        mappings.append((local_port, int(remote_s) if remote_s else local_port))
+    host, kubelet_addr = f.kubelet_address(ns or "default", pod)
+    khost, _, kport = kubelet_addr.rpartition(":")
+
+    def tunnel(conn, pod_port) -> bool:
+        backend = None
+        try:
+            backend = socket.create_connection((khost, int(kport)), timeout=10)
+            req = (f"POST /portForward/{ns or 'default'}/{pod}?port={pod_port} "
+                   f"HTTP/1.1\r\nHost: {kubelet_addr}\r\n"
+                   f"Content-Length: 0\r\n\r\n").encode()
+            backend.sendall(req)
+            buf = b""
+            while b"\r\n\r\n" not in buf:  # read the upgrade response
+                chunk = backend.recv(1024)
+                if not chunk:
+                    f.err.write("port-forward: kubelet closed the tunnel\n")
+                    return False
+                buf += chunk
+            status_line = buf.split(b"\r\n", 1)[0]
+            if b"101" not in status_line:
+                f.err.write(f"port-forward: kubelet refused the tunnel: "
+                            f"{status_line.decode(errors='replace')}\n")
+                return False
+            extra = buf.split(b"\r\n\r\n", 1)[1]
+            if extra:
+                conn.sendall(extra)
+            relay_bidirectional(conn, backend, idle_timeout=60.0)
+            return True
+        except OSError as e:
+            f.err.write(f"port-forward: {e}\n")
+            return False
+        finally:
+            conn.close()
+            if backend is not None:
+                backend.close()
+
+    listeners = []
+    for local_port, pod_port in mappings:
+        listener = socket.socket()
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind(("127.0.0.1", local_port))
+        listener.listen(8)
+        bound = listener.getsockname()[1]
+        listeners.append((listener, pod_port))
+        f.out.write(f"Forwarding from 127.0.0.1:{bound} -> {pod}:{pod_port} "
+                    f"(node {host})\n")
+    f.out.flush()
+
+    def serve(listener, pod_port, once_result=None):
+        while True:
+            try:
+                conn, _addr = listener.accept()
+            except OSError:
+                return
+            ok = tunnel(conn, pod_port)
+            if once_result is not None:
+                once_result.append(ok)
+                return
+
+    try:
+        if opts.once:
+            # serve exactly one connection on the first mapping (tests)
+            result: list = []
+            serve(listeners[0][0], listeners[0][1], result)
+            return 0 if result and result[0] else 1
+        threads = [threading.Thread(target=serve, args=(l, p), daemon=True)
+                   for l, p in listeners]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return 0
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        for listener, _ in listeners:
+            listener.close()
+
+
+def _cmd_proxy(f: Factory, opts) -> int:
+    """ref: cmd/proxy.go — local HTTP proxy to the apiserver."""
+    import http.server
+    import urllib.error
+    import urllib.request
+
+    if not f.apiserver_url:
+        raise KubectlError("proxy requires an HTTP API server connection")
+    base = f.apiserver_url
+    prefix = opts.api_prefix
+
+    class H(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def _relay(self):
+            if not self.path.startswith(prefix):
+                body = b"404: only " + prefix.encode() + b" is proxied\n"
+                self.send_response(404)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            n = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(n) if n else None
+            req = urllib.request.Request(base + self.path, data=body,
+                                         method=self.command)
+            if body is not None:
+                req.add_header("Content-Type",
+                               self.headers.get("Content-Type",
+                                                "application/json"))
+            try:
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    payload = r.read()
+                    self.send_response(r.status)
+                    ctype = r.headers.get("Content-Type", "application/json")
+            except urllib.error.HTTPError as e:
+                payload = e.read()
+                self.send_response(e.code)
+                ctype = e.headers.get("Content-Type", "application/json")
+            except (urllib.error.URLError, OSError) as e:
+                # apiserver unreachable -> a clean 502, not a dropped socket
+                payload = f"502: apiserver unreachable: {e}\n".encode()
+                self.send_response(502)
+                ctype = "text/plain; charset=utf-8"
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        do_GET = do_POST = do_PUT = do_DELETE = do_PATCH = _relay
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", opts.port), H)
+    f.out.write(f"Starting to serve on 127.0.0.1:"
+                f"{httpd.server_address[1]}\n")
+    f.out.flush()
+    try:
+        if opts.once:
+            httpd.timeout = 30
+            httpd.handle_request()
+        else:
+            httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.server_close()
+    return 0
+
+
 def run_kubectl(argv: List[str], factory: Factory) -> int:
     """Parse + execute; returns a process exit code. All output goes to the
     factory's out/err streams (testable like cmd_test.go)."""
@@ -448,6 +716,12 @@ def run_kubectl(argv: List[str], factory: Factory) -> int:
         if opts.command == "log":
             f.out.write(f.pod_logs(ns or "default", opts.pod, opts.container))
             return 0
+        if opts.command == "exec":
+            return _cmd_exec(f, ns, opts)
+        if opts.command == "port-forward":
+            return _cmd_port_forward(f, ns, opts)
+        if opts.command == "proxy":
+            return _cmd_proxy(f, opts)
         if opts.command in ("run-container", "run"):
             return _cmd_run(f, ns, opts)
         if opts.command == "expose":
@@ -485,10 +759,35 @@ def run_kubectl(argv: List[str], factory: Factory) -> int:
         return 1
 
 
+class _NoClusterClient:
+    """Placeholder client when no kubeconfig resolves — commands that never
+    touch the server (config, version) still work; anything else gets a
+    clear error instead of a traceback."""
+
+    transport = None  # Factory introspects this attribute at construction
+
+    def __init__(self, reason: str):
+        self.reason = reason
+
+    def resource(self, *a, **kw):
+        raise KubectlError(
+            f"no cluster configured: {self.reason} "
+            f"(set one up with 'kubectl config set-cluster ...')")
+
+    def __getattr__(self, name):
+        raise KubectlError(
+            f"no cluster configured: {self.reason} "
+            f"(set one up with 'kubectl config set-cluster ...')")
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     """Entry point for the real binary: connects over HTTP using kubeconfig
-    (ref: cmd/kubectl/kubectl.go)."""
-    from kubernetes_tpu.client.clientcmd import client_from_config
-    client = client_from_config()
+    (ref: cmd/kubectl/kubectl.go). kubeconfig resolution is lazy-tolerant:
+    `kubectl config ...` must work before any cluster is configured."""
+    from kubernetes_tpu.client.clientcmd import ConfigError, client_from_config
+    try:
+        client = client_from_config()
+    except ConfigError as e:
+        client = _NoClusterClient(str(e))
     return run_kubectl(argv if argv is not None else sys.argv[1:],
                        Factory(client))

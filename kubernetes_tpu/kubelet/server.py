@@ -21,7 +21,6 @@ from __future__ import annotations
 
 import json
 import os
-import select
 import socket
 import threading
 import urllib.parse
@@ -32,6 +31,7 @@ from kubernetes_tpu.api import types as api
 from kubernetes_tpu.api.latest import scheme as default_scheme
 from kubernetes_tpu.kubelet.stats import ProcStatsProvider, StatsProvider
 from kubernetes_tpu.runtime.serialize import to_wire
+from kubernetes_tpu.util.stream import relay_bidirectional
 from kubernetes_tpu.util import metrics as metricspkg
 
 __all__ = ["KubeletServer"]
@@ -234,19 +234,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Connection", "Upgrade")
         self.end_headers()
         self.wfile.flush()
-        conn = self.connection
         try:
-            while True:
-                readable, _, _ = select.select([conn, backend], [], [], 30.0)
-                if not readable:
-                    break
-                for s in readable:
-                    data = s.recv(65536)
-                    if not data:
-                        return
-                    (backend if s is conn else conn).sendall(data)
-        except OSError:
-            pass
+            relay_bidirectional(self.connection, backend, idle_timeout=30.0)
         finally:
             backend.close()
             self.close_connection = True

@@ -92,9 +92,15 @@ class TestCRUD:
 
     def test_patch(self, client):
         client.pods().create(make_pod("pp", labels={"a": "1"}))
+        # a merge patch is expressed in the wire shape of the version it is
+        # POSTed against — v1beta1 flattens labels to the top level
+        # (ref: resthandler.go PatchResource patches the versioned object)
+        if client.transport.version in ("v1beta1", "v1beta2"):
+            body = {"labels": {"b": "2"}}
+        else:
+            body = {"metadata": {"labels": {"b": "2"}}}
         out = client.transport.request(
-            "patch", "pods", namespace="default", name="pp",
-            body={"metadata": {"labels": {"b": "2"}}})
+            "patch", "pods", namespace="default", name="pp", body=body)
         assert out.metadata.labels == {"a": "1", "b": "2"}
 
     def test_keepalive_survives_delete_with_body(self, server):

@@ -205,6 +205,166 @@ def test_metrics_endpoint(server):
     assert b"kubelet_sync_total" in body
 
 
+def test_kubectl_exec_and_port_forward_through_cluster():
+    """kubectl exec + port-forward via the kubelet endpoints
+    (ref: cmd/exec.go, cmd/portforward.go over the SPDY slot)."""
+    import io
+
+    from kubernetes_tpu.cluster import Cluster, ClusterConfig
+    from kubernetes_tpu.kubectl.cmd import run_kubectl
+
+    cluster = Cluster(ClusterConfig(num_nodes=1, kubelet_http=True)).start()
+    try:
+        cluster.client.pods("default").create(mkpod())
+        # the cluster's scheduler binds it; racing a manual Binding would
+        # 409 against the CAS guard
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if cluster.client.pods("default").get("web").spec.host:
+                break
+            time.sleep(0.05)
+        handle = cluster.nodes["node-0"]
+        wait_for_container(handle.runtime, "u-1", "c")
+        handle.runtime.exec_results[("c", ("cat", "/etc/hostname"))] = \
+            (0, "web-host\n")
+
+        out, err = io.StringIO(), io.StringIO()
+        factory = cluster.kubectl_factory(out=out, err=err)
+        rc = run_kubectl(["exec", "-p", "web", "-c", "c",
+                          "cat", "/etc/hostname"], factory)
+        assert rc == 0, err.getvalue()
+        assert out.getvalue() == "web-host\n"
+
+        # port-forward: tunnel one connection to a real backend socket
+        backend = socket.socket()
+        backend.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        backend.bind(("127.0.0.1", 0))
+        backend.listen(1)
+        bport = backend.getsockname()[1]
+
+        def echo():
+            conn, _ = backend.accept()
+            data = conn.recv(4096)
+            conn.sendall(b"fw:" + data)
+            conn.close()
+
+        threading.Thread(target=echo, daemon=True).start()
+        handle.server._dial = lambda pod, port: socket.create_connection(
+            ("127.0.0.1", bport), timeout=5)
+
+        out2, err2 = io.StringIO(), io.StringIO()
+        factory2 = cluster.kubectl_factory(out=out2, err=err2)
+        result = {}
+
+        def run_pf():
+            result["rc"] = run_kubectl(
+                ["port-forward", "-p", "web", "0:80", "--once"], factory2)
+
+        t = threading.Thread(target=run_pf, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 5
+        local_port = None
+        while time.monotonic() < deadline:
+            m = out2.getvalue()
+            if "Forwarding from 127.0.0.1:" in m:
+                local_port = int(m.split("127.0.0.1:")[1].split(" ")[0])
+                break
+            time.sleep(0.05)
+        assert local_port, "port-forward never bound"
+        with socket.create_connection(("127.0.0.1", local_port),
+                                      timeout=5) as s:
+            s.sendall(b"ping")
+            assert s.recv(4096) == b"fw:ping"
+        t.join(timeout=10)
+        assert result.get("rc") == 0
+        backend.close()
+    finally:
+        cluster.stop()
+
+
+def test_kubectl_proxy_and_http_log_exec():
+    """kubectl proxy relays to the apiserver; log/exec work over plain HTTP
+    through the apiserver node proxy (the real-binary path)."""
+    import io
+    import json as _json
+
+    from kubernetes_tpu.apiserver.http import APIServer
+    from kubernetes_tpu.client.client import Client
+    from kubernetes_tpu.client.http import HTTPTransport
+    from kubernetes_tpu.cluster import Cluster, ClusterConfig
+    from kubernetes_tpu.kubectl.cmd import Factory, run_kubectl
+
+    cluster = Cluster(ClusterConfig(num_nodes=1, kubelet_http=True)).start()
+    srv = APIServer(cluster.master, port=0,
+                    node_locator=cluster.node_locator).start()
+    try:
+        client = Client(HTTPTransport(srv.base_url))
+        client.pods("default").create(mkpod())
+        # the cluster's scheduler binds it (only one node to choose)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if client.pods("default").get("web").spec.host == "node-0":
+                break
+            time.sleep(0.05)
+        handle = cluster.nodes["node-0"]
+        rec = wait_for_container(handle.runtime, "u-1", "c")
+        handle.runtime.append_log(rec.id, "http log line\n")
+        handle.runtime.exec_results[("c", ("id",))] = (0, "uid=0\n")
+
+        out, err = io.StringIO(), io.StringIO()
+        factory = Factory(client, out=out, err=err)  # no harness seams
+        assert run_kubectl(["log", "web"], factory) == 0, err.getvalue()
+        assert out.getvalue() == "http log line\n"
+        out.truncate(0); out.seek(0)
+        assert run_kubectl(["exec", "-p", "web", "id"], factory) == 0, \
+            err.getvalue()
+        assert out.getvalue() == "uid=0\n"
+        # multi-word argv must survive the apiserver proxy (repeated cmd=
+        # params; a collapsing proxy would exec ['cat'] alone)
+        handle.runtime.exec_results[("c", ("cat", "/etc/hostname"))] = \
+            (0, "host-from-file\n")
+        out.truncate(0); out.seek(0)
+        assert run_kubectl(["exec", "-p", "web", "cat", "/etc/hostname"],
+                           factory) == 0, err.getvalue()
+        assert out.getvalue() == "host-from-file\n"
+        # nonzero exit: output still shown, rc 1
+        handle.runtime.exec_results[("c", ("false",))] = (1, "boom\n")
+        out.truncate(0); out.seek(0)
+        assert run_kubectl(["exec", "-p", "web", "false"], factory) == 1
+        assert out.getvalue() == "boom\n"
+
+        # kubectl proxy --once on an ephemeral port
+        out3, err3 = io.StringIO(), io.StringIO()
+        factory3 = Factory(client, out=out3, err=err3)
+        result = {}
+
+        def run_proxy():
+            result["rc"] = run_kubectl(["proxy", "--port", "0", "--once"],
+                                       factory3)
+
+        t = threading.Thread(target=run_proxy, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 5
+        pport = None
+        while time.monotonic() < deadline:
+            m = out3.getvalue()
+            if "Starting to serve on" in m:
+                pport = int(m.strip().rsplit(":", 1)[1])
+                break
+            time.sleep(0.05)
+        assert pport, "proxy never bound"
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{pport}/api/v1/namespaces/default/pods",
+                timeout=5) as r:
+            items = _json.loads(r.read())["items"]
+        assert items[0]["metadata"]["name"] == "web"
+        t.join(timeout=10)
+        assert result.get("rc") == 0
+    finally:
+        srv.stop()
+        cluster.stop()
+
+
 def test_kubectl_log_through_cluster():
     """kubectl log -> cluster pod_logs -> kubelet server -> runtime
     (ref: kubectl/cmd/log.go path through the node's read-only API)."""
@@ -216,10 +376,12 @@ def test_kubectl_log_through_cluster():
     cluster = Cluster(ClusterConfig(num_nodes=1, kubelet_http=True)).start()
     try:
         cluster.client.pods("default").create(mkpod())
-        # bind directly — no scheduler needed for one node
-        cluster.client.pods("default").bind(api.Binding(
-            metadata=api.ObjectMeta(name="web", namespace="default"),
-            pod_name="web", host="node-0"))
+        # the cluster's scheduler binds it (single node)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if cluster.client.pods("default").get("web").spec.host:
+                break
+            time.sleep(0.05)
         handle = cluster.nodes["node-0"]
         rec = wait_for_container(handle.runtime, "u-1", "c")
         handle.runtime.append_log(rec.id, "container says hi\n")
