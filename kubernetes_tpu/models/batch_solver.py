@@ -173,9 +173,11 @@ def snapshot_to_inputs(snap: ClusterSnapshot) -> SolverInputs:
     )
 
 
-@functools.partial(jax.jit, static_argnames=("w_lr", "w_spread", "w_equal"))
+@functools.partial(jax.jit,
+                   static_argnames=("w_lr", "w_spread", "w_equal", "unroll"))
 def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
-              w_equal: int = 0) -> Tuple[jnp.ndarray, jnp.ndarray]:
+              w_equal: int = 0, unroll: int = 1
+              ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Solve one wave. Returns (chosen_node_idx[P] int32 — -1 unschedulable,
     scores[P] int32 — the winning combined score, -1 if unschedulable)."""
     N = inp.cap_cpu.shape[0]
@@ -265,7 +267,7 @@ def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
 
     xs = (static_mask, inp.req_cpu, inp.req_mem, inp.pod_ports, inp.pod_pds,
           inp.tie_hi, inp.tie_lo, inp.pod_gid, inp.pod_group_member)
-    _, (chosen, scores) = jax.lax.scan(step, init, xs)
+    _, (chosen, scores) = jax.lax.scan(step, init, xs, unroll=unroll)
     return chosen, scores
 
 
