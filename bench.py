@@ -8,15 +8,25 @@ in < 1 s on a 100-node / 3000-pod cluster, i.e. the north star normalizes to
 10_000 pods/s. vs_baseline = pods_per_sec / 10_000 — >= 1.0 means the
 "10k pods in under a second" goal is met.
 
-Prints ONE JSON line on stdout; diagnostics go to stderr.
+Capture robustness: `python bench.py` runs a small parent harness that
+executes the real benchmark in a child subprocess with a per-attempt
+timeout and bounded retries (TPU backend init can transiently fail or hang;
+see jax "Unable to initialize backend" UNAVAILABLE). The parent ALWAYS
+prints exactly ONE JSON line on stdout — a measured number on success, a
+diagnostic record ({"value": 0, "error": ...}) on failure — and never
+hangs past --max-seconds. Diagnostics go to stderr.
 
 Usage: python bench.py [--smoke] [--pods P] [--nodes N]
+                       [--max-seconds S] [--attempt-seconds S] [--retries R]
+                       [--profile DIR]
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -24,6 +34,107 @@ import time
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
+
+# --------------------------------------------------------------------------
+# Parent harness: never hang, never stack-trace, always one JSON line.
+# --------------------------------------------------------------------------
+
+def _extract_json_line(text: str):
+    """Last line of `text` that parses as a JSON object, or None."""
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and "metric" in obj:
+            return line
+    return None
+
+
+def parent(argv) -> int:
+    if "-h" in argv or "--help" in argv:
+        # show both flag sets without spawning (or retrying) a child
+        _child_parser().print_help()
+        print("\ncapture-harness flags:\n"
+              "  --max-seconds S      overall watchdog budget (default 480)\n"
+              "  --attempt-seconds S  per-attempt timeout (default 240)\n"
+              "  --retries R          re-attempts after a crash/hang (default 3)")
+        return 0
+    ap = argparse.ArgumentParser(add_help=False)
+    ap.add_argument("--max-seconds", type=float, default=480.0,
+                    help="overall watchdog: total wall budget for all attempts")
+    ap.add_argument("--attempt-seconds", type=float, default=240.0,
+                    help="timeout for a single child attempt")
+    ap.add_argument("--retries", type=int, default=3,
+                    help="max re-attempts after a crashed/hung child")
+    args, child_args = ap.parse_known_args(argv)
+
+    deadline = time.monotonic() + args.max_seconds
+    cmd = [sys.executable, os.path.abspath(__file__), "--_child"] + child_args
+    backoffs = [5.0, 15.0, 30.0, 30.0]
+    last_err = "no attempt ran"
+
+    for attempt in range(args.retries + 1):
+        remaining = deadline - time.monotonic()
+        if remaining <= 5.0:
+            last_err += f" (watchdog: {args.max_seconds:.0f}s budget exhausted)"
+            break
+        t = min(args.attempt_seconds, remaining)
+        log(f"[bench] attempt {attempt + 1}/{args.retries + 1} "
+            f"(timeout {t:.0f}s, budget {remaining:.0f}s)")
+        try:
+            p = subprocess.run(cmd, timeout=t, capture_output=True, text=True)
+        except subprocess.TimeoutExpired as e:
+            def _txt(b):
+                return b.decode("utf-8", "replace") if isinstance(b, bytes) \
+                    else (b or "")
+            # the child may have printed its result and then hung in
+            # backend teardown — salvage the measurement before retrying
+            line = _extract_json_line(_txt(e.stdout))
+            if line is not None:
+                log(f"[bench] child hung after printing a result; using it")
+                print(line)
+                return 0
+            last_err = f"attempt {attempt + 1} timed out after {t:.0f}s"
+            log(f"[bench] {last_err}; child stderr tail:\n"
+                f"{_txt(e.stderr)[-2000:]}")
+        except OSError as e:
+            last_err = f"could not spawn child: {e}"
+            log(f"[bench] {last_err}")
+        else:
+            sys.stderr.write(p.stderr[-6000:])
+            sys.stderr.flush()
+            line = _extract_json_line(p.stdout)
+            if line is not None:
+                # A JSON verdict (even a failed equivalence gate) is final —
+                # deterministic results don't improve with retries.
+                print(line)
+                return p.returncode
+            last_err = (f"child exited rc={p.returncode} with no JSON; "
+                        f"stderr tail: {p.stderr[-500:].strip()!r}")
+            log(f"[bench] {last_err}")
+        if attempt < args.retries:
+            pause = backoffs[min(attempt, len(backoffs) - 1)]
+            if time.monotonic() + pause < deadline:
+                log(f"[bench] backing off {pause:.0f}s before retry")
+                time.sleep(pause)
+
+    print(json.dumps({
+        "metric": "pods_scheduled_per_sec",
+        "value": 0.0,
+        "unit": "pods/s",
+        "vs_baseline": 0.0,
+        "error": last_err[-800:],
+    }))
+    return 1
+
+
+# --------------------------------------------------------------------------
+# Child: the actual benchmark.
+# --------------------------------------------------------------------------
 
 def build_cluster(n_nodes: int, n_pods: int, n_services: int = 8,
                   existing_per_node: int = 2):
@@ -66,20 +177,37 @@ def build_cluster(n_nodes: int, n_pods: int, n_services: int = 8,
     return nodes, existing, pending, services
 
 
-def main():
-    ap = argparse.ArgumentParser()
+def _child_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="bench.py")
     ap.add_argument("--smoke", action="store_true",
                     help="small shapes + force CPU (CI / laptops)")
     ap.add_argument("--pods", type=int, default=None)
     ap.add_argument("--nodes", type=int, default=None)
     ap.add_argument("--oracle-pods", type=int, default=300,
                     help="pods for the serial-oracle rate + equivalence gate")
-    args = ap.parse_args()
+    ap.add_argument("--profile", default=None, metavar="DIR",
+                    help="capture a jax.profiler trace of the solve into DIR")
+    return ap
+
+
+def child(argv) -> int:
+    args = _child_parser().parse_args(argv)
 
     import jax
 
     if args.smoke:
         jax.config.update("jax_platforms", "cpu")
+
+    # Fail fast if the backend is unreachable: surface the error to stderr
+    # and exit non-zero quickly so the parent can retry with backoff.
+    try:
+        backend = jax.default_backend()
+        devices = jax.devices()
+    except Exception as e:  # noqa: BLE001 — any backend error means retry
+        log(f"[bench-child] backend init failed: {type(e).__name__}: {e}")
+        return 17
+    log(f"backend={backend} devices={devices}")
+
     n_pods = args.pods or (500 if args.smoke else 10_000)
     n_nodes = args.nodes or (100 if args.smoke else 5_000)
 
@@ -91,7 +219,6 @@ def main():
     from kubernetes_tpu.models.oracle import solve_serial
     from kubernetes_tpu.models.snapshot import encode_snapshot
 
-    log(f"backend={jax.default_backend()} devices={jax.devices()}")
     log(f"building cluster: {n_pods} pods x {n_nodes} nodes")
     nodes, existing, pending, services = build_cluster(n_nodes, n_pods)
 
@@ -133,12 +260,17 @@ def main():
     compile_s = time.perf_counter() - t0
     log(f"encode={encode_s:.3f}s first-call(compile+run)={compile_s:.3f}s")
 
+    if args.profile:
+        jax.profiler.start_trace(args.profile)
     runs = []
     for _ in range(3):
         t0 = time.perf_counter()
         chosen, scores = solve_jit(inp)
         jax.block_until_ready((chosen, scores))
         runs.append(time.perf_counter() - t0)
+    if args.profile:
+        jax.profiler.stop_trace()
+        log(f"jax.profiler trace written to {args.profile}")
     solve_s = min(runs)
     chosen_np = np.asarray(chosen)
     scheduled = int((chosen_np >= 0).sum())
@@ -162,4 +294,6 @@ def main():
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    if len(sys.argv) > 1 and sys.argv[1] == "--_child":
+        sys.exit(child(sys.argv[2:]))
+    sys.exit(parent(sys.argv[1:]))
