@@ -230,7 +230,8 @@ class _Handler(BaseHTTPRequestHandler):
             watching = True
             rest = rest[1:]
         if rest and rest[0] in ("proxy", "redirect"):
-            return self._handle_proxy_redirect(rest[0], version, rest[1:], query, user)
+            return self._handle_proxy_redirect(rest[0], version, rest[1:],
+                                               query, user, method, raw_body)
 
         # namespace from path (v1-style) or query param (v1beta1-style).
         # /namespaces/{name}[/finalize] stays the namespaces resource itself;
@@ -355,7 +356,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     # ----- proxy / redirect (ref: pkg/apiserver/{proxy,redirect}.go) -----
 
-    def _handle_proxy_redirect(self, mode: str, version: str, rest, query, user) -> int:
+    def _handle_proxy_redirect(self, mode: str, version: str, rest, query,
+                               user, method: str = "GET",
+                               raw_body: bytes = b"") -> int:
         apisrv = self.server.api  # type: ignore[attr-defined]
         namespace = query.get("namespace", "")
         if rest and rest[0] == "namespaces" and len(rest) >= 3:
@@ -380,7 +383,15 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             return 307
         try:
-            resp = urllib.request.urlopen(target, timeout=10)
+            # forward the incoming method and body verbatim (ref: proxy.go
+            # ServeHTTP builds the backend request from the original) — a bare
+            # urlopen(target) would turn every proxied POST into a GET
+            fwd = urllib.request.Request(
+                target, data=raw_body if raw_body else None, method=method)
+            ctype = self.headers.get("Content-Type")
+            if ctype and raw_body:
+                fwd.add_header("Content-Type", ctype)
+            resp = urllib.request.urlopen(fwd, timeout=10)
         except urllib.error.HTTPError as e:
             resp = e  # backend errors relay verbatim (exec exit!=0 is a 500)
         except Exception as e:

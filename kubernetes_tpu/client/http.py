@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import base64
 import http.client
-import os
 import json
 import socket
 import threading
@@ -27,6 +26,12 @@ from kubernetes_tpu.api.latest import scheme as default_scheme
 
 __all__ = ["HTTPTransport"]
 
+# Set by the test harness (tests/conftest.py) to run the whole suite over a
+# chosen wire version (ref: hack/test-go.sh KUBE_TEST_API_VERSIONS loop).
+# Deliberately NOT read from os.environ here: a stray env var must not be
+# able to change the wire version of production clients (advisor r1 #4).
+test_version_override: str = ""
+
 
 class HTTPTransport:
     """Talks to an API server over HTTP. ``auth`` is ``("basic", user, pw)``
@@ -39,9 +44,7 @@ class HTTPTransport:
                  insecure_skip_tls_verify: bool = False):
         self.base_url = base_url.rstrip("/")
         self.scheme = scheme or default_scheme
-        # KUBE_TEST_API_VERSION runs the whole suite over a chosen wire
-        # version (ref: hack/test-go.sh KUBE_TEST_API_VERSIONS loop)
-        self.version = version or os.environ.get("KUBE_TEST_API_VERSION", "") \
+        self.version = version or test_version_override \
             or self.scheme.default_version
         self.timeout = timeout
         self.ssl_context = None

@@ -44,7 +44,9 @@ def build_kubelet(opts):
     from kubernetes_tpu.kubelet.kubelet import Kubelet
     from kubernetes_tpu.kubelet.runtime import FakeRuntime
     from kubernetes_tpu.kubelet.server import KubeletServer
-    from kubernetes_tpu.volume.plugins import new_default_plugin_mgr
+    from kubernetes_tpu.volume.plugins import (ExecMounter,
+                                               RefusingDiskManager,
+                                               new_default_plugin_mgr)
 
     hostname = opts.hostname_override or socket.gethostname()
     client = Client(HTTPTransport(opts.api_servers))
@@ -53,7 +55,12 @@ def build_kubelet(opts):
     # the runtime seam: this image has no Docker daemon — FakeRuntime fills
     # the dockertools slot (a real runtime drops in behind ContainerRuntime)
     runtime = FakeRuntime()
-    volume_mgr = new_default_plugin_mgr(opts.root_dir, kubelet_client=client)
+    # real mounter so NFS mounts actually happen (or fail loudly); PD attach
+    # refuses outright — there is no cloud disk backend on this host — so
+    # such pods get a mount error instead of an empty dir
+    volume_mgr = new_default_plugin_mgr(opts.root_dir, kubelet_client=client,
+                                        mounter=ExecMounter(),
+                                        disk_manager=RefusingDiskManager())
     kubelet = Kubelet(hostname, runtime, client=client, recorder=recorder,
                       resync_period=opts.sync_frequency,
                       volume_mgr=volume_mgr)

@@ -412,6 +412,13 @@ class SecretStrategy(Strategy):
         errs = validation.validate_object_meta(s.metadata, namespaced=True)
         total = 0
         for k, v in (s.data or {}).items():
+            # each key becomes a filename in the secret volume — it must be a
+            # DNS-1123 subdomain (ref: pkg/api/validation/validation.go
+            # ValidateSecret:1010), which also forbids path separators / '..'
+            if not validation.is_dns1123_subdomain(k):
+                errs.append(ValueError(
+                    f"data[{k}]: key must be a DNS-1123 subdomain"))
+                continue
             try:
                 total += len(base64.b64decode(v, validate=True))
             except Exception:

@@ -22,7 +22,8 @@ from typing import Any, Dict, List, Optional
 from kubernetes_tpu.api import types as api
 
 __all__ = ["Builder", "Cleaner", "VolumePlugin", "VolumePluginMgr",
-           "Mounter", "FakeMounter", "DiskManager", "FakeDiskManager",
+           "Mounter", "FakeMounter", "ExecMounter", "DiskManager",
+           "FakeDiskManager", "RefusingDiskManager",
            "new_default_plugin_mgr", "escape_plugin_name"]
 
 
@@ -214,6 +215,12 @@ class SecretPlugin(VolumePlugin):
             path = b.get_path()
             os.makedirs(path, exist_ok=True)
             for key, value in secret.data.items():
+                # defense in depth vs. SecretStrategy.validate: a key that is
+                # not a plain filename ('../x', 'a/b', '') could escape the
+                # pod volume dir and overwrite arbitrary kubelet-host files
+                if os.path.basename(key) != key or key in ("", ".", ".."):
+                    raise ValueError(
+                        f"secret {secret_name!r}: unsafe data key {key!r}")
                 try:
                     raw = base64.b64decode(value, validate=True)
                 except (binascii.Error, ValueError):
@@ -245,6 +252,11 @@ class Mounter:
     def is_mounted(self, target: str) -> bool:
         raise NotImplementedError
 
+    def device_for(self, target: str) -> Optional[str]:
+        """Source device mounted at ``target`` (for detach bookkeeping —
+        ref: gce_pd.go TearDown reads the device back from the mount table)."""
+        return None
+
 
 class FakeMounter(Mounter):
     def __init__(self):
@@ -261,6 +273,10 @@ class FakeMounter(Mounter):
 
     def is_mounted(self, target):
         return target in self.mounts
+
+    def device_for(self, target):
+        entry = self.mounts.get(target)
+        return entry[0] if entry else None
 
 
 class ExecMounter(Mounter):
@@ -281,6 +297,18 @@ class ExecMounter(Mounter):
                 return any(line.split()[1] == real for line in f)
         except OSError:
             return False
+
+    def device_for(self, target):
+        real = os.path.realpath(target)
+        try:
+            with open("/proc/mounts") as f:
+                for line in f:
+                    parts = line.split()
+                    if len(parts) >= 2 and parts[1] == real:
+                        return parts[0]
+        except OSError:
+            pass
+        return None
 
 
 class NFSPlugin(VolumePlugin):
@@ -312,7 +340,10 @@ class NFSPlugin(VolumePlugin):
         mounter = self.mounter
 
         def tear_down():
-            mounter.unmount(base.get_path())
+            # gate on IsMountPoint as the reference does (nfs.go TearDown):
+            # a dir left behind by a failed mount must not abort cleanup
+            if mounter.is_mounted(base.get_path()):
+                mounter.unmount(base.get_path())
             _DirBuilder.tear_down(base)
         base.tear_down = tear_down
         return base
@@ -346,6 +377,42 @@ class FakeDiskManager(DiskManager):
     def detach_disk(self, pd_name):
         self.attached.pop(pd_name, None)
         self.log.append(("detach", pd_name))
+
+
+class RefusingDiskManager(DiskManager):
+    """Installed when no real cloud disk backend exists: attaching fails
+    loudly so the pod is rejected with a mount error instead of silently
+    running against an empty local dir (advisor finding r1 #2)."""
+
+    def attach_disk(self, pd_name, read_only):
+        raise RuntimeError(
+            f"cannot attach GCE PD {pd_name!r}: no disk manager configured "
+            "on this kubelet (no cloud provider)")
+
+    def detach_disk(self, pd_name):
+        raise RuntimeError(
+            f"cannot detach GCE PD {pd_name!r}: no disk manager configured "
+            "on this kubelet (no cloud provider)")
+
+
+def _device_to_pd_name(device: str) -> Optional[str]:
+    """Map a mounted device back to its GCE pd name. The mount table holds
+    the resolved node (/dev/sdb), not the /dev/disk/by-id/google-<pd> alias
+    mount(8) was given — reverse it through the by-id symlinks."""
+    name = os.path.basename(device)
+    if name.startswith("google-"):
+        return name[len("google-"):]
+    by_id = "/dev/disk/by-id"
+    try:
+        real = os.path.realpath(device)
+        for entry in os.listdir(by_id):
+            if not entry.startswith("google-"):
+                continue
+            if os.path.realpath(os.path.join(by_id, entry)) == real:
+                return entry[len("google-"):]
+    except OSError:
+        pass
+    return None
 
 
 class GCEPersistentDiskPlugin(VolumePlugin):
@@ -382,10 +449,16 @@ class GCEPersistentDiskPlugin(VolumePlugin):
         disks, mounter = self.disks, self.mounter
 
         def tear_down():
-            mounter.unmount(base.get_path())
-            # volume_name is the pd name by kubelet convention when cleaning
-            # orphans; precise detach bookkeeping needs the original spec,
-            # which the reference reads back from the mount table
+            # read the device back from the mount table to recover the pd
+            # name, as the reference's TearDown does (gce_pd.go), so the
+            # cloud attachment is released and not leaked
+            device = mounter.device_for(base.get_path())
+            if mounter.is_mounted(base.get_path()):
+                mounter.unmount(base.get_path())
+            if device:
+                pd_name = _device_to_pd_name(device)
+                if pd_name:
+                    disks.detach_disk(pd_name)
             _DirBuilder.tear_down(base)
         base.tear_down = tear_down
         return base

@@ -21,3 +21,12 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+# Wire-version matrix: hack/test.sh exports KUBE_TEST_API_VERSION per run;
+# the override lives in the test harness so production clients never read
+# the environment (advisor r1 #4).
+_v = os.environ.get("KUBE_TEST_API_VERSION", "")
+if _v:
+    from kubernetes_tpu.client import http as _client_http
+
+    _client_http.test_version_override = _v
