@@ -49,9 +49,22 @@ def build_scheduler(opts):
     config = factory.create(provider=opts.algorithm_provider,
                             policy=policy, recorder=recorder)
     if opts.algorithm == "tpu-batch":
+        from kubernetes_tpu.models.policy import (UnsupportedPolicy,
+                                                  batch_policy_from)
         from kubernetes_tpu.scheduler.tpu_batch import BatchScheduler
+        try:
+            batch_policy = batch_policy_from(opts.algorithm_provider, policy)
+        except UnsupportedPolicy as e:
+            # never silently solve a different problem than configured:
+            # fall back to the serial driver, which runs the plugin
+            # functions directly
+            print(f"kube-scheduler: tpu-batch cannot model this "
+                  f"configuration ({e}); falling back to serial",
+                  file=sys.stderr)
+            return factory, Scheduler(config)
         return factory, BatchScheduler(config, factory, client,
-                                       wave_linger_s=opts.wave_period)
+                                       wave_linger_s=opts.wave_period,
+                                       batch_policy=batch_policy)
     return factory, Scheduler(config)
 
 

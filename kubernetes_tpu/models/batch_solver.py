@@ -17,6 +17,22 @@ call over a dense (pending_pods x nodes) problem:
   truncation, same float32 spread rounding, same FNV-1a-mod-count tie-break
   over nodes in list order.
 
+The full policy plugin vocabulary is modeled (models/policy.BatchPolicy —
+the jit-static description of the configured predicate/priority sets):
+
+- CheckNodeLabelPresence rides the static ``node_extra_ok`` mask;
+- NodeLabelPriority is a static additive score plane;
+- CheckServiceAffinity (predicates.go:238-324): constraints pinned by the
+  pod's node selector are folded into the static mask; constraints derived
+  from the first committed service peer's node ("anchor") are tracked in
+  the scan carry — each commit sets the anchor of every service group the
+  pod belongs to, exactly reproducing the serial "first pod in list order"
+  lookup;
+- ServiceAntiAffinity (spreading.go:104-168): per-zone peer counts via
+  one-hot matmuls, restricted to nodes feasible for the current pod — the
+  serial path computes priorities over the *filtered* node list, so zone
+  counts exclude infeasible nodes.
+
 TPU dtype strategy: v5e has no native int64 — every wide i64 op is emulated
 as multiple i32 ops. Byte capacities exceed int32, but floor division and
 integer comparison are invariant under a common scaling, so the encoder
@@ -53,6 +69,7 @@ def ensure_x64() -> None:
 import jax.numpy as jnp
 import numpy as np
 
+from kubernetes_tpu.models.policy import BatchPolicy
 from kubernetes_tpu.models.snapshot import ClusterSnapshot
 from kubernetes_tpu.ops.kernels import (
     calculate_score as _calculate_score,
@@ -96,6 +113,14 @@ class SolverInputs(NamedTuple):
     pod_gid: jnp.ndarray
     pod_group_member: jnp.ndarray
     group_counts: jnp.ndarray
+    # policy extensions (zero-size planes when unused)
+    score_static: jnp.ndarray    # [N] i32
+    node_aff_vals: jnp.ndarray   # [N, L] i32
+    pod_aff_static: jnp.ndarray  # [P, L] i32
+    anchor_vals0: jnp.ndarray    # [G, L] i32
+    has_anchor0: jnp.ndarray     # [G] bool
+    zone_labeled: jnp.ndarray    # [A, N] bool
+    zone_onehot: jnp.ndarray     # [A, N, V] f32
 
 
 def _pack_bits(a: np.ndarray) -> np.ndarray:
@@ -148,6 +173,28 @@ def snapshot_to_inputs(snap: ClusterSnapshot) -> SolverInputs:
                   snap.cap_cpu + req_cpu_total)
     rdt = np.int32 if use_i32 else np.int64
 
+    N = snap.n_nodes
+    P = snap.n_pods
+    G = snap.group_counts.shape[0]
+    score_static = (snap.score_static if snap.score_static is not None
+                    else np.zeros(N, np.int32))
+    node_aff_vals = (snap.node_aff_vals if snap.node_aff_vals is not None
+                     else np.zeros((N, 0), np.int32))
+    pod_aff_static = (snap.pod_aff_static if snap.pod_aff_static is not None
+                      else np.zeros((P, 0), np.int32))
+    anchor_vals0 = (snap.anchor_vals0 if snap.anchor_vals0 is not None
+                    else np.zeros((G, 0), np.int32))
+    has_anchor0 = (snap.has_anchor0 if snap.has_anchor0 is not None
+                   else np.zeros(G, bool))
+    node_zone = (snap.node_zone if snap.node_zone is not None
+                 else np.zeros((0, N), np.int32))
+    A = node_zone.shape[0]
+    V = max(1, int(node_zone.max(initial=-1)) + 1)
+    zone_onehot = (node_zone[:, :, None] ==
+                   np.arange(V, dtype=np.int32)[None, None, :]
+                   ).astype(np.float32)                       # [A, N, V]
+    zone_labeled = node_zone >= 0                             # [A, N]
+
     return SolverInputs(
         cap_cpu=jnp.asarray(snap.cap_cpu.astype(rdt)),
         cap_mem=jnp.asarray(cap_mem.astype(rdt)),
@@ -170,28 +217,59 @@ def snapshot_to_inputs(snap: ClusterSnapshot) -> SolverInputs:
         pod_gid=jnp.asarray(snap.pod_gid),
         pod_group_member=jnp.asarray(snap.pod_group_member),
         group_counts=jnp.asarray(snap.group_counts),
+        score_static=jnp.asarray(score_static.astype(np.int32)),
+        node_aff_vals=jnp.asarray(node_aff_vals.astype(np.int32)),
+        pod_aff_static=jnp.asarray(pod_aff_static.astype(np.int32)),
+        anchor_vals0=jnp.asarray(anchor_vals0.astype(np.int32)),
+        has_anchor0=jnp.asarray(has_anchor0),
+        zone_labeled=jnp.asarray(zone_labeled),
+        zone_onehot=jnp.asarray(zone_onehot),
     )
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("w_lr", "w_spread", "w_equal", "unroll"))
+                   static_argnames=("w_lr", "w_spread", "w_equal", "unroll",
+                                    "pol"))
 def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
-              w_equal: int = 0, unroll: int = 1
+              w_equal: int = 0, unroll: int = 1,
+              pol: Optional[BatchPolicy] = None
               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Solve one wave. Returns (chosen_node_idx[P] int32 — -1 unschedulable,
-    scores[P] int32 — the winning combined score, -1 if unschedulable)."""
+    scores[P] int32 — the winning combined score, -1 if unschedulable).
+
+    ``pol`` is the static policy description; when omitted, the default
+    provider's plugin set with the given legacy weights applies."""
+    if pol is None:
+        pol = BatchPolicy(w_lr=w_lr, w_spread=w_spread, w_equal=w_equal)
     N = inp.cap_cpu.shape[0]
+    P = inp.req_cpu.shape[0]
+    L = inp.node_aff_vals.shape[1]
     rdt = inp.cap_cpu.dtype
     arange_n = jnp.arange(N, dtype=jnp.int32)
 
+    if pol.all_infeasible:
+        # no nonzero-weight priorities: prioritizeNodes emits nothing and
+        # Schedule fails every pod (generic_scheduler.go:76-80)
+        return (jnp.full(P, -1, jnp.int32), jnp.full(P, NEG, jnp.int32))
+
     # ---- batched Filter pre-pass (MXU) -----------------------------------
-    # selector violations: required pairs the node lacks, exact f32 matmul
-    violations = jnp.dot(inp.pod_sel.astype(jnp.float32),
-                         (~inp.node_sel).astype(jnp.float32).T)  # [P, N]
-    sel_ok = violations == 0
-    host_ok = (inp.pod_host_idx[:, None] == -1) | \
-              (inp.pod_host_idx[:, None] == arange_n[None, :])
-    static_mask = sel_ok & host_ok & inp.node_extra_ok[None, :]  # [P, N]
+    static_mask = jnp.broadcast_to(inp.node_extra_ok[None, :], (P, N))
+    if pol.use_selector:
+        # selector violations: required pairs the node lacks, exact f32 matmul
+        violations = jnp.dot(inp.pod_sel.astype(jnp.float32),
+                             (~inp.node_sel).astype(jnp.float32).T)  # [P, N]
+        static_mask = static_mask & (violations == 0)
+    if pol.use_host:
+        host_ok = (inp.pod_host_idx[:, None] == -1) | \
+                  (inp.pod_host_idx[:, None] == arange_n[None, :])
+        static_mask = static_mask & host_ok
+    if pol.has_affinity:
+        # node-selector-pinned affinity constraints are static per pod
+        # (predicates.go:247-254); -2 = label not pinned by the selector
+        for l in range(L):
+            pinned = inp.pod_aff_static[:, l, None]            # [P, 1]
+            static_mask = static_mask & (
+                (pinned == -2) | (inp.node_aff_vals[None, :, l] == pinned))
 
     # ---- sequential commit scan over pods --------------------------------
     class Carry(NamedTuple):
@@ -202,43 +280,84 @@ def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
         ports: jnp.ndarray           # [N, Wp] u32 packed
         pds: jnp.ndarray             # [N, Wd] u32 packed
         counts: jnp.ndarray          # [G, N+1] i32
+        anchor_vals: jnp.ndarray     # [G, L] i32
+        has_anchor: jnp.ndarray      # [G] bool
 
     init = Carry(inp.fit_used_cpu, inp.fit_used_mem,
                  inp.score_used_cpu, inp.score_used_mem,
-                 inp.node_ports, inp.node_pds, inp.group_counts)
+                 inp.node_ports, inp.node_pds, inp.group_counts,
+                 inp.anchor_vals0, inp.has_anchor0)
 
     def step(carry: Carry, xs):
         (static_row, req_cpu, req_mem, pod_ports, pod_pds,
-         tie_hi, tie_lo, gid, member) = xs
+         tie_hi, tie_lo, gid, member, aff_static) = xs
 
-        # Filter: resources (predicates.go:127-152 — zero-request always
-        # fits; zero capacity never constrains; pre-exceeded nodes fail)
-        cpu_ok = (inp.cap_cpu == 0) | (inp.cap_cpu - carry.fit_used_cpu >= req_cpu)
-        mem_ok = (inp.cap_mem == 0) | (inp.cap_mem - carry.fit_used_mem >= req_mem)
-        zero_req = (req_cpu == 0) & (req_mem == 0)
-        # fit_exceeded is static: committed pending pods always fit, so they
-        # never flip a node into the pre-exceeded state.
-        res_ok = zero_req | (~inp.fit_exceeded & cpu_ok & mem_ok)
-        # Filter: host ports (predicates.go:326-338) — packed-word AND
-        port_conflict = jnp.any(carry.ports & pod_ports[None, :] != 0, axis=1)
-        # Filter: GCE PD exclusivity (predicates.go:68-83)
-        pd_conflict = jnp.any(carry.pds & pod_pds[None, :] != 0, axis=1)
+        feasible = static_row
+        if pol.use_resources:
+            # Filter: resources (predicates.go:127-152 — zero-request always
+            # fits; zero capacity never constrains; pre-exceeded nodes fail)
+            cpu_ok = (inp.cap_cpu == 0) | \
+                (inp.cap_cpu - carry.fit_used_cpu >= req_cpu)
+            mem_ok = (inp.cap_mem == 0) | \
+                (inp.cap_mem - carry.fit_used_mem >= req_mem)
+            zero_req = (req_cpu == 0) & (req_mem == 0)
+            # fit_exceeded is static: committed pending pods always fit, so
+            # they never flip a node into the pre-exceeded state.
+            feasible = feasible & \
+                (zero_req | (~inp.fit_exceeded & cpu_ok & mem_ok))
+        if pol.use_ports:
+            # Filter: host ports (predicates.go:326-338) — packed-word AND
+            feasible = feasible & \
+                ~jnp.any(carry.ports & pod_ports[None, :] != 0, axis=1)
+        if pol.use_disk:
+            # Filter: GCE PD exclusivity (predicates.go:68-83)
+            feasible = feasible & \
+                ~jnp.any(carry.pds & pod_pds[None, :] != 0, axis=1)
+        if pol.has_affinity:
+            # anchor-derived constraints (predicates.go:256-276): apply for
+            # labels the selector didn't pin, once the group has a peer
+            safe_g = jnp.maximum(gid, 0)
+            row = carry.anchor_vals[safe_g]                    # [L]
+            has = (gid >= 0) & carry.has_anchor[safe_g]
+            dyn = jnp.ones(N, bool)
+            for l in range(L):
+                need = (aff_static[l] == -2) & (row[l] >= 0)
+                dyn = dyn & (~need | (inp.node_aff_vals[:, l] == row[l]))
+            feasible = feasible & (~has | dyn)
 
-        feasible = static_row & res_ok & ~port_conflict & ~pd_conflict
-
-        # Score: LeastRequested (priorities.go:41-75 — all-pods usage + pod)
-        total_cpu = carry.score_used_cpu + req_cpu
-        total_mem = carry.score_used_mem + req_mem
-        lr = ((_calculate_score(total_cpu, inp.cap_cpu)
-               + _calculate_score(total_mem, inp.cap_mem)) // 2).astype(jnp.int32)
-        # Score: ServiceSpreading (spreading.go:37-86)
-        safe_gid = jnp.maximum(gid, 0)
-        counts_row = carry.counts[safe_gid]          # [N+1]
-        max_count = jnp.max(counts_row)
-        spread = _spread_score(max_count, counts_row[:N])
-        spread = jnp.where(gid >= 0, spread, jnp.int32(10))  # no service: 10
-
-        score = lr * w_lr + spread * w_spread + jnp.int32(w_equal)
+        counts_row = carry.counts[jnp.maximum(gid, 0)]         # [N+1]
+        score = jnp.zeros(N, jnp.int32)
+        if pol.w_lr:
+            # Score: LeastRequested (priorities.go:41-75 — all-pods usage)
+            total_cpu = carry.score_used_cpu + req_cpu
+            total_mem = carry.score_used_mem + req_mem
+            lr = ((_calculate_score(total_cpu, inp.cap_cpu)
+                   + _calculate_score(total_mem, inp.cap_mem)) // 2
+                  ).astype(jnp.int32)
+            score = score + lr * pol.w_lr
+        if pol.w_spread:
+            # Score: ServiceSpreading (spreading.go:37-86)
+            max_count = jnp.max(counts_row)
+            spread = _spread_score(max_count, counts_row[:N])
+            spread = jnp.where(gid >= 0, spread, jnp.int32(10))
+            score = score + spread * pol.w_spread
+        for a, (_label, w) in enumerate(pol.anti_affinity):
+            # Score: ServiceAntiAffinity (spreading.go:104-168). The serial
+            # path scores over the FILTERED node list, so per-zone counts
+            # include only nodes feasible for this pod; peers off-list
+            # (slot N) and on infeasible nodes don't count.
+            counts_eff = jnp.where(gid >= 0, counts_row, jnp.int32(0))
+            num = jnp.sum(counts_eff)
+            c = (counts_eff[:N] * feasible).astype(jnp.float32)
+            zc = inp.zone_onehot[a].T @ c                       # [V]
+            cnt = (inp.zone_onehot[a] @ zc).astype(jnp.int32)   # [N]
+            s = _spread_score(num, cnt)
+            s = jnp.where(inp.zone_labeled[a], s, jnp.int32(0))
+            score = score + s * w
+        if pol.label_prefs:
+            score = score + inp.score_static
+        if pol.w_equal:
+            score = score + jnp.int32(pol.w_equal)
         masked = jnp.where(feasible, score, jnp.int32(NEG))
 
         # select host (generic_scheduler.go:84-96, deterministic tie-break)
@@ -250,6 +369,16 @@ def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
 
         # commit: one-hot update of every accumulator at the chosen node
         onehot = (arange_n == chosen)                # [N] (all-False if -1)
+        if pol.has_affinity:
+            committed = chosen >= 0
+            chosen_vals = inp.node_aff_vals[jnp.maximum(chosen, 0)]  # [L]
+            newly = member & ~carry.has_anchor & committed
+            anchor_vals = jnp.where(newly[:, None], chosen_vals[None, :],
+                                    carry.anchor_vals)
+            has_anchor = carry.has_anchor | newly
+        else:
+            anchor_vals = carry.anchor_vals
+            has_anchor = carry.has_anchor
         carry = Carry(
             fit_used_cpu=carry.fit_used_cpu + onehot * req_cpu,
             fit_used_mem=carry.fit_used_mem + onehot * req_mem,
@@ -261,12 +390,15 @@ def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
                                       jnp.uint32(0)),
             counts=carry.counts + (member[:, None]
                                    * jnp.pad(onehot, (0, 1)).astype(jnp.int32)[None, :]),
+            anchor_vals=anchor_vals,
+            has_anchor=has_anchor,
         )
         win_score = jnp.where(any_feasible, top, jnp.int32(NEG))
         return carry, (chosen, win_score)
 
     xs = (static_mask, inp.req_cpu, inp.req_mem, inp.pod_ports, inp.pod_pds,
-          inp.tie_hi, inp.tie_lo, inp.pod_gid, inp.pod_group_member)
+          inp.tie_hi, inp.tie_lo, inp.pod_gid, inp.pod_group_member,
+          inp.pod_aff_static)
     _, (chosen, scores) = jax.lax.scan(step, init, xs, unroll=unroll)
     return chosen, scores
 
@@ -274,8 +406,7 @@ def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
 def solve(snap: ClusterSnapshot) -> Tuple[np.ndarray, np.ndarray]:
     """Host entry: encode -> device -> solve -> host decisions."""
     inp = snapshot_to_inputs(snap)
-    chosen, scores = solve_jit(inp, w_lr=snap.w_least_requested,
-                               w_spread=snap.w_spreading, w_equal=snap.w_equal)
+    chosen, scores = solve_jit(inp, pol=snap.policy)
     return np.asarray(chosen), np.asarray(scores)
 
 

@@ -30,8 +30,11 @@ __all__ = ["solve_serial"]
 def solve_serial(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
                  pending_pods: Sequence[api.Pod],
                  services: Sequence[api.Service] = (),
-                 provider: str = schedplugins.DEFAULT_PROVIDER
+                 provider: str = schedplugins.DEFAULT_PROVIDER,
+                 policy: Optional[schedplugins.Policy] = None
                  ) -> List[Optional[str]]:
+    """Serial reference decisions for a wave. A ``policy`` replaces the
+    provider's plugin sets entirely (CreateFromConfig, factory.go:88-104)."""
     node_list = api.NodeList(items=list(nodes))
     committed: List[api.Pod] = list(existing_pods)
     pod_lister = FakePodLister(committed)  # shared, mutated via committed
@@ -40,11 +43,14 @@ def solve_serial(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
         service_lister=FakeServiceLister(list(services)),
         node_lister=FakeMinionLister(node_list),
         node_info=FakeNodeInfo(node_list))
-    keys = schedplugins.get_algorithm_provider(provider)
-    scheduler = GenericScheduler(
-        schedplugins.get_predicates(keys["predicates"], args),
-        schedplugins.get_priorities(keys["priorities"], args),
-        pod_lister)
+    if policy is not None:
+        predicates = schedplugins.predicates_from_policy(policy, args)
+        priorities = schedplugins.priorities_from_policy(policy, args)
+    else:
+        keys = schedplugins.get_algorithm_provider(provider)
+        predicates = schedplugins.get_predicates(keys["predicates"], args)
+        priorities = schedplugins.get_priorities(keys["priorities"], args)
+    scheduler = GenericScheduler(predicates, priorities, pod_lister)
 
     decisions: List[Optional[str]] = []
     minion_lister = FakeMinionLister(node_list)

@@ -6,10 +6,11 @@ existing pods, and the pending-pod batch into fixed-shape arrays the batch
 solver (kubernetes_tpu.models.batch_solver) consumes in a single compiled
 call.
 
-Exactness over hashing: label selectors, host ports, and GCE PD names are
-interned into small per-batch vocabularies built from the pending pods, so
-the "does pod p's selector accept node n" check is an exact boolean matmul —
-no hash collisions to reconcile with the serial oracle.
+Exactness over hashing: label selectors, host ports, GCE PD names, and
+affinity label values are interned into small per-batch vocabularies built
+from the pending pods, so the "does pod p's selector accept node n" check is
+an exact boolean matmul — no hash collisions to reconcile with the serial
+oracle.
 
 Encoded predicate state mirrors predicates.go exactly:
 - resources: two accumulators per node — the greedy-fitting usage + exceeded
@@ -19,7 +20,21 @@ Encoded predicate state mirrors predicates.go exactly:
 - ports: vocabulary over host ports observed anywhere (getUsedPorts :340);
 - service spreading: per (namespace, first-matching-service) group counts by
   host, plus one overflow bucket for unassigned/unknown hosts — the
-  reference counts those toward maxCount too (spreading.go:62-68).
+  reference counts those toward maxCount too (spreading.go:62-68). The
+  group axis is padded to a power of two (recompile-friendly buckets); a
+  wave may span arbitrarily many services.
+
+Policy extensions (models/policy.BatchPolicy):
+- CheckNodeLabelPresence folds into ``node_extra_ok`` (static per node);
+- NodeLabelPriority folds into ``score_static`` (static additive score);
+- CheckServiceAffinity: per-label value codes for nodes, the pod's
+  node-selector-pinned codes, and per-group anchor state (the first
+  committed service peer's node values — predicates.go:238-324);
+- ServiceAntiAffinity: per-config node zone codes (spreading.go:104-168).
+
+Everything host-side is vectorized numpy — one Python pass over each pod
+list to pull fields out of the object graph, then bulk array ops; there are
+no per-(pod x service) or per-(group x pod) Python loops.
 """
 
 from __future__ import annotations
@@ -30,16 +45,51 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from kubernetes_tpu.api import types as api
-from kubernetes_tpu.scheduler.generic import fnv1a64, pod_tie_break_key
-from kubernetes_tpu.scheduler.predicates import get_resource_request
+from kubernetes_tpu.models.policy import BatchPolicy, DEFAULT_BATCH_POLICY
+from kubernetes_tpu.scheduler.generic import (
+    FNV64_OFFSET,
+    FNV64_PRIME,
+    pod_tie_break_key,
+)
 
 __all__ = ["ClusterSnapshot", "encode_snapshot"]
+
+
+def _fnv1a64_batch(keys: List[str]) -> np.ndarray:
+    """Vectorized FNV-1a-64 over a batch of strings (same results as
+    scheduler.generic.fnv1a64, which stays the serial-oracle twin). The
+    per-byte dependency chain runs over the max string length — a dozen
+    numpy passes over [P] instead of 10k Python loops."""
+    if not keys:
+        return np.zeros(0, np.uint64)
+    bs = [k.encode("utf-8") for k in keys]
+    maxlen = max(len(b) for b in bs)
+    if maxlen == 0:
+        return np.full(len(bs), FNV64_OFFSET, np.uint64)
+    buf = np.frombuffer(b"".join(b.ljust(maxlen, b"\0") for b in bs),
+                        np.uint8).reshape(len(bs), maxlen)
+    lens = np.array([len(b) for b in bs])
+    h = np.full(len(bs), FNV64_OFFSET, np.uint64)
+    prime = np.uint64(FNV64_PRIME)
+    for c in range(maxlen):
+        nh = (h ^ buf[:, c].astype(np.uint64)) * prime  # wraps mod 2^64
+        h = np.where(c < lens, nh, h)
+    return h
 
 _PAD = 8  # minimum vocabulary padding (keeps matmul shapes nonzero)
 
 
 def _pad_to(n: int, multiple: int = _PAD) -> int:
     return max(multiple, ((n + multiple - 1) // multiple) * multiple)
+
+
+def _pow2_pad(n: int, minimum: int = 8) -> int:
+    """Next power of two >= max(n, minimum) — bounds the number of distinct
+    compiled shapes as the group count varies wave to wave."""
+    out = minimum
+    while out < n:
+        out *= 2
+    return out
 
 
 @dataclass
@@ -59,7 +109,7 @@ class ClusterSnapshot:
     node_ports: np.ndarray       # [N, K] bool
     node_sel: np.ndarray         # [N, K2] bool — node has (key,value) label
     node_pds: np.ndarray         # [N, K3] bool
-    node_extra_ok: np.ndarray    # [N] bool — policy NodeLabelPresence etc.
+    node_extra_ok: np.ndarray    # [N] bool — NodeLabelPresence + caller mask
     # pending pods
     pod_names: List[str]
     req_cpu: np.ndarray          # [P] i64
@@ -70,11 +120,19 @@ class ClusterSnapshot:
     pod_host_idx: np.ndarray     # [P] i32: -1 unset, -2 host not in node list
     tie_hi: np.ndarray           # [P] i64 — fnv1a64(pod key) >> 32
     tie_lo: np.ndarray           # [P] i64 — fnv1a64(pod key) & 0xffffffff
-    # service spreading groups
+    # service spreading groups (axis padded to a power of two)
     pod_gid: np.ndarray          # [P] i32, -1 = no service
     pod_group_member: np.ndarray  # [P, G] bool — pod's labels match group's selector
     group_counts: np.ndarray     # [G, N+1] i32 (slot N: unassigned/unknown hosts)
-    # priority weights (static)
+    # policy extensions (minimal shapes when the policy doesn't use them)
+    score_static: np.ndarray = None    # [N] i32 — NodeLabelPriority terms
+    node_aff_vals: np.ndarray = None   # [N, L] i32 value codes, -1 absent
+    pod_aff_static: np.ndarray = None  # [P, L] i32 codes, -2 unspecified
+    anchor_vals0: np.ndarray = None    # [G, L] i32 — initial anchor values
+    has_anchor0: np.ndarray = None     # [G] bool
+    node_zone: np.ndarray = None       # [A, N] i32 zone codes, -1 unlabeled
+    policy: BatchPolicy = field(default_factory=lambda: DEFAULT_BATCH_POLICY)
+    # priority weights (kept for back-compat; mirror policy)
     w_least_requested: int = 1
     w_spreading: int = 1
     w_equal: int = 0
@@ -88,14 +146,19 @@ class ClusterSnapshot:
         return len(self.pod_names)
 
 
+def _label_items(meta_labels: Optional[Dict[str, str]]):
+    return (meta_labels or {}).items()
+
+
 def encode_snapshot(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
                     pending_pods: Sequence[api.Pod],
                     services: Sequence[api.Service] = (),
                     node_extra_ok: Optional[np.ndarray] = None,
-                    max_groups: int = 64) -> ClusterSnapshot:
+                    policy: Optional[BatchPolicy] = None) -> ClusterSnapshot:
     """Encode one scheduling wave. Node order defines the tie-break order and
     must match what the serial oracle sees."""
-    N, P = len(nodes), len(pending_pods)
+    policy = policy or DEFAULT_BATCH_POLICY
+    N, P, E = len(nodes), len(pending_pods), len(existing_pods)
     node_index = {n.metadata.name: i for i, n in enumerate(nodes)}
 
     # -- capacities ---------------------------------------------------------
@@ -108,173 +171,303 @@ def encode_snapshot(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
         q = cap.get(api.ResourceMemory)
         cap_mem[i] = q.int_value() if q is not None else 0
 
-    # -- existing pod usage: greedy Filter accumulators + Score sums --------
-    pods_by_host: Dict[str, List[api.Pod]] = {}
-    for p in existing_pods:
-        pods_by_host.setdefault(p.status.host, []).append(p)
-
-    fit_used_cpu = np.zeros(N, np.int64)
-    fit_used_mem = np.zeros(N, np.int64)
-    fit_exceeded = np.zeros(N, bool)
-    score_used_cpu = np.zeros(N, np.int64)
-    score_used_mem = np.zeros(N, np.int64)
-    for host, host_pods in pods_by_host.items():
-        i = node_index.get(host)
-        if i is None:
-            continue
-        ccpu, cmem = cap_cpu[i], cap_mem[i]
-        used_c = used_m = 0
-        for p in host_pods:
-            req = get_resource_request(p)
-            score_used_cpu[i] += req.milli_cpu
-            score_used_mem[i] += req.memory
-            fits_cpu = ccpu == 0 or (ccpu - used_c) >= req.milli_cpu
-            fits_mem = cmem == 0 or (cmem - used_m) >= req.memory
-            if fits_cpu and fits_mem:
-                used_c += req.milli_cpu
-                used_m += req.memory
-            else:
-                fit_exceeded[i] = True
-        fit_used_cpu[i] = used_c
-        fit_used_mem[i] = used_m
-
-    # -- vocabularies -------------------------------------------------------
-    port_vocab: Dict[int, int] = {}
-    sel_vocab: Dict[Tuple[str, str], int] = {}
-    pd_vocab: Dict[str, int] = {}
+    # -- service selector vocabulary (needed by the pod passes) -------------
+    services = list(services)
+    S = len(services)
+    svc_vocab: Dict[Tuple[str, str], int] = {}
+    ns_codes: Dict[str, int] = {}
 
     def intern(vocab, key):
         if key not in vocab:
             vocab[key] = len(vocab)
         return vocab[key]
 
-    def pod_port_list(p: api.Pod):
-        return [cp.host_port for c in p.spec.containers for cp in c.ports]
+    sv_ij: List[Tuple[int, int]] = []
+    for si, s in enumerate(services):
+        for kv in (s.spec.selector or {}).items():
+            sv_ij.append((si, intern(svc_vocab, kv)))
 
-    def pod_pd_list(p: api.Pod):
-        return [v.source.gce_persistent_disk.pd_name for v in p.spec.volumes
-                if v.source.gce_persistent_disk is not None]
+    # -- pending pods: one Python pass pulls every field --------------------
+    port_vocab: Dict[int, int] = {}
+    sel_vocab: Dict[Tuple[str, str], int] = {}
+    pd_vocab: Dict[str, int] = {}
 
-    for p in pending_pods:
-        for port in pod_port_list(p):
-            if port:
-                intern(port_vocab, port)
+    req_cpu = np.zeros(P, np.int64)
+    req_mem = np.zeros(P, np.int64)
+    pod_host_idx = np.full(P, -1, np.int32)
+    pod_names: List[str] = []
+    pp_ij: List[Tuple[int, int]] = []   # (pod, port-vocab) pairs
+    ps_ij: List[Tuple[int, int]] = []   # (pod, selector-vocab)
+    pg_ij: List[Tuple[int, int]] = []   # (pod, pd-vocab)
+    pf_ij: List[Tuple[int, int]] = []   # (pod, service-selector-vocab)
+    pod_ns = np.zeros(P, np.int32)
+    for j, p in enumerate(pending_pods):
+        meta = p.metadata
+        pod_names.append(f"{meta.namespace}/{meta.name}")
+        pod_ns[j] = intern(ns_codes, meta.namespace)
+        lbls = meta.labels or {}
+        for kv in lbls.items():
+            t = svc_vocab.get(kv)
+            if t is not None:
+                pf_ij.append((j, t))
+        # inlined get_resource_request (predicates.go:93-101) — the 2x10k
+        # call + dataclass overhead shows up at 10k-pod waves
+        c_cpu = c_mem = 0
+        for c in p.spec.containers:
+            limits = c.resources.limits
+            q = limits.get(api.ResourceCPU)
+            if q is not None:
+                c_cpu += q.milli_value()
+            q = limits.get(api.ResourceMemory)
+            if q is not None:
+                c_mem += q.int_value()
+            for cp in c.ports:
+                if cp.host_port:
+                    pp_ij.append((j, intern(port_vocab, cp.host_port)))
+        req_cpu[j] = c_cpu
+        req_mem[j] = c_mem
         for kv in (p.spec.node_selector or {}).items():
-            intern(sel_vocab, kv)
-        for pd in pod_pd_list(p):
-            intern(pd_vocab, pd)
+            ps_ij.append((j, intern(sel_vocab, kv)))
+        for v in p.spec.volumes:
+            if v.source.gce_persistent_disk is not None:
+                pg_ij.append((j, intern(pd_vocab,
+                                        v.source.gce_persistent_disk.pd_name)))
+        if p.spec.host:
+            pod_host_idx[j] = node_index.get(p.spec.host, -2)
+    tie = _fnv1a64_batch([pod_tie_break_key(p) for p in pending_pods])
+    tie_hi = (tie >> np.uint64(32)).astype(np.int64)
+    tie_lo = (tie & np.uint64(0xFFFFFFFF)).astype(np.int64)
 
     K = _pad_to(len(port_vocab))
     K2 = _pad_to(len(sel_vocab))
     K3 = _pad_to(len(pd_vocab))
 
-    node_ports = np.zeros((N, K), bool)
-    node_pds = np.zeros((N, K3), bool)
-    for host, host_pods in pods_by_host.items():
-        i = node_index.get(host)
-        if i is None:
-            continue
-        for p in host_pods:
-            for port in pod_port_list(p):
-                k = port_vocab.get(port)
-                if k is not None and port:
-                    node_ports[i, k] = True
-            for pd in pod_pd_list(p):
-                k = pd_vocab.get(pd)
-                if k is not None:
-                    node_pds[i, k] = True
+    def scatter_true(pairs, rows, cols) -> np.ndarray:
+        out = np.zeros((rows, cols), bool)
+        if pairs:
+            idx = np.asarray(pairs, np.int64)
+            out[idx[:, 0], idx[:, 1]] = True
+        return out
 
+    pod_ports = scatter_true(pp_ij, P, K)
+    pod_sel = scatter_true(ps_ij, P, K2)
+    pod_pds = scatter_true(pg_ij, P, K3)
+
+    # -- node label plane for the selector vocabulary -----------------------
     node_sel = np.zeros((N, K2), bool)
     for i, n in enumerate(nodes):
-        lbls = n.metadata.labels or {}
-        for kv, k in sel_vocab.items():
-            if lbls.get(kv[0]) == kv[1]:
+        for kv in _label_items(n.metadata.labels):
+            k = sel_vocab.get(kv)
+            if k is not None:
                 node_sel[i, k] = True
 
-    # -- pending pods -------------------------------------------------------
-    req_cpu = np.zeros(P, np.int64)
-    req_mem = np.zeros(P, np.int64)
-    pod_ports = np.zeros((P, K), bool)
-    pod_sel = np.zeros((P, K2), bool)
-    pod_pds = np.zeros((P, K3), bool)
-    pod_host_idx = np.full(P, -1, np.int32)
-    tie_hi = np.zeros(P, np.int64)
-    tie_lo = np.zeros(P, np.int64)
-    pod_names = []
-    for j, p in enumerate(pending_pods):
-        pod_names.append(f"{p.metadata.namespace}/{p.metadata.name}")
-        req = get_resource_request(p)
-        req_cpu[j] = req.milli_cpu
-        req_mem[j] = req.memory
-        for port in pod_port_list(p):
-            if port:
-                pod_ports[j, port_vocab[port]] = True
-        for kv in (p.spec.node_selector or {}).items():
-            pod_sel[j, sel_vocab[kv]] = True
-        for pd in pod_pd_list(p):
-            pod_pds[j, pd_vocab[pd]] = True
-        if p.spec.host:
-            pod_host_idx[j] = node_index.get(p.spec.host, -2)
-        h = fnv1a64(pod_tie_break_key(p))
-        tie_hi[j] = h >> 32
-        tie_lo[j] = h & 0xFFFFFFFF
+    # -- existing pods: one Python pass, then bulk accumulation -------------
+    e_host = np.full(E, N, np.int64)      # N = unknown/unassigned slot
+    e_cpu = np.zeros(E, np.int64)
+    e_mem = np.zeros(E, np.int64)
+    np_ij: List[Tuple[int, int]] = []     # (node, port-vocab)
+    nd_ij: List[Tuple[int, int]] = []     # (node, pd-vocab)
+    ef_ij: List[Tuple[int, int]] = []     # (pod, service-selector-vocab)
+    e_ns = np.full(E, -9, np.int32)       # unseen namespaces can't match
+    for e, p in enumerate(existing_pods):
+        meta = p.metadata
+        code = ns_codes.get(meta.namespace)
+        if code is not None:
+            e_ns[e] = code
+        for kv in (meta.labels or {}).items():
+            t = svc_vocab.get(kv)
+            if t is not None:
+                ef_ij.append((e, t))
+        i = node_index.get(p.status.host, -1)
+        c_cpu = c_mem = 0
+        for c in p.spec.containers:
+            limits = c.resources.limits
+            q = limits.get(api.ResourceCPU)
+            if q is not None:
+                c_cpu += q.milli_value()
+            q = limits.get(api.ResourceMemory)
+            if q is not None:
+                c_mem += q.int_value()
+            if i >= 0:
+                for cp in c.ports:
+                    k = port_vocab.get(cp.host_port)
+                    if k is not None and cp.host_port:
+                        np_ij.append((i, k))
+        e_cpu[e] = c_cpu
+        e_mem[e] = c_mem
+        if i < 0:
+            continue
+        e_host[e] = i
+        for v in p.spec.volumes:
+            if v.source.gce_persistent_disk is not None:
+                k = pd_vocab.get(v.source.gce_persistent_disk.pd_name)
+                if k is not None:
+                    nd_ij.append((i, k))
 
-    # -- service spreading groups ------------------------------------------
+    node_ports = scatter_true(np_ij, N, K)
+    node_pds = scatter_true(nd_ij, N, K3)
+
+    on_node = e_host < N
+    score_used_cpu = np.zeros(N, np.int64)
+    score_used_mem = np.zeros(N, np.int64)
+    np.add.at(score_used_cpu, e_host[on_node], e_cpu[on_node])
+    np.add.at(score_used_mem, e_host[on_node], e_mem[on_node])
+
+    # greedy Filter accumulators (CheckPodsExceedingCapacity :104-124):
+    # when a node's total existing usage fits its capacity, every prefix fit
+    # too — the greedy result equals the sum and nothing exceeded. Only the
+    # (rare) overflowing nodes need the sequential in-order walk.
+    fit_used_cpu = score_used_cpu.copy()
+    fit_used_mem = score_used_mem.copy()
+    fit_exceeded = np.zeros(N, bool)
+    all_fit = ((cap_cpu == 0) | (score_used_cpu <= cap_cpu)) & \
+              ((cap_mem == 0) | (score_used_mem <= cap_mem))
+    if not all_fit.all():
+        slow = set(np.nonzero(~all_fit)[0].tolist())
+        per_host: Dict[int, Tuple[int, int]] = {i: (0, 0) for i in slow}
+        for e in range(E):
+            i = int(e_host[e])
+            if i not in per_host:
+                continue
+            used_c, used_m = per_host[i]
+            fits_c = cap_cpu[i] == 0 or (cap_cpu[i] - used_c) >= e_cpu[e]
+            fits_m = cap_mem[i] == 0 or (cap_mem[i] - used_m) >= e_mem[e]
+            if fits_c and fits_m:
+                per_host[i] = (used_c + int(e_cpu[e]), used_m + int(e_mem[e]))
+            else:
+                fit_exceeded[i] = True
+        for i, (used_c, used_m) in per_host.items():
+            fit_used_cpu[i] = used_c
+            fit_used_mem[i] = used_m
+
+    # -- service groups (vectorized) ---------------------------------------
     # group = (namespace, index of FIRST service whose selector matches the
     # pod) — mirrors ServiceSpread's "just use the first service"
-    # (spreading.go:44). Group membership of *any* pod (existing or committed)
-    # is: same namespace + selector match.
-    services = list(services)
-    # set-based service selectors reduce to (k,v)-subset checks; doing the
-    # subset test on frozensets directly (instead of Selector.matches per
-    # pod x group) is the encode hot path at 10k-pod waves
-    svc_items = [frozenset((s.spec.selector or {}).items()) for s in services]
-    group_ids: Dict[Tuple[str, int], int] = {}
+    # (spreading.go:44). Group membership of *any* pod (existing or
+    # committed) is: same namespace + selector match.
+    T = max(1, len(svc_vocab))
+    svc_req = scatter_true(sv_ij, max(1, S), T)[:S] if S else np.zeros((0, T), bool)
+    req_cnt = svc_req.sum(axis=1).astype(np.int32)            # [S]
+    svc_ns = np.array([(intern(ns_codes, s.metadata.namespace)
+                        if s.metadata.namespace else -1) for s in services],
+                      np.int32) if S else np.zeros(0, np.int32)
+
+    def feat_matrix(pairs, rows) -> np.ndarray:
+        out = np.zeros((max(1, rows), T), np.float32)
+        if pairs:
+            idx = np.asarray(pairs, np.int64)
+            out[idx[:, 0], idx[:, 1]] = 1.0
+        return out[:rows]
+
+    group_ids: Dict[Tuple[int, int], int] = {}   # (ns_code, svc_idx) -> gid
     pod_gid = np.full(P, -1, np.int32)
+    if S and P:
+        pod_feat = feat_matrix(pf_ij, P)                       # [P, T]
+        hits = pod_feat @ svc_req.astype(np.float32).T          # [P, S]
+        subset_pending = hits == req_cnt[None, :]
+        eligible = subset_pending & (req_cnt[None, :] > 0) & \
+            ((svc_ns[None, :] == -1) | (svc_ns[None, :] == pod_ns[:, None]))
+        has_svc = eligible.any(axis=1)
+        first_svc = np.argmax(eligible, axis=1)
+        for j in np.nonzero(has_svc)[0]:
+            key = (int(pod_ns[j]), int(first_svc[j]))
+            if key not in group_ids:
+                group_ids[key] = len(group_ids)
+            pod_gid[j] = group_ids[key]
 
-    def pod_items(p: api.Pod):
-        return set((p.metadata.labels or {}).items())
-
-    pending_items = [pod_items(p) for p in pending_pods]
-
-    def first_service_for(p: api.Pod, items) -> Optional[int]:
-        for si, s in enumerate(services):
-            if s.metadata.namespace and s.metadata.namespace != p.metadata.namespace:
-                continue
-            if not svc_items[si]:
-                continue
-            if svc_items[si] <= items:
-                return si
-        return None
-
-    for j, p in enumerate(pending_pods):
-        si = first_service_for(p, pending_items[j])
-        if si is None:
-            continue
-        key = (p.metadata.namespace, si)
-        if key not in group_ids:
-            if len(group_ids) >= max_groups:
-                raise ValueError(
-                    f"pending batch spans more than {max_groups} service groups; "
-                    "split the wave or raise max_groups")
-            group_ids[key] = len(group_ids)
-        pod_gid[j] = group_ids[key]
-
-    G = max(1, len(group_ids))
+    G_real = len(group_ids)
+    G = _pow2_pad(max(1, G_real))
     group_counts = np.zeros((G, N + 1), np.int32)
     pod_group_member = np.zeros((P, G), bool)
+    anchor_node = np.full(G, -1, np.int64)       # node idx of initial anchor
+    anchor_unknown = np.zeros(G, bool)           # anchor exists off-list
     if group_ids:
-        existing_items = [(p, pod_items(p)) for p in existing_pods]
-        for (ns, si), g in group_ids.items():
-            sel = svc_items[si]
-            for p, items in existing_items:
-                if p.metadata.namespace != ns or not sel <= items:
-                    continue
-                i = node_index.get(p.status.host, N)  # unknown host -> slot N
-                group_counts[g, i] += 1
+        g_ns = np.array([k[0] for k in group_ids], np.int32)     # [G_real]
+        g_si = np.array([k[1] for k in group_ids], np.int64)
+        pod_group_member[:, :G_real] = subset_pending[:, g_si] & \
+            (pod_ns[:, None] == g_ns[None, :])
+        if E:
+            e_feat = feat_matrix(ef_ij, E)                      # [E, T]
+            e_hits = e_feat @ svc_req.astype(np.float32).T       # [E, S]
+            subset_exist = e_hits == req_cnt[None, :]
+            member_exist = subset_exist[:, g_si] & \
+                (e_ns[:, None] == g_ns[None, :])                 # [E, G_real]
+            for g in range(G_real):
+                mask = member_exist[:, g]
+                if mask.any():
+                    group_counts[g, :] = np.bincount(
+                        e_host[mask], minlength=N + 1).astype(np.int32)
+                    first = int(np.argmax(mask))
+                    a = int(e_host[first])
+                    if a < N:
+                        anchor_node[g] = a
+                    else:
+                        anchor_unknown[g] = True
+
+    # -- policy: NodeLabelPresence -> node_extra_ok ------------------------
+    extra_ok = (node_extra_ok.copy() if node_extra_ok is not None
+                else np.ones(N, bool))
+    if policy.label_presence:
+        for i, n in enumerate(nodes):
+            lbls = n.metadata.labels or {}
+            for labels, presence in policy.label_presence:
+                for l in labels:
+                    if (l in lbls) != presence:
+                        extra_ok[i] = False
+                        break
+
+    # -- policy: NodeLabelPriority -> static additive score ----------------
+    score_static = np.zeros(N, np.int32)
+    if policy.label_prefs:
+        for i, n in enumerate(nodes):
+            lbls = n.metadata.labels or {}
+            acc = 0
+            for label, presence, weight in policy.label_prefs:
+                if (label in lbls) == presence:
+                    acc += 10 * weight
+            score_static[i] = acc
+
+    # -- policy: ServiceAffinity value codes + anchors ---------------------
+    L = len(policy.affinity_labels)
+    node_aff_vals = np.full((N, L), -1, np.int32)
+    pod_aff_static = np.full((P, L), -2, np.int32)
+    anchor_vals0 = np.full((G, L), -3, np.int32)
+    has_anchor0 = np.zeros(G, bool)
+    if L:
+        val_vocabs: List[Dict[str, int]] = [{} for _ in range(L)]
+        for li, label in enumerate(policy.affinity_labels):
+            vocab = val_vocabs[li]
+            for i, n in enumerate(nodes):
+                v = (n.metadata.labels or {}).get(label)
+                if v is not None:
+                    node_aff_vals[i, li] = intern(vocab, v)
             for j, p in enumerate(pending_pods):
-                if p.metadata.namespace == ns and sel <= pending_items[j]:
-                    pod_group_member[j, g] = True
+                v = (p.spec.node_selector or {}).get(label)
+                if v is not None:
+                    pod_aff_static[j, li] = intern(vocab, v)
+        has_anchor0[:] = (anchor_node >= 0) | anchor_unknown
+        ok = anchor_node >= 0
+        anchor_vals0[ok] = node_aff_vals[anchor_node[ok]]
+        # serial semantics: a pod consulting an anchor whose host is not a
+        # known node fails that pod's schedule() (NodeInfo lookup error,
+        # predicates.go:238-324) and the driver requeues it with backoff.
+        # Mark exactly those pods infeasible everywhere (an impossible
+        # pinned code) so the rest of the wave schedules normally.
+        if anchor_unknown.any():
+            needs_anchor = (pod_gid >= 0) & (pod_aff_static == -2).any(axis=1)
+            for j in np.nonzero(needs_anchor)[0]:
+                if anchor_unknown[pod_gid[j]]:
+                    pod_aff_static[j, 0] = -100
+
+    # -- policy: ServiceAntiAffinity zone codes ----------------------------
+    A = len(policy.anti_affinity)
+    node_zone = np.full((A, N), -1, np.int32)
+    for a, (label, _w) in enumerate(policy.anti_affinity):
+        vocab: Dict[str, int] = {}
+        for i, n in enumerate(nodes):
+            v = (n.metadata.labels or {}).get(label)
+            if v is not None:
+                node_zone[a, i] = intern(vocab, v)
 
     return ClusterSnapshot(
         node_names=[n.metadata.name for n in nodes],
@@ -283,12 +476,18 @@ def encode_snapshot(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
         fit_exceeded=fit_exceeded,
         score_used_cpu=score_used_cpu, score_used_mem=score_used_mem,
         node_ports=node_ports, node_sel=node_sel, node_pds=node_pds,
-        node_extra_ok=(node_extra_ok if node_extra_ok is not None
-                       else np.ones(N, bool)),
+        node_extra_ok=extra_ok,
         pod_names=pod_names,
         req_cpu=req_cpu, req_mem=req_mem,
         pod_ports=pod_ports, pod_sel=pod_sel, pod_pds=pod_pds,
         pod_host_idx=pod_host_idx, tie_hi=tie_hi, tie_lo=tie_lo,
         pod_gid=pod_gid, pod_group_member=pod_group_member,
         group_counts=group_counts,
+        score_static=score_static,
+        node_aff_vals=node_aff_vals, pod_aff_static=pod_aff_static,
+        anchor_vals0=anchor_vals0, has_anchor0=has_anchor0,
+        node_zone=node_zone,
+        policy=policy,
+        w_least_requested=policy.w_lr, w_spreading=policy.w_spread,
+        w_equal=policy.w_equal,
     )

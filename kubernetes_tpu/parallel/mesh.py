@@ -71,6 +71,12 @@ def pad_inputs_for_mesh(inp: SolverInputs, mesh: Mesh) -> Tuple[SolverInputs, in
         pod_host_idx=inp.pod_host_idx, tie_hi=inp.tie_hi, tie_lo=inp.tie_lo,
         pod_gid=inp.pod_gid, pod_group_member=inp.pod_group_member,
         group_counts=pad_n(inp.group_counts, axis=1),
+        score_static=pad_n(inp.score_static),
+        node_aff_vals=pad_n(inp.node_aff_vals, fill=-1),
+        pod_aff_static=inp.pod_aff_static,
+        anchor_vals0=inp.anchor_vals0, has_anchor0=inp.has_anchor0,
+        zone_labeled=pad_n(inp.zone_labeled, axis=1, fill=False),
+        zone_onehot=pad_n(inp.zone_onehot, axis=1),
     ), n
 
 
@@ -96,12 +102,18 @@ def _input_shardings(mesh: Mesh) -> SolverInputs:
         # counts: small [G, N+1] — the +1 overflow slot breaks even node
         # sharding; replicate (GSPMD gathers the one-hot update, tiny)
         group_counts=rep,
+        score_static=node,
+        node_aff_vals=node2d,
+        pod_aff_static=rep,
+        anchor_vals0=rep, has_anchor0=rep,
+        zone_labeled=s(None, "nodes"),
+        zone_onehot=s(None, "nodes", None),
     )
 
 
 def solve_sharded(inp: SolverInputs, mesh: Optional[Mesh] = None,
-                  w_lr: int = 1, w_spread: int = 1, w_equal: int = 0
-                  ) -> Tuple[np.ndarray, np.ndarray]:
+                  w_lr: int = 1, w_spread: int = 1, w_equal: int = 0,
+                  pol=None) -> Tuple[np.ndarray, np.ndarray]:
     """Run solve_jit under a device mesh. Decisions are identical to the
     single-device path; only the layout changes."""
     mesh = mesh or make_mesh()
@@ -110,7 +122,8 @@ def solve_sharded(inp: SolverInputs, mesh: Optional[Mesh] = None,
     placed = jax.tree.map(jax.device_put, tuple(padded), tuple(shardings))
     with mesh:
         chosen, scores = solve_jit(SolverInputs(*placed), w_lr=w_lr,
-                                   w_spread=w_spread, w_equal=w_equal)
+                                   w_spread=w_spread, w_equal=w_equal,
+                                   pol=pol)
     chosen = np.asarray(chosen)
     scores = np.asarray(scores)
     # padded nodes are infeasible, so indices never point past n; no remap

@@ -116,6 +116,11 @@ class SchedulerConfig:
     next_pod: Callable[[], api.Pod] = None
     error: Callable[[api.Pod, Exception], None] = None
     recorder: Optional[EventRecorder] = None
+    # what the config was built from, so alternate drivers (tpu_batch) can
+    # refuse configurations they cannot model instead of silently solving
+    # the default-provider problem
+    provider: str = schedplugins.DEFAULT_PROVIDER
+    policy: Optional[schedplugins.Policy] = None
 
 
 class Scheduler:
@@ -274,6 +279,8 @@ class ConfigFactory:
             next_pod=self._next_pod,
             error=self._make_error_func(),
             recorder=recorder,
+            provider=provider,
+            policy=policy,
         )
 
     def stop(self) -> None:

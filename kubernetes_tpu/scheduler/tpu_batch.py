@@ -30,6 +30,7 @@ from kubernetes_tpu.models.batch_solver import (
     snapshot_to_inputs,
     solve_jit,
 )
+from kubernetes_tpu.models.policy import BatchPolicy, batch_policy_from
 from kubernetes_tpu.models.snapshot import encode_snapshot
 from kubernetes_tpu.scheduler.driver import ConfigFactory, SchedulerConfig
 from kubernetes_tpu.scheduler.generic import FitError
@@ -38,17 +39,27 @@ __all__ = ["BatchScheduler"]
 
 
 class BatchScheduler:
-    """Wave-based driver over SchedulerConfig plumbing."""
+    """Wave-based driver over SchedulerConfig plumbing.
+
+    ``batch_policy`` is the normalized form of the configured provider /
+    policy file (models/policy.batch_policy_from); the solver honors the
+    same predicate/priority sets and weights the serial driver would use.
+    When not given explicitly it is derived from the config's recorded
+    provider/policy, so constructing this class for an unsupported
+    configuration raises UnsupportedPolicy — a non-default policy can never
+    silently fall through to default-provider decisions."""
 
     def __init__(self, config: SchedulerConfig, factory: ConfigFactory,
                  client, wave_size: int = 1024, wave_linger_s: float = 0.02,
-                 solve_fn=None):
+                 solve_fn=None, batch_policy: BatchPolicy = None):
         self.config = config
         self.factory = factory
         self.client = client
         self.wave_size = wave_size
         self.wave_linger_s = wave_linger_s
         self.solve_fn = solve_fn or self._default_solve
+        self.batch_policy = batch_policy or batch_policy_from(
+            getattr(config, "provider", None), getattr(config, "policy", None))
         self._stop = threading.Event()
 
     # -- wave assembly ------------------------------------------------------
@@ -67,8 +78,9 @@ class BatchScheduler:
 
     # -- solving ------------------------------------------------------------
     def _default_solve(self, nodes, existing, pending, services):
-        snap = encode_snapshot(nodes, existing, pending, services)
-        chosen, _ = solve_jit(snapshot_to_inputs(snap))
+        snap = encode_snapshot(nodes, existing, pending, services,
+                               policy=self.batch_policy)
+        chosen, _ = solve_jit(snapshot_to_inputs(snap), pol=self.batch_policy)
         import numpy as np
 
         return decisions_to_names(snap, np.asarray(chosen))

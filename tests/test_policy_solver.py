@@ -1,0 +1,337 @@
+"""Policy coverage of the TPU batch solver.
+
+The batch path must make exactly the serial path's decisions under ANY
+supported provider/policy configuration (ref: the policy plugin set —
+predicates.go:194-324 CheckNodeLabelPresence/CheckServiceAffinity,
+priorities.go:98-134 NodeLabelPriority, spreading.go:104-168
+ServiceAntiAffinity, plus configured weights from the JSON Policy file,
+plugin/pkg/scheduler/api/types.go:23-103). Deterministic cases pin each
+plugin's semantics; the fuzz sweeps randomized policies x clusters.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.quantity import Quantity
+from kubernetes_tpu.models.batch_solver import (
+    decisions_to_names,
+    snapshot_to_inputs,
+    solve_jit,
+)
+from kubernetes_tpu.models.oracle import solve_serial
+from kubernetes_tpu.models.policy import (
+    BatchPolicy,
+    UnsupportedPolicy,
+    batch_policy_from,
+)
+from kubernetes_tpu.models.snapshot import encode_snapshot
+from kubernetes_tpu.scheduler.plugins import Policy, load_policy
+
+
+def mk_node(name, cpu="8", mem="16Gi", labels=None):
+    return api.Node(metadata=api.ObjectMeta(name=name, labels=labels or {}),
+                    spec=api.NodeSpec(capacity={"cpu": Quantity(cpu),
+                                                "memory": Quantity(mem)}))
+
+
+def mk_pod(name, ns="default", labels=None, cpu="100m", mem="64Mi",
+           selector=None, host="", status_host=""):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace=ns, uid=f"uid-{name}",
+                                labels=labels or {}),
+        spec=api.PodSpec(
+            host=host, node_selector=selector or {},
+            containers=[api.Container(
+                name="c", image="i",
+                resources=api.ResourceRequirements(limits={
+                    "cpu": Quantity(cpu), "memory": Quantity(mem)}))]),
+        status=api.PodStatus(host=status_host))
+
+
+def mk_service(name, selector, ns="default"):
+    return api.Service(metadata=api.ObjectMeta(name=name, namespace=ns),
+                       spec=api.ServiceSpec(port=80, selector=selector))
+
+
+def run_both(nodes, existing, pending, services, policy=None):
+    serial = solve_serial(nodes, existing, pending, services, policy=policy)
+    bp = batch_policy_from(policy=policy) if policy is not None \
+        else batch_policy_from()
+    snap = encode_snapshot(nodes, existing, pending, services, policy=bp)
+    chosen, _ = solve_jit(snapshot_to_inputs(snap), pol=bp)
+    batch = decisions_to_names(snap, np.asarray(chosen))
+    assert batch == serial, f"batch {batch}\nserial {serial}"
+    return serial
+
+
+# ---------------------------------------------------------------------------
+# deterministic plugin semantics
+# ---------------------------------------------------------------------------
+
+POLICY_AFFINITY = """
+{"predicates": [
+    {"name": "PodFitsResources"},
+    {"name": "aff", "argument": {"serviceAffinity": {"labels": ["zone"]}}}],
+ "priorities": [{"name": "LeastRequestedPriority", "weight": 1}]}
+"""
+
+
+def test_service_affinity_follows_existing_peer():
+    nodes = [mk_node("a1", labels={"zone": "za"}),
+             mk_node("b1", labels={"zone": "zb"}),
+             mk_node("b2", labels={"zone": "zb"})]
+    services = [mk_service("web", {"app": "web"})]
+    # an existing peer lives in zone zb -> all pending service pods must
+    # land in zb (predicates.go:256-276 anchor from first service pod)
+    existing = [mk_pod("seed", labels={"app": "web"}, status_host="b1")]
+    pending = [mk_pod(f"w{i}", labels={"app": "web"}) for i in range(4)]
+    policy = load_policy(POLICY_AFFINITY)
+    decisions = run_both(nodes, existing, pending, services, policy)
+    assert all(d in ("b1", "b2") for d in decisions), decisions
+
+
+def test_service_affinity_anchor_set_by_first_commit():
+    # no existing peers: the FIRST pending pod to commit picks freely, and
+    # every later service peer is pinned to its zone
+    nodes = [mk_node("a1", labels={"zone": "za"}),
+             mk_node("b1", labels={"zone": "zb"})]
+    services = [mk_service("web", {"app": "web"})]
+    pending = [mk_pod(f"w{i}", labels={"app": "web"}) for i in range(4)]
+    policy = load_policy(POLICY_AFFINITY)
+    decisions = run_both(nodes, [], pending, services, policy)
+    first_zone = "za" if decisions[0] == "a1" else "zb"
+    zones = {"a1": "za", "b1": "zb"}
+    assert all(zones[d] == first_zone for d in decisions), decisions
+
+
+def test_service_affinity_selector_pins_label():
+    nodes = [mk_node("a1", labels={"zone": "za"}),
+             mk_node("b1", labels={"zone": "zb"})]
+    services = [mk_service("web", {"app": "web"})]
+    existing = [mk_pod("seed", labels={"app": "web"}, status_host="b1")]
+    # node_selector zone=za overrides the anchor-derived value
+    # (predicates.go:247-254: selector wins for labels it pins)
+    pending = [mk_pod("w0", labels={"app": "web"}, selector={"zone": "za"})]
+    policy = load_policy(POLICY_AFFINITY)
+    decisions = run_both(nodes, existing, pending, services, policy)
+    assert decisions == ["a1"]
+
+
+def test_node_label_presence_filters():
+    policy = load_policy("""
+    {"predicates": [
+        {"name": "PodFitsResources"},
+        {"name": "ssd_only",
+         "argument": {"labelsPresence": {"labels": ["ssd"], "presence": true}}}],
+     "priorities": [{"name": "LeastRequestedPriority", "weight": 1}]}
+    """)
+    nodes = [mk_node("n0"), mk_node("n1", labels={"ssd": "true"}),
+             mk_node("n2", labels={"ssd": "true"})]
+    pending = [mk_pod(f"p{i}") for i in range(4)]
+    decisions = run_both(nodes, [], pending, [], policy)
+    assert set(decisions) <= {"n1", "n2"}
+
+
+def test_node_label_priority_prefers_labeled():
+    policy = load_policy("""
+    {"predicates": [{"name": "PodFitsResources"}],
+     "priorities": [
+        {"name": "pref_ssd", "weight": 3,
+         "argument": {"labelPreference": {"label": "ssd", "presence": true}}}]}
+    """)
+    nodes = [mk_node("n0"), mk_node("n1", labels={"ssd": "1"})]
+    decisions = run_both(nodes, [], [mk_pod("p0")], [], policy)
+    assert decisions == ["n1"]
+
+
+def test_service_anti_affinity_spreads_zones():
+    policy = load_policy("""
+    {"predicates": [{"name": "PodFitsResources"}],
+     "priorities": [
+        {"name": "zone_spread", "weight": 2,
+         "argument": {"serviceAntiAffinity": {"label": "zone"}}}]}
+    """)
+    nodes = [mk_node("a1", labels={"zone": "za"}),
+             mk_node("a2", labels={"zone": "za"}),
+             mk_node("b1", labels={"zone": "zb"})]
+    services = [mk_service("web", {"app": "web"})]
+    existing = [mk_pod("e0", labels={"app": "web"}, status_host="a1"),
+                mk_pod("e1", labels={"app": "web"}, status_host="a2")]
+    # za already has 2 peers, zb none -> zb scores higher
+    decisions = run_both(nodes, existing,
+                         [mk_pod("w0", labels={"app": "web"})], services,
+                         policy)
+    assert decisions == ["b1"]
+
+
+def test_configured_weights_change_decisions():
+    # heavily-weighted LeastRequested packs onto the roomy node even though
+    # a service peer lives there; the default weights spread instead
+    nodes = [mk_node("big", cpu="64", mem="128Gi"), mk_node("small")]
+    services = [mk_service("web", {"app": "web"})]
+    existing = [mk_pod("e0", labels={"app": "web"}, status_host="big")]
+    pending = [mk_pod("w0", labels={"app": "web"}, cpu="2", mem="512Mi")]
+    heavy = load_policy("""
+    {"predicates": [{"name": "PodFitsResources"}],
+     "priorities": [
+        {"name": "LeastRequestedPriority", "weight": 20},
+        {"name": "ServiceSpreadingPriority", "weight": 1}]}
+    """)
+    d_heavy = run_both(nodes, existing, pending, services, heavy)
+    d_default = run_both(nodes, existing, pending, services, None)
+    assert d_heavy == ["big"]
+    assert d_default == ["small"]
+
+
+def test_empty_priorities_equal_fallback():
+    policy = Policy(predicates=[], priorities=[])
+    nodes = [mk_node("n0"), mk_node("n1")]
+    pending = [mk_pod(f"p{i}") for i in range(3)]
+    decisions = run_both(nodes, [], pending, [], policy)
+    assert all(d is not None for d in decisions)
+
+
+def test_all_zero_weights_schedules_nothing():
+    policy = load_policy("""
+    {"predicates": [{"name": "PodFitsResources"}],
+     "priorities": [{"name": "LeastRequestedPriority", "weight": 0}]}
+    """)
+    nodes = [mk_node("n0")]
+    decisions = run_both(nodes, [], [mk_pod("p0")], [], policy)
+    assert decisions == [None]
+
+
+def test_unknown_plugin_raises_unsupported():
+    with pytest.raises(UnsupportedPolicy):
+        batch_policy_from(policy=load_policy(
+            '{"predicates": [{"name": "SomebodysCustomPredicate"}],'
+            ' "priorities": []}'))
+    with pytest.raises(UnsupportedPolicy):
+        batch_policy_from(policy=load_policy(
+            '{"predicates": [],'
+            ' "priorities": [{"name": "MysteryPriority", "weight": 2}]}'))
+
+
+# ---------------------------------------------------------------------------
+# randomized policy x cluster equivalence fuzz
+# ---------------------------------------------------------------------------
+
+def _random_policy(rng: random.Random) -> Policy:
+    preds = []
+    for name in ("PodFitsPorts", "PodFitsResources", "NoDiskConflict",
+                 "MatchNodeSelector", "HostName"):
+        if rng.random() < 0.7:
+            preds.append({"name": name})
+    if rng.random() < 0.4:
+        preds.append({"name": "label_req", "argument": {"labelsPresence": {
+            "labels": ["ssd"], "presence": rng.random() < 0.5}}})
+    if rng.random() < 0.5:
+        labels = rng.choice([["zone"], ["zone", "rack"]])
+        preds.append({"name": "aff",
+                      "argument": {"serviceAffinity": {"labels": labels}}})
+    prios = []
+    for name in ("LeastRequestedPriority", "ServiceSpreadingPriority",
+                 "EqualPriority"):
+        if rng.random() < 0.7:
+            prios.append({"name": name, "weight": rng.randint(0, 3)})
+    if rng.random() < 0.5:
+        prios.append({"name": "zone_anti", "weight": rng.randint(0, 3),
+                      "argument": {"serviceAntiAffinity": {"label": "zone"}}})
+    if rng.random() < 0.4:
+        prios.append({"name": "pref", "weight": rng.randint(0, 2),
+                      "argument": {"labelPreference": {
+                          "label": "ssd", "presence": rng.random() < 0.5}}})
+    import json
+
+    return load_policy(json.dumps({"predicates": preds, "priorities": prios}))
+
+
+def _random_cluster(rng: random.Random, n_nodes=14, n_existing=20,
+                    n_pending=24, n_services=5):
+    zones = ["z0", "z1", "z2"]
+    racks = ["r0", "r1"]
+    nodes = []
+    for i in range(n_nodes):
+        labels = {}
+        if rng.random() < 0.8:
+            labels["zone"] = rng.choice(zones)
+        if rng.random() < 0.6:
+            labels["rack"] = rng.choice(racks)
+        if rng.random() < 0.4:
+            labels["ssd"] = "true"
+        nodes.append(mk_node(f"n{i:02d}", cpu=rng.choice(["2", "4", "8"]),
+                             mem=rng.choice(["4Gi", "8Gi"]), labels=labels))
+    services = [mk_service(f"s{k}", {"app": f"a{k}"},
+                           ns=rng.choice(["default", "other"]))
+                for k in range(n_services)]
+
+    def rand_pod(name, hosted):
+        labels = {}
+        if rng.random() < 0.8:
+            labels["app"] = f"a{rng.randrange(n_services)}"
+        selector = {}
+        if rng.random() < 0.25:
+            selector["zone"] = rng.choice(zones)
+        if rng.random() < 0.1:
+            selector["rack"] = rng.choice(racks)
+        kwargs = dict(
+            ns=rng.choice(["default", "other"]),
+            labels=labels, selector=selector,
+            cpu=f"{rng.choice([100, 250, 500, 1000])}m",
+            mem=f"{rng.choice([64, 128, 512])}Mi")
+        if hosted:
+            kwargs["status_host"] = nodes[rng.randrange(n_nodes)].metadata.name
+        return mk_pod(name, **kwargs)
+
+    existing = [rand_pod(f"e{i:03d}", True) for i in range(n_existing)]
+    pending = [rand_pod(f"p{i:03d}", False) for i in range(n_pending)]
+    # sprinkle ports / pinned hosts on pending pods
+    for p in pending:
+        if rng.random() < 0.15:
+            p.spec.containers[0].ports = [api.ContainerPort(
+                container_port=80, host_port=8000 + rng.randrange(4))]
+        if rng.random() < 0.05:
+            p.spec.host = nodes[rng.randrange(n_nodes)].metadata.name
+    return nodes, existing, pending, services
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_fuzz_policy_equivalence(seed):
+    rng = random.Random(1000 + seed)
+    nodes, existing, pending, services = _random_cluster(rng)
+    try:
+        policy = _random_policy(rng)
+        batch_policy_from(policy=policy)
+    except UnsupportedPolicy:
+        pytest.skip("random policy fell outside the modeled set")
+    run_both(nodes, existing, pending, services, policy)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fuzz_provider_equivalence(seed):
+    """Default provider, randomized clusters — guards the fast path."""
+    rng = random.Random(2000 + seed)
+    nodes, existing, pending, services = _random_cluster(rng)
+    run_both(nodes, existing, pending, services, None)
+
+
+def test_affinity_unknown_anchor_fails_only_consulting_pod():
+    """A service peer on an off-list node (cordoned/deleted) poisons only
+    the pods that consult that anchor; the rest of the wave schedules.
+    (The serial path fails the consulting pod's schedule() call with a
+    NodeInfo lookup error and requeues it — not the whole wave.)"""
+    nodes = [mk_node("a1", labels={"zone": "za"}),
+             mk_node("b1", labels={"zone": "zb"})]
+    services = [mk_service("web", {"app": "web"})]
+    existing = [mk_pod("ghost", labels={"app": "web"}, status_host="gone")]
+    pending = [mk_pod("w0", labels={"app": "web"}),        # consults anchor
+               mk_pod("other", labels={"app": "x"})]       # unrelated
+    bp = batch_policy_from(policy=load_policy(POLICY_AFFINITY))
+    snap = encode_snapshot(nodes, existing, pending, services, policy=bp)
+    chosen, _ = solve_jit(snapshot_to_inputs(snap), pol=bp)
+    batch = decisions_to_names(snap, np.asarray(chosen))
+    assert batch[0] is None
+    assert batch[1] is not None

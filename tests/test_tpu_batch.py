@@ -82,3 +82,55 @@ def test_batch_scheduler_requeues_unschedulable():
     finally:
         sched.stop()
         factory.stop()
+
+
+def test_batch_scheduler_many_service_groups():
+    """A wave spanning hundreds of service groups must schedule to
+    completion — the encoder pads the group axis instead of refusing
+    (round-1 weakness: >64 groups raised and the whole wave requeued
+    forever)."""
+    n_services = 200
+    m = Master()
+    client = Client(InProcessTransport(m))
+    for i in range(8):
+        client.nodes().create(mk_node(f"n{i}", cpu="64", mem="128Gi"))
+    for s in range(n_services):
+        client.services().create(api.Service(
+            metadata=api.ObjectMeta(name=f"svc-{s:03d}", namespace="default"),
+            spec=api.ServiceSpec(port=80, selector={"app": f"app-{s:03d}"})))
+    factory = ConfigFactory(client, node_poll_period=0.1)
+    config = factory.create()
+    sched = BatchScheduler(config, factory, client, wave_size=256,
+                           wave_linger_s=0.2).run()
+    try:
+        time.sleep(0.3)  # let reflectors sync
+        for s in range(n_services):
+            client.pods().create(mk_pod(f"p{s:03d}", app=f"app-{s:03d}"))
+        assert _wait(lambda: all(p.spec.host
+                                 for p in client.pods().list().items),
+                     timeout=30.0), "wave with 200 service groups stalled"
+    finally:
+        sched.stop()
+        factory.stop()
+
+
+def test_encode_many_groups_matches_serial():
+    """Encoder-level: 150 groups in one wave, decisions bit-identical."""
+    import numpy as np
+
+    from kubernetes_tpu.models.batch_solver import (
+        decisions_to_names, snapshot_to_inputs, solve_jit)
+    from kubernetes_tpu.models.oracle import solve_serial
+    from kubernetes_tpu.models.snapshot import encode_snapshot
+
+    nodes = [mk_node(f"n{i}", cpu="64", mem="128Gi") for i in range(10)]
+    services = [api.Service(
+        metadata=api.ObjectMeta(name=f"s{k}", namespace="default"),
+        spec=api.ServiceSpec(port=80, selector={"app": f"a{k}"}))
+        for k in range(150)]
+    pending = [mk_pod(f"p{k}", app=f"a{k}") for k in range(150)]
+    snap = encode_snapshot(nodes, [], pending, services)
+    assert snap.group_counts.shape[0] >= 150  # padded pow2 bucket
+    chosen, _ = solve_jit(snapshot_to_inputs(snap))
+    batch = decisions_to_names(snap, np.asarray(chosen))
+    assert batch == solve_serial(nodes, [], pending, services)
