@@ -10,6 +10,7 @@ and the standalone demo cluster.
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -51,6 +52,8 @@ class ClusterConfig:
     static_pod_dirs: Dict[str, str] = field(default_factory=dict)  # node -> dir
     kubelet_http: bool = False      # start a KubeletServer per node
     batch_scheduler: bool = False   # tpu-batch wave scheduler instead of serial
+    process_runtime: bool = False   # real local-process runtime (native pause)
+    runtime_root: str = ""          # ProcessRuntime state dir ("" = tmpdir)
 
 
 class _NodeHandle:
@@ -82,9 +85,20 @@ class Cluster:
             for i in range(c.num_nodes)]
 
         # kubelets (ref: integration.go:131-246 startKubelet x2)
+        self._runtime_tmp: Optional[str] = None
+        if c.process_runtime and not c.runtime_root:
+            import tempfile
+
+            self._runtime_tmp = tempfile.mkdtemp(prefix="ktpu-runtime-")
         for node in static_nodes:
             name = node.metadata.name
-            runtime = FakeRuntime(ip_base=f"10.{88 + len(self.nodes)}.0.")
+            if c.process_runtime:
+                from kubernetes_tpu.kubelet import ProcessRuntime
+
+                root = os.path.join(c.runtime_root or self._runtime_tmp, name)
+                runtime = ProcessRuntime(root)
+            else:
+                runtime = FakeRuntime(ip_base=f"10.{88 + len(self.nodes)}.0.")
             recorder = EventRecorder(self.client, api.EventSource(
                 component="kubelet", host=name))
             kubelet = Kubelet(name, runtime, client=self.client,
@@ -211,6 +225,12 @@ class Cluster:
             handle.kubelet.stop()
             if handle.server is not None:
                 handle.server.stop()
+            if hasattr(handle.runtime, "shutdown"):
+                handle.runtime.shutdown()
+        if self._runtime_tmp:
+            import shutil
+
+            shutil.rmtree(self._runtime_tmp, ignore_errors=True)
 
     # ------------------------------------------------------------------
     # test helpers (ref: integration.go podsOnMinions / waitForPodRunning)

@@ -7,7 +7,8 @@ back to the API server.
 
 The container runtime sits behind the ``ContainerRuntime`` seam
 (ref: dockertools.DockerInterface); ``FakeRuntime`` is the test double
-(ref: FakeDockerClient) and the integration harness's "node".
+(ref: FakeDockerClient) and ``ProcessRuntime`` runs pods as real local
+process groups with the native pause binary as each pod's sandbox.
 """
 
 from kubernetes_tpu.kubelet.runtime import (
@@ -16,13 +17,14 @@ from kubernetes_tpu.kubelet.runtime import (
     FakeRuntime,
     INFRA_CONTAINER_NAME,
 )
+from kubernetes_tpu.kubelet.process_runtime import ProcessRuntime
 from kubernetes_tpu.kubelet.kubelet import Kubelet
 from kubernetes_tpu.kubelet.config import PodConfig, ApiserverSource, FileSource
 from kubernetes_tpu.kubelet.pod_workers import PodWorkers
 from kubernetes_tpu.kubelet.status import StatusManager
 
 __all__ = [
-    "ContainerRecord", "ContainerRuntime", "FakeRuntime",
+    "ContainerRecord", "ContainerRuntime", "FakeRuntime", "ProcessRuntime",
     "INFRA_CONTAINER_NAME", "Kubelet", "PodConfig", "ApiserverSource",
     "FileSource", "PodWorkers", "StatusManager",
 ]

@@ -32,6 +32,33 @@ int main() {
   sigaction(SIGINT, &sa, nullptr);
   sigaction(SIGTERM, &sa, nullptr);
 
+  // Spawn-kill hardening: the runtime may start us with SIGTERM/SIGINT
+  // blocked because some supervised environments deliver a stray TERM to
+  // freshly-spawned processes before any handler can install. Discard
+  // exactly one pending stray (deliver it into SIG_IGN), then restore the
+  // graceful handler and unblock — later, legitimate TERMs still land.
+  sigset_t pending;
+  sigpending(&pending);
+  if (sigismember(&pending, SIGTERM) || sigismember(&pending, SIGINT)) {
+    struct sigaction ign = {};
+    ign.sa_handler = SIG_IGN;
+    sigaction(SIGTERM, &ign, nullptr);
+    sigaction(SIGINT, &ign, nullptr);
+    sigset_t unblock;
+    sigemptyset(&unblock);
+    sigaddset(&unblock, SIGTERM);
+    sigaddset(&unblock, SIGINT);
+    sigprocmask(SIG_UNBLOCK, &unblock, nullptr);  // stray delivered, ignored
+    sigaction(SIGTERM, &sa, nullptr);
+    sigaction(SIGINT, &sa, nullptr);
+  } else {
+    sigset_t unblock;
+    sigemptyset(&unblock);
+    sigaddset(&unblock, SIGTERM);
+    sigaddset(&unblock, SIGINT);
+    sigprocmask(SIG_UNBLOCK, &unblock, nullptr);
+  }
+
   // Reap children if we are PID 1 of the sandbox: ignore SIGCHLD with
   // SA_NOCLDWAIT so zombies never accumulate.
   struct sigaction reap = {};
