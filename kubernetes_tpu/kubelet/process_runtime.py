@@ -264,14 +264,29 @@ class ProcessRuntime(ContainerRuntime):
             if not p.record.running:
                 return
             pgid = p.popen.pid
-        # TERM -> grace -> KILL outside the lock (the wait can take seconds)
-        try:
-            os.killpg(pgid, signal.SIGTERM)
-        except ProcessLookupError:
-            pass
-        try:
-            p.popen.wait(timeout=self.stop_grace_s)
-        except subprocess.TimeoutExpired:
+        # TERM -> grace -> KILL outside the lock (the wait can take seconds).
+        # TERM is re-sent every 0.5s through the grace period: the pause
+        # binary may classify one early TERM as a spawn-kill stray and
+        # discard it (native/pause/pause.cc), so a single shot could wedge a
+        # graceful stop into the KILL path. Re-sending is idempotent for
+        # ordinary workloads and guarantees pause sees a post-window TERM.
+        deadline = time.time() + self.stop_grace_s
+        terminated = False
+        while True:
+            try:
+                os.killpg(pgid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                break
+            try:
+                p.popen.wait(timeout=min(0.5, remaining))
+                terminated = True
+                break
+            except subprocess.TimeoutExpired:
+                continue
+        if not terminated and p.popen.poll() is None:
             try:
                 os.killpg(pgid, signal.SIGKILL)
             except ProcessLookupError:

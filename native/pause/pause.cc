@@ -11,53 +11,58 @@
 // under runtimes that deliver TERM before KILL, and loops on other wakeups
 // (e.g. SIGCHLD when acting as PID 1) instead of dying.
 //
+// Spawn-kill hardening: some supervised environments deliver one stray
+// SIGTERM to freshly-spawned processes within ~1ms of exec. The runtime
+// spawns us with TERM/INT blocked (kubelet/process_runtime.py) so the stray
+// parks as pending until our handler is installed; the handler then treats
+// AT MOST ONE terminate signal arriving inside a short startup window as
+// that stray and discards it. Every later signal — or a second early one —
+// shuts us down. The runtime re-sends TERM during its grace period, so even
+// a legitimate stop that lands inside the stray window only costs one
+// re-send, never a KILL escalation. (This replaces an earlier sigpending/
+// SIG_IGN handshake that could eat a legitimate TERM arriving between its
+// pending-check and re-arm — the cause of a 137-on-graceful-stop flake.)
+//
 // Build: `make` here, or `make -C native` from the repo root. Static,
 // no libc-beyond-syscall dependencies in the hot path.
 
 #include <csignal>
-#include <cstdlib>
+#include <ctime>
 #include <unistd.h>
 
 namespace {
 
 volatile sig_atomic_t shutting_down = 0;
+volatile sig_atomic_t stray_budget = 1;
+struct timespec start_ts;
 
-void handle_terminate(int) { shutting_down = 1; }
+// Window after exec inside which a single terminate signal is presumed to
+// be the supervisor's spawn-kill stray rather than a real stop request.
+constexpr long kStrayWindowNs = 250L * 1000 * 1000;  // 250ms
+
+void handle_terminate(int) {
+  // clock_gettime is async-signal-safe (POSIX.1-2008).
+  struct timespec now;
+  clock_gettime(CLOCK_MONOTONIC, &now);
+  long long elapsed_ns =
+      (long long)(now.tv_sec - start_ts.tv_sec) * 1000000000LL +
+      (now.tv_nsec - start_ts.tv_nsec);
+  if (elapsed_ns < kStrayWindowNs && stray_budget > 0) {
+    stray_budget = 0;  // discard exactly one early stray
+    return;
+  }
+  shutting_down = 1;
+}
 
 }  // namespace
 
 int main() {
+  clock_gettime(CLOCK_MONOTONIC, &start_ts);
+
   struct sigaction sa = {};
   sa.sa_handler = handle_terminate;
   sigaction(SIGINT, &sa, nullptr);
   sigaction(SIGTERM, &sa, nullptr);
-
-  // Spawn-kill hardening: the runtime may start us with SIGTERM/SIGINT
-  // blocked because some supervised environments deliver a stray TERM to
-  // freshly-spawned processes before any handler can install. Discard
-  // exactly one pending stray (deliver it into SIG_IGN), then restore the
-  // graceful handler and unblock — later, legitimate TERMs still land.
-  sigset_t pending;
-  sigpending(&pending);
-  if (sigismember(&pending, SIGTERM) || sigismember(&pending, SIGINT)) {
-    struct sigaction ign = {};
-    ign.sa_handler = SIG_IGN;
-    sigaction(SIGTERM, &ign, nullptr);
-    sigaction(SIGINT, &ign, nullptr);
-    sigset_t unblock;
-    sigemptyset(&unblock);
-    sigaddset(&unblock, SIGTERM);
-    sigaddset(&unblock, SIGINT);
-    sigprocmask(SIG_UNBLOCK, &unblock, nullptr);  // stray delivered, ignored
-    sigaction(SIGTERM, &sa, nullptr);
-    sigaction(SIGINT, &sa, nullptr);
-  } else {
-    sigset_t unblock;
-    sigemptyset(&unblock);
-    sigaddset(&unblock, SIGTERM);
-    sigaddset(&unblock, SIGINT);
-    sigprocmask(SIG_UNBLOCK, &unblock, nullptr);
-  }
 
   // Reap children if we are PID 1 of the sandbox: ignore SIGCHLD with
   // SA_NOCLDWAIT so zombies never accumulate.
@@ -65,6 +70,15 @@ int main() {
   reap.sa_handler = SIG_IGN;
   reap.sa_flags = SA_NOCLDWAIT;
   sigaction(SIGCHLD, &reap, nullptr);
+
+  // Handlers are armed — release any signals the runtime spawned us with
+  // blocked. A pending stray delivers straight into handle_terminate, which
+  // classifies it by arrival time instead of guessing from sigpending.
+  sigset_t unblock;
+  sigemptyset(&unblock);
+  sigaddset(&unblock, SIGTERM);
+  sigaddset(&unblock, SIGINT);
+  sigprocmask(SIG_UNBLOCK, &unblock, nullptr);
 
   while (!shutting_down) {
     pause();  // sleeps until any signal; zero CPU while parked
