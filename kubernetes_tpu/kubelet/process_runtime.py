@@ -264,27 +264,32 @@ class ProcessRuntime(ContainerRuntime):
             if not p.record.running:
                 return
             pgid = p.popen.pid
+            is_pause = p.argv[0] == self.pause_binary
         # TERM -> grace -> KILL outside the lock (the wait can take seconds).
-        # TERM is re-sent every 0.5s through the grace period: the pause
-        # binary may classify one early TERM as a spawn-kill stray and
-        # discard it (native/pause/pause.cc), so a single shot could wedge a
-        # graceful stop into the KILL path. Re-sending is idempotent for
-        # ordinary workloads and guarantees pause sees a post-window TERM.
-        deadline = time.time() + self.stop_grace_s
+        # For the pause sandbox only, TERM is re-sent every 0.5s through the
+        # grace period: pause may classify one early TERM as a spawn-kill
+        # stray and discard it (native/pause/pause.cc), so a single shot
+        # could wedge a graceful stop into the KILL path. Ordinary workloads
+        # get the Docker-style single TERM — some tools treat a second
+        # signal as "force quit now", which would cut their grace short.
+        deadline = time.monotonic() + self.stop_grace_s
         terminated = False
         while True:
             try:
                 os.killpg(pgid, signal.SIGTERM)
             except ProcessLookupError:
                 pass
-            remaining = deadline - time.time()
+            remaining = deadline - time.monotonic()
             if remaining <= 0:
                 break
             try:
-                p.popen.wait(timeout=min(0.5, remaining))
+                p.popen.wait(timeout=min(0.5, remaining) if is_pause
+                             else remaining)
                 terminated = True
                 break
             except subprocess.TimeoutExpired:
+                if not is_pause:
+                    break
                 continue
         if not terminated and p.popen.poll() is None:
             try:

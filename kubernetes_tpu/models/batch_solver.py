@@ -88,22 +88,21 @@ _I32_HEADROOM = (2**31 - 1) // 10  # calculate_score multiplies by 10
 
 class SolverInputs(NamedTuple):
     """Device-ready arrays (see ClusterSnapshot for shapes/meaning).
-    Resource arrays are int32 when the gcd-scaled wave fits, else int64;
-    port/pd sets are packed uint32 bitmask words."""
+    Resource planes are [_, R] with R the wave's resource-dimension count
+    (cpu, memory, then node-advertised extras — jit-static); int32 when the
+    per-dimension gcd-scaled wave fits, else int64; port/pd sets are packed
+    uint32 bitmask words."""
 
-    cap_cpu: jnp.ndarray
-    cap_mem: jnp.ndarray
-    fit_used_cpu: jnp.ndarray
-    fit_used_mem: jnp.ndarray
+    n_scored: jnp.ndarray        # [] i32 — LeastRequested divisor (see snapshot)
+    cap: jnp.ndarray             # [N, R]
+    fit_used: jnp.ndarray        # [N, R]
     fit_exceeded: jnp.ndarray
-    score_used_cpu: jnp.ndarray
-    score_used_mem: jnp.ndarray
+    score_used: jnp.ndarray      # [N, R]
     node_ports: jnp.ndarray      # [N, Wp] u32 packed
     node_sel: jnp.ndarray
     node_pds: jnp.ndarray        # [N, Wd] u32 packed
     node_extra_ok: jnp.ndarray
-    req_cpu: jnp.ndarray
-    req_mem: jnp.ndarray
+    req: jnp.ndarray             # [P, R]
     pod_ports: jnp.ndarray       # [P, Wp] u32 packed
     pod_sel: jnp.ndarray
     pod_pds: jnp.ndarray         # [P, Wd] u32 packed
@@ -134,15 +133,21 @@ def _pack_bits(a: np.ndarray) -> np.ndarray:
     return words.astype(np.uint32)
 
 
-def _memory_scale(snap: ClusterSnapshot) -> int:
-    """gcd of every memory value in the wave — dividing them all by it is
-    exact for each comparison and floor division the solver performs."""
-    vals = np.concatenate([snap.cap_mem, snap.fit_used_mem,
-                           snap.score_used_mem, snap.req_mem])
-    vals = vals[vals != 0]
-    if vals.size == 0:
-        return 1
-    return int(np.gcd.reduce(np.abs(vals)))
+def _resource_scales(snap: ClusterSnapshot) -> np.ndarray:
+    """Per-dimension gcd of every value in that resource column — dividing a
+    whole column by a common factor is exact for each comparison and floor
+    division the solver performs. (Memory reduces by Mi granularity; cpu
+    milli-values usually by 100.)"""
+    cols = np.concatenate([snap.cap, snap.fit_used, snap.score_used,
+                           snap.req], axis=0)              # [*, R]
+    R = cols.shape[1]
+    scales = np.ones(R, np.int64)
+    for r in range(R):
+        vals = cols[:, r]
+        vals = vals[vals != 0]
+        if vals.size:
+            scales[r] = np.gcd.reduce(np.abs(vals))
+    return scales
 
 
 def _fits_i32(*arrays) -> bool:
@@ -155,22 +160,17 @@ def _fits_i32(*arrays) -> bool:
 
 def snapshot_to_inputs(snap: ClusterSnapshot) -> SolverInputs:
     ensure_x64()
-    g = _memory_scale(snap)
-    cap_mem = snap.cap_mem // g
-    fit_used_mem = snap.fit_used_mem // g
-    score_used_mem = snap.score_used_mem // g
-    req_mem = snap.req_mem // g
+    g = _resource_scales(snap)[None, :]                    # [1, R]
+    cap = snap.cap // g
+    fit_used = snap.fit_used // g
+    score_used = snap.score_used // g
+    req = snap.req // g
 
     # int32 is safe when no running sum can reach 2^31/10: the largest
     # initial value plus the whole batch's requests bounds every accumulator
-    req_mem_total = np.array([int(req_mem.sum())])
-    req_cpu_total = np.array([int(snap.req_cpu.sum())])
-    use_i32 = _fits_i32(cap_mem, fit_used_mem,
-                        score_used_mem + req_mem_total,
-                        cap_mem + req_mem_total) and \
-        _fits_i32(snap.cap_cpu, snap.fit_used_cpu,
-                  snap.score_used_cpu + req_cpu_total,
-                  snap.cap_cpu + req_cpu_total)
+    req_total = req.sum(axis=0, keepdims=True)             # [1, R]
+    use_i32 = _fits_i32(cap, fit_used, score_used + req_total,
+                        cap + req_total)
     rdt = np.int32 if use_i32 else np.int64
 
     N = snap.n_nodes
@@ -196,19 +196,16 @@ def snapshot_to_inputs(snap: ClusterSnapshot) -> SolverInputs:
     zone_labeled = node_zone >= 0                             # [A, N]
 
     return SolverInputs(
-        cap_cpu=jnp.asarray(snap.cap_cpu.astype(rdt)),
-        cap_mem=jnp.asarray(cap_mem.astype(rdt)),
-        fit_used_cpu=jnp.asarray(snap.fit_used_cpu.astype(rdt)),
-        fit_used_mem=jnp.asarray(fit_used_mem.astype(rdt)),
+        n_scored=jnp.asarray(np.int32(snap.n_scored)),
+        cap=jnp.asarray(cap.astype(rdt)),
+        fit_used=jnp.asarray(fit_used.astype(rdt)),
         fit_exceeded=jnp.asarray(snap.fit_exceeded),
-        score_used_cpu=jnp.asarray(snap.score_used_cpu.astype(rdt)),
-        score_used_mem=jnp.asarray(score_used_mem.astype(rdt)),
+        score_used=jnp.asarray(score_used.astype(rdt)),
         node_ports=jnp.asarray(_pack_bits(snap.node_ports)),
         node_sel=jnp.asarray(snap.node_sel),
         node_pds=jnp.asarray(_pack_bits(snap.node_pds)),
         node_extra_ok=jnp.asarray(snap.node_extra_ok),
-        req_cpu=jnp.asarray(snap.req_cpu.astype(rdt)),
-        req_mem=jnp.asarray(req_mem.astype(rdt)),
+        req=jnp.asarray(req.astype(rdt)),
         pod_ports=jnp.asarray(_pack_bits(snap.pod_ports)),
         pod_sel=jnp.asarray(snap.pod_sel),
         pod_pds=jnp.asarray(_pack_bits(snap.pod_pds)),
@@ -241,11 +238,15 @@ def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
     provider's plugin set with the given legacy weights applies."""
     if pol is None:
         pol = BatchPolicy(w_lr=w_lr, w_spread=w_spread, w_equal=w_equal)
-    N = inp.cap_cpu.shape[0]
-    P = inp.req_cpu.shape[0]
+    N, R = inp.cap.shape
+    P = inp.req.shape[0]
     L = inp.node_aff_vals.shape[1]
-    rdt = inp.cap_cpu.dtype
+    rdt = inp.cap.dtype
     arange_n = jnp.arange(N, dtype=jnp.int32)
+    # per-dim fit rule (serial twin: predicates.dim_fits): cpu/memory —
+    # always dims 0,1 — are unconstrained at zero capacity (reference
+    # parity); extended dims are strict, so a GPU pod can't land GPU-less
+    unconstrained = (inp.cap == 0) & (jnp.arange(R) < 2)[None, :]  # [N, R]
 
     if pol.all_infeasible:
         # no nonzero-weight priorities: prioritizeNodes emits nothing and
@@ -273,38 +274,35 @@ def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
 
     # ---- sequential commit scan over pods --------------------------------
     class Carry(NamedTuple):
-        fit_used_cpu: jnp.ndarray    # [N] resource dtype
-        fit_used_mem: jnp.ndarray
-        score_used_cpu: jnp.ndarray
-        score_used_mem: jnp.ndarray
+        fit_used: jnp.ndarray        # [N, R] resource dtype
+        score_used: jnp.ndarray      # [N, R]
         ports: jnp.ndarray           # [N, Wp] u32 packed
         pds: jnp.ndarray             # [N, Wd] u32 packed
         counts: jnp.ndarray          # [G, N+1] i32
         anchor_vals: jnp.ndarray     # [G, L] i32
         has_anchor: jnp.ndarray      # [G] bool
 
-    init = Carry(inp.fit_used_cpu, inp.fit_used_mem,
-                 inp.score_used_cpu, inp.score_used_mem,
+    init = Carry(inp.fit_used, inp.score_used,
                  inp.node_ports, inp.node_pds, inp.group_counts,
                  inp.anchor_vals0, inp.has_anchor0)
 
     def step(carry: Carry, xs):
-        (static_row, req_cpu, req_mem, pod_ports, pod_pds,
+        (static_row, req, pod_ports, pod_pds,
          tie_hi, tie_lo, gid, member, aff_static) = xs
 
         feasible = static_row
         if pol.use_resources:
-            # Filter: resources (predicates.go:127-152 — zero-request always
-            # fits; zero capacity never constrains; pre-exceeded nodes fail)
-            cpu_ok = (inp.cap_cpu == 0) | \
-                (inp.cap_cpu - carry.fit_used_cpu >= req_cpu)
-            mem_ok = (inp.cap_mem == 0) | \
-                (inp.cap_mem - carry.fit_used_mem >= req_mem)
-            zero_req = (req_cpu == 0) & (req_mem == 0)
+            # Filter: resources over all R dims (predicates.go:127-152 —
+            # a pod requesting zero of everything always fits; pre-exceeded
+            # nodes fail; per-dim rule per ``unconstrained`` above)
+            res_ok = jnp.all(unconstrained |
+                             (inp.cap - carry.fit_used >= req[None, :]),
+                             axis=1)
+            zero_req = jnp.all(req == 0)
             # fit_exceeded is static: committed pending pods always fit, so
             # they never flip a node into the pre-exceeded state.
             feasible = feasible & \
-                (zero_req | (~inp.fit_exceeded & cpu_ok & mem_ok))
+                (zero_req | (~inp.fit_exceeded & res_ok))
         if pol.use_ports:
             # Filter: host ports (predicates.go:326-338) — packed-word AND
             feasible = feasible & \
@@ -328,12 +326,13 @@ def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
         counts_row = carry.counts[jnp.maximum(gid, 0)]         # [N+1]
         score = jnp.zeros(N, jnp.int32)
         if pol.w_lr:
-            # Score: LeastRequested (priorities.go:41-75 — all-pods usage)
-            total_cpu = carry.score_used_cpu + req_cpu
-            total_mem = carry.score_used_mem + req_mem
-            lr = ((_calculate_score(total_cpu, inp.cap_cpu)
-                   + _calculate_score(total_mem, inp.cap_mem)) // 2
-                  ).astype(jnp.int32)
+            # Score: LeastRequested (priorities.go:41-75 — all-pods usage),
+            # averaged over the scored dims (sum // n_scored == the
+            # reference's (cpu+mem)/2 when only cpu+memory are advertised;
+            # request-only dims have zero capacity and so score 0)
+            total = carry.score_used + req[None, :]
+            lr = (_calculate_score(total, inp.cap).sum(axis=1)
+                  // inp.n_scored.astype(rdt)).astype(jnp.int32)
             score = score + lr * pol.w_lr
         if pol.w_spread:
             # Score: ServiceSpreading (spreading.go:37-86)
@@ -380,10 +379,8 @@ def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
             anchor_vals = carry.anchor_vals
             has_anchor = carry.has_anchor
         carry = Carry(
-            fit_used_cpu=carry.fit_used_cpu + onehot * req_cpu,
-            fit_used_mem=carry.fit_used_mem + onehot * req_mem,
-            score_used_cpu=carry.score_used_cpu + onehot * req_cpu,
-            score_used_mem=carry.score_used_mem + onehot * req_mem,
+            fit_used=carry.fit_used + onehot[:, None] * req[None, :],
+            score_used=carry.score_used + onehot[:, None] * req[None, :],
             ports=carry.ports | jnp.where(onehot[:, None], pod_ports[None, :],
                                           jnp.uint32(0)),
             pds=carry.pds | jnp.where(onehot[:, None], pod_pds[None, :],
@@ -396,7 +393,7 @@ def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
         win_score = jnp.where(any_feasible, top, jnp.int32(NEG))
         return carry, (chosen, win_score)
 
-    xs = (static_mask, inp.req_cpu, inp.req_mem, inp.pod_ports, inp.pod_pds,
+    xs = (static_mask, inp.req, inp.pod_ports, inp.pod_pds,
           inp.tie_hi, inp.tie_lo, inp.pod_gid, inp.pod_group_member,
           inp.pod_aff_static)
     _, (chosen, scores) = jax.lax.scan(step, init, xs, unroll=unroll)

@@ -46,6 +46,7 @@ import numpy as np
 
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.models.policy import BatchPolicy, DEFAULT_BATCH_POLICY
+from kubernetes_tpu.scheduler import predicates as _preds
 from kubernetes_tpu.scheduler.generic import (
     FNV64_OFFSET,
     FNV64_PRIME,
@@ -97,14 +98,16 @@ class ClusterSnapshot:
     """All arrays are numpy; the solver moves them to device."""
 
     node_names: List[str]
-    # capacities / usage (int64: memory bytes exceed int32)
-    cap_cpu: np.ndarray          # [N] i64 milli-CPU
-    cap_mem: np.ndarray          # [N] i64 bytes
-    fit_used_cpu: np.ndarray     # [N] i64 greedy-fitting usage (Filter)
-    fit_used_mem: np.ndarray     # [N] i64
+    # R-dimensional resource planes (int64: memory bytes exceed int32).
+    # resource_names[0:2] is always [cpu, memory] (reference parity), then
+    # node-advertised extras (the scored universe, n_scored total), then
+    # request-only dims (constrain but never score).
+    resource_names: List[str]
+    n_scored: int
+    cap: np.ndarray              # [N, R] i64 (cpu col in milli-units)
+    fit_used: np.ndarray         # [N, R] i64 greedy-fitting usage (Filter)
     fit_exceeded: np.ndarray     # [N] bool — an existing pod already didn't fit
-    score_used_cpu: np.ndarray   # [N] i64 all-pods usage (Score)
-    score_used_mem: np.ndarray   # [N] i64
+    score_used: np.ndarray       # [N, R] i64 all-pods usage (Score)
     # vocab-interned boolean features
     node_ports: np.ndarray       # [N, K] bool
     node_sel: np.ndarray         # [N, K2] bool — node has (key,value) label
@@ -112,8 +115,7 @@ class ClusterSnapshot:
     node_extra_ok: np.ndarray    # [N] bool — NodeLabelPresence + caller mask
     # pending pods
     pod_names: List[str]
-    req_cpu: np.ndarray          # [P] i64
-    req_mem: np.ndarray          # [P] i64
+    req: np.ndarray              # [P, R] i64
     pod_ports: np.ndarray        # [P, K] bool
     pod_sel: np.ndarray          # [P, K2] bool — required (key,value) pairs
     pod_pds: np.ndarray          # [P, K3] bool
@@ -161,15 +163,31 @@ def encode_snapshot(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
     N, P, E = len(nodes), len(pending_pods), len(existing_pods)
     node_index = {n.metadata.name: i for i, n in enumerate(nodes)}
 
-    # -- capacities ---------------------------------------------------------
-    cap_cpu = np.zeros(N, np.int64)
-    cap_mem = np.zeros(N, np.int64)
+    # -- capacities: R-dimensional planes -----------------------------------
+    # resource universe and value canonicalization shared with the serial
+    # path (scheduler.predicates.resource_universe / resource_value): the
+    # scored dims (cpu, memory, node-advertised extras) come first; dims
+    # only requested by pods are appended — they constrain (dim_fits) but
+    # score zero everywhere, so LeastRequested divides by n_scored only.
+    scored = _preds.resource_universe(nodes)
+    seen = set(scored)
+    request_only: List[str] = []
+    for p in list(pending_pods) + list(existing_pods):
+        for c in p.spec.containers:
+            for name in c.resources.limits:
+                if name not in seen:
+                    seen.add(name)
+                    request_only.append(name)
+    resource_names = scored + sorted(request_only)
+    n_scored = len(scored)
+    R = len(resource_names)
+    rindex = {name: r for r, name in enumerate(resource_names)}
+    cap = np.zeros((N, R), np.int64)
     for i, n in enumerate(nodes):
-        cap = n.spec.capacity or {}
-        q = cap.get(api.ResourceCPU)
-        cap_cpu[i] = q.milli_value() if q is not None else 0
-        q = cap.get(api.ResourceMemory)
-        cap_mem[i] = q.int_value() if q is not None else 0
+        for name, q in (n.spec.capacity or {}).items():
+            r = rindex.get(name)
+            if r is not None:
+                cap[i, r] = _preds.resource_value(name, q)
 
     # -- service selector vocabulary (needed by the pod passes) -------------
     services = list(services)
@@ -192,8 +210,7 @@ def encode_snapshot(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
     sel_vocab: Dict[Tuple[str, str], int] = {}
     pd_vocab: Dict[str, int] = {}
 
-    req_cpu = np.zeros(P, np.int64)
-    req_mem = np.zeros(P, np.int64)
+    req = np.zeros((P, R), np.int64)
     pod_host_idx = np.full(P, -1, np.int32)
     pod_names: List[str] = []
     pp_ij: List[Tuple[int, int]] = []   # (pod, port-vocab) pairs
@@ -210,22 +227,17 @@ def encode_snapshot(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
             t = svc_vocab.get(kv)
             if t is not None:
                 pf_ij.append((j, t))
-        # inlined get_resource_request (predicates.go:93-101) — the 2x10k
-        # call + dataclass overhead shows up at 10k-pod waves
-        c_cpu = c_mem = 0
+        # inlined get_resource_request (predicates.go:93-101) — per-pod
+        # function + dataclass overhead shows up at 10k-pod waves
         for c in p.spec.containers:
-            limits = c.resources.limits
-            q = limits.get(api.ResourceCPU)
-            if q is not None:
-                c_cpu += q.milli_value()
-            q = limits.get(api.ResourceMemory)
-            if q is not None:
-                c_mem += q.int_value()
+            for name, q in c.resources.limits.items():
+                r = rindex.get(name)
+                if r is not None:
+                    req[j, r] += (q.milli_value() if name == api.ResourceCPU
+                                  else q.int_value())
             for cp in c.ports:
                 if cp.host_port:
                     pp_ij.append((j, intern(port_vocab, cp.host_port)))
-        req_cpu[j] = c_cpu
-        req_mem[j] = c_mem
         for kv in (p.spec.node_selector or {}).items():
             ps_ij.append((j, intern(sel_vocab, kv)))
         for v in p.spec.volumes:
@@ -263,8 +275,7 @@ def encode_snapshot(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
 
     # -- existing pods: one Python pass, then bulk accumulation -------------
     e_host = np.full(E, N, np.int64)      # N = unknown/unassigned slot
-    e_cpu = np.zeros(E, np.int64)
-    e_mem = np.zeros(E, np.int64)
+    e_req = np.zeros((E, R), np.int64)
     np_ij: List[Tuple[int, int]] = []     # (node, port-vocab)
     nd_ij: List[Tuple[int, int]] = []     # (node, pd-vocab)
     ef_ij: List[Tuple[int, int]] = []     # (pod, service-selector-vocab)
@@ -279,22 +290,17 @@ def encode_snapshot(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
             if t is not None:
                 ef_ij.append((e, t))
         i = node_index.get(p.status.host, -1)
-        c_cpu = c_mem = 0
         for c in p.spec.containers:
-            limits = c.resources.limits
-            q = limits.get(api.ResourceCPU)
-            if q is not None:
-                c_cpu += q.milli_value()
-            q = limits.get(api.ResourceMemory)
-            if q is not None:
-                c_mem += q.int_value()
+            for name, q in c.resources.limits.items():
+                r = rindex.get(name)
+                if r is not None:
+                    e_req[e, r] += (q.milli_value() if name == api.ResourceCPU
+                                    else q.int_value())
             if i >= 0:
                 for cp in c.ports:
                     k = port_vocab.get(cp.host_port)
                     if k is not None and cp.host_port:
                         np_ij.append((i, k))
-        e_cpu[e] = c_cpu
-        e_mem[e] = c_mem
         if i < 0:
             continue
         e_host[e] = i
@@ -308,37 +314,37 @@ def encode_snapshot(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
     node_pds = scatter_true(nd_ij, N, K3)
 
     on_node = e_host < N
-    score_used_cpu = np.zeros(N, np.int64)
-    score_used_mem = np.zeros(N, np.int64)
-    np.add.at(score_used_cpu, e_host[on_node], e_cpu[on_node])
-    np.add.at(score_used_mem, e_host[on_node], e_mem[on_node])
+    score_used = np.zeros((N, R), np.int64)
+    np.add.at(score_used, e_host[on_node], e_req[on_node])
 
     # greedy Filter accumulators (CheckPodsExceedingCapacity :104-124):
     # when a node's total existing usage fits its capacity, every prefix fit
     # too — the greedy result equals the sum and nothing exceeded. Only the
     # (rare) overflowing nodes need the sequential in-order walk.
-    fit_used_cpu = score_used_cpu.copy()
-    fit_used_mem = score_used_mem.copy()
+    fit_used = score_used.copy()
     fit_exceeded = np.zeros(N, bool)
-    all_fit = ((cap_cpu == 0) | (score_used_cpu <= cap_cpu)) & \
-              ((cap_mem == 0) | (score_used_mem <= cap_mem))
+    # per-dim fit rule (predicates.dim_fits): cpu/memory zero-capacity is
+    # unconstrained; extended dims are strict
+    is_core = np.arange(R) < 2
+    unconstrained = (cap == 0) & is_core[None, :]
+    all_fit = (unconstrained | (score_used <= cap)).all(axis=1)
     if not all_fit.all():
         slow = set(np.nonzero(~all_fit)[0].tolist())
-        per_host: Dict[int, Tuple[int, int]] = {i: (0, 0) for i in slow}
+        per_host: Dict[int, np.ndarray] = {
+            i: np.zeros(R, np.int64) for i in slow}
         for e in range(E):
             i = int(e_host[e])
             if i not in per_host:
                 continue
-            used_c, used_m = per_host[i]
-            fits_c = cap_cpu[i] == 0 or (cap_cpu[i] - used_c) >= e_cpu[e]
-            fits_m = cap_mem[i] == 0 or (cap_mem[i] - used_m) >= e_mem[e]
-            if fits_c and fits_m:
-                per_host[i] = (used_c + int(e_cpu[e]), used_m + int(e_mem[e]))
+            used = per_host[i]
+            fits = bool((unconstrained[i] |
+                         (cap[i] - used >= e_req[e])).all())
+            if fits:
+                per_host[i] = used + e_req[e]
             else:
                 fit_exceeded[i] = True
-        for i, (used_c, used_m) in per_host.items():
-            fit_used_cpu[i] = used_c
-            fit_used_mem[i] = used_m
+        for i, used in per_host.items():
+            fit_used[i] = used
 
     # -- service groups (vectorized) ---------------------------------------
     # group = (namespace, index of FIRST service whose selector matches the
@@ -471,14 +477,13 @@ def encode_snapshot(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
 
     return ClusterSnapshot(
         node_names=[n.metadata.name for n in nodes],
-        cap_cpu=cap_cpu, cap_mem=cap_mem,
-        fit_used_cpu=fit_used_cpu, fit_used_mem=fit_used_mem,
-        fit_exceeded=fit_exceeded,
-        score_used_cpu=score_used_cpu, score_used_mem=score_used_mem,
+        resource_names=resource_names, n_scored=n_scored,
+        cap=cap, fit_used=fit_used, fit_exceeded=fit_exceeded,
+        score_used=score_used,
         node_ports=node_ports, node_sel=node_sel, node_pds=node_pds,
         node_extra_ok=extra_ok,
         pod_names=pod_names,
-        req_cpu=req_cpu, req_mem=req_mem,
+        req=req,
         pod_ports=pod_ports, pod_sel=pod_sel, pod_pds=pod_pds,
         pod_host_idx=pod_host_idx, tie_hi=tie_hi, tie_lo=tie_lo,
         pod_gid=pod_gid, pod_group_member=pod_group_member,

@@ -47,7 +47,7 @@ def pad_inputs_for_mesh(inp: SolverInputs, mesh: Mesh) -> Tuple[SolverInputs, in
     """Pad the node axis to a multiple of the "nodes" mesh axis with
     infeasible nodes. Returns (padded inputs, original N)."""
     shards = mesh.shape["nodes"]
-    n = int(inp.cap_cpu.shape[0])
+    n = int(inp.cap.shape[0])
     pad = (-n) % shards
     if pad == 0:
         return inp, n
@@ -58,15 +58,14 @@ def pad_inputs_for_mesh(inp: SolverInputs, mesh: Mesh) -> Tuple[SolverInputs, in
         return jnp.pad(x, widths, constant_values=fill)
 
     return SolverInputs(
-        cap_cpu=pad_n(inp.cap_cpu), cap_mem=pad_n(inp.cap_mem),
-        fit_used_cpu=pad_n(inp.fit_used_cpu), fit_used_mem=pad_n(inp.fit_used_mem),
+        n_scored=inp.n_scored,
+        cap=pad_n(inp.cap), fit_used=pad_n(inp.fit_used),
         fit_exceeded=pad_n(inp.fit_exceeded, fill=True),
-        score_used_cpu=pad_n(inp.score_used_cpu),
-        score_used_mem=pad_n(inp.score_used_mem),
+        score_used=pad_n(inp.score_used),
         node_ports=pad_n(inp.node_ports), node_sel=pad_n(inp.node_sel),
         node_pds=pad_n(inp.node_pds),
         node_extra_ok=pad_n(inp.node_extra_ok, fill=False),  # never feasible
-        req_cpu=inp.req_cpu, req_mem=inp.req_mem,
+        req=inp.req,
         pod_ports=inp.pod_ports, pod_sel=inp.pod_sel, pod_pds=inp.pod_pds,
         pod_host_idx=inp.pod_host_idx, tie_hi=inp.tie_hi, tie_lo=inp.tie_lo,
         pod_gid=inp.pod_gid, pod_group_member=inp.pod_group_member,
@@ -90,12 +89,12 @@ def _input_shardings(mesh: Mesh) -> SolverInputs:
     node2d = s("nodes", None)
     rep = s()
     return SolverInputs(
-        cap_cpu=node, cap_mem=node,
-        fit_used_cpu=node, fit_used_mem=node, fit_exceeded=node,
-        score_used_cpu=node, score_used_mem=node,
+        n_scored=rep,
+        cap=node2d, fit_used=node2d, fit_exceeded=node,
+        score_used=node2d,
         node_ports=node2d, node_sel=node2d, node_pds=node2d,
         node_extra_ok=node,
-        req_cpu=rep, req_mem=rep,
+        req=rep,
         pod_ports=rep, pod_sel=rep, pod_pds=rep,
         pod_host_idx=rep, tie_hi=rep, tie_lo=rep,
         pod_gid=rep, pod_group_member=rep,

@@ -17,14 +17,14 @@ kernels (kubernetes_tpu.models.batch_solver) must agree with bit-for-bit:
 
 from __future__ import annotations
 
-from dataclasses import dataclass
-from typing import Callable, Dict, List, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from kubernetes_tpu.api import labels as labels_pkg
 from kubernetes_tpu.api import types as api
 
 __all__ = [
     "FitPredicate", "get_resource_request", "check_pods_exceeding_capacity",
+    "resource_value", "capacity_values", "resource_universe", "dim_fits",
     "ResourceFit", "NodeSelector", "pod_fits_host", "NodeLabelChecker",
     "ServiceAffinity", "pod_fits_ports", "get_used_ports", "no_disk_conflict",
     "map_pods_to_machines",
@@ -33,56 +33,92 @@ __all__ = [
 FitPredicate = Callable[[api.Pod, List[api.Pod], str], bool]
 
 
-@dataclass
-class ResourceRequest:
-    milli_cpu: int = 0
-    memory: int = 0
+def resource_value(name: str, q) -> int:
+    """Canonical integer for one resource dimension: CPU counts milli-units
+    (predicates.go:96 ``MilliValue``), everything else whole units."""
+    return q.milli_value() if name == api.ResourceCPU else q.int_value()
 
 
-def get_resource_request(pod: api.Pod) -> ResourceRequest:
-    """ref: predicates.go:93-101 getResourceRequest — container limits."""
-    r = ResourceRequest()
+def get_resource_request(pod: api.Pod) -> Dict[str, int]:
+    """ref: predicates.go:93-101 getResourceRequest — container limits,
+    generalized from the reference's hard-coded cpu+memory pair to every
+    resource dimension the pod names (the R-dimensional model the BASELINE
+    3-resource bin-packing config exercises). Returns {resource: amount}
+    with CPU in milli-units."""
+    r: Dict[str, int] = {}
     for c in pod.spec.containers:
-        limits = c.resources.limits
-        q = limits.get(api.ResourceMemory)
-        if q is not None:
-            r.memory += q.int_value()
-        q = limits.get(api.ResourceCPU)
-        if q is not None:
-            r.milli_cpu += q.milli_value()
+        for name, q in c.resources.limits.items():
+            r[name] = r.get(name, 0) + resource_value(name, q)
     return r
+
+
+def capacity_values(capacity: Optional[dict]) -> Dict[str, int]:
+    """Canonical integer capacity per advertised dimension."""
+    return {name: resource_value(name, q)
+            for name, q in (capacity or {}).items()}
+
+
+def resource_universe(nodes) -> List[str]:
+    """The wave's *scored* resource dimensions: cpu and memory always
+    (reference parity — predicates.go/priorities.go hard-code them), plus
+    every other resource any node advertises, sorted. LeastRequested
+    averages its per-dimension scores over exactly this set, so it is
+    derivable from the node list alone and stable across a wave. Dimensions
+    only *requested* but advertised nowhere still constrain (see
+    ``dim_fits``) but score zero everywhere, so they are excluded here.
+    Shared by the serial path and the snapshot encoder — both must agree
+    for the bit-identical contract."""
+    extras = set()
+    for n in nodes:
+        for name in (n.spec.capacity or {}):
+            if name not in (api.ResourceCPU, api.ResourceMemory):
+                extras.add(name)
+    return [api.ResourceCPU, api.ResourceMemory] + sorted(extras)
+
+
+def dim_fits(name: str, cap: int, free: int, req: int) -> bool:
+    """Per-dimension fit rule. cpu/memory: zero capacity never constrains
+    (predicates.go:117-118 — reference parity). Every other dimension is an
+    extended resource: absent/zero capacity cannot satisfy a nonzero
+    request (a GPU pod must not land on a GPU-less node)."""
+    if name in (api.ResourceCPU, api.ResourceMemory) and cap == 0:
+        return True
+    return free >= req
 
 
 def check_pods_exceeding_capacity(pods: List[api.Pod], capacity: dict
                                   ) -> Tuple[List[api.Pod], List[api.Pod]]:
     """ref: predicates.go:104-124 CheckPodsExceedingCapacity.
 
-    Greedy in-order accounting; a zero capacity dimension never constrains.
+    Greedy in-order accounting over every requested dimension (cpu+memory
+    exactly as the reference; extended resources per ``dim_fits``).
     Returns (fitting, not_fitting).
     """
-    cap_cpu_q = capacity.get(api.ResourceCPU)
-    cap_mem_q = capacity.get(api.ResourceMemory)
-    total_milli_cpu = cap_cpu_q.milli_value() if cap_cpu_q is not None else 0
-    total_memory = cap_mem_q.int_value() if cap_mem_q is not None else 0
-    cpu_requested = 0
-    mem_requested = 0
+    caps = capacity_values(capacity)
+    used: Dict[str, int] = {}
     fitting: List[api.Pod] = []
     not_fitting: List[api.Pod] = []
     for p in pods:
         req = get_resource_request(p)
-        fits_cpu = total_milli_cpu == 0 or (total_milli_cpu - cpu_requested) >= req.milli_cpu
-        fits_mem = total_memory == 0 or (total_memory - mem_requested) >= req.memory
-        if not fits_cpu or not fits_mem:
+        fits = all(
+            dim_fits(k, caps.get(k, 0), caps.get(k, 0) - used.get(k, 0), v)
+            for k, v in req.items())
+        if not fits:
             not_fitting.append(p)
             continue
-        cpu_requested += req.milli_cpu
-        mem_requested += req.memory
+        for k, v in req.items():
+            used[k] = used.get(k, 0) + v
         fitting.append(p)
     return fitting, not_fitting
 
 
 class ResourceFit:
-    """ref: predicates.go:127-152 ResourceFit.PodFitsResources."""
+    """ref: predicates.go:127-152 ResourceFit.PodFitsResources.
+
+    The zero-request fast path (:129 "no resources requested always fits")
+    generalizes to: a pod requesting a zero amount of every dimension it
+    names fits unconditionally — identical to the reference for cpu+memory
+    pods, and exactly the batch solver's ``zero_req`` test."""
 
     def __init__(self, node_info):
         self.info = node_info
@@ -90,7 +126,7 @@ class ResourceFit:
     def pod_fits_resources(self, pod: api.Pod, existing_pods: List[api.Pod],
                            node: str) -> bool:
         req = get_resource_request(pod)
-        if req.milli_cpu == 0 and req.memory == 0:
+        if not any(req.values()):
             return True  # no resources requested always fits (:129)
         info = self.info.get_node_info(node)
         pods = list(existing_pods) + [pod]

@@ -16,6 +16,7 @@ import struct
 
 from kubernetes_tpu.api import labels as labels_pkg
 from kubernetes_tpu.api import types as api
+from kubernetes_tpu.scheduler import predicates
 from kubernetes_tpu.scheduler.predicates import map_pods_to_machines
 
 __all__ = [
@@ -70,43 +71,39 @@ def calculate_score(requested: int, capacity: int, node: str) -> int:
     return ((capacity - requested) * 10) // capacity
 
 
-def _calculate_occupancy(pod: api.Pod, node: api.Node, pods: List[api.Pod]) -> HostPriority:
-    """ref: priorities.go:41-75 calculateOccupancy."""
-    total_milli_cpu = 0
-    total_memory = 0
+def _calculate_occupancy(pod: api.Pod, node: api.Node, pods: List[api.Pod],
+                         universe: List[str]) -> HostPriority:
+    """ref: priorities.go:41-75 calculateOccupancy, generalized to the
+    wave's R resource dimensions: the per-dimension integer scores are
+    averaged over the whole universe (``sum // R``), which reduces to the
+    reference's ``(cpu_score + memory_score) / 2`` when the cluster
+    advertises only cpu+memory."""
+    totals = {k: 0 for k in universe}
     for existing in pods:
         for c in existing.spec.containers:
-            q = c.resources.limits.get(api.ResourceCPU)
-            if q is not None:
-                total_milli_cpu += q.milli_value()
-            q = c.resources.limits.get(api.ResourceMemory)
-            if q is not None:
-                total_memory += q.int_value()
+            for name, q in c.resources.limits.items():
+                if name in totals:
+                    totals[name] += predicates.resource_value(name, q)
     # add the pod being scheduled (differentiates empty minions by size)
     for c in pod.spec.containers:
-        q = c.resources.limits.get(api.ResourceCPU)
-        if q is not None:
-            total_milli_cpu += q.milli_value()
-        q = c.resources.limits.get(api.ResourceMemory)
-        if q is not None:
-            total_memory += q.int_value()
+        for name, q in c.resources.limits.items():
+            if name in totals:
+                totals[name] += predicates.resource_value(name, q)
 
-    cap = node.spec.capacity or {}
-    cap_cpu = cap.get(api.ResourceCPU)
-    cap_mem = cap.get(api.ResourceMemory)
-    capacity_milli_cpu = cap_cpu.milli_value() if cap_cpu is not None else 0
-    capacity_memory = cap_mem.int_value() if cap_mem is not None else 0
-
-    cpu_score = calculate_score(total_milli_cpu, capacity_milli_cpu, node.metadata.name)
-    memory_score = calculate_score(total_memory, capacity_memory, node.metadata.name)
-    return HostPriority(host=node.metadata.name, score=(cpu_score + memory_score) // 2)
+    caps = predicates.capacity_values(node.spec.capacity)
+    score = sum(calculate_score(totals[k], caps.get(k, 0), node.metadata.name)
+                for k in universe) // len(universe)
+    return HostPriority(host=node.metadata.name, score=score)
 
 
 def least_requested_priority(pod: api.Pod, pod_lister, minion_lister) -> List[HostPriority]:
     """ref: priorities.go:79-95 LeastRequestedPriority."""
     nodes = minion_lister.list()
+    universe = predicates.resource_universe(nodes.items)
     pods_to_machines = map_pods_to_machines(pod_lister)
-    return [_calculate_occupancy(pod, node, pods_to_machines.get(node.metadata.name, []))
+    return [_calculate_occupancy(pod, node,
+                                 pods_to_machines.get(node.metadata.name, []),
+                                 universe)
             for node in nodes.items]
 
 

@@ -61,6 +61,12 @@ int main() {
 
   struct sigaction sa = {};
   sa.sa_handler = handle_terminate;
+  // Serialize TERM/INT delivery: without this, two pending signals could
+  // nest their handlers and both pass the stray_budget check, discarding a
+  // legitimate stop alongside the stray.
+  sigemptyset(&sa.sa_mask);
+  sigaddset(&sa.sa_mask, SIGTERM);
+  sigaddset(&sa.sa_mask, SIGINT);
   sigaction(SIGINT, &sa, nullptr);
   sigaction(SIGTERM, &sa, nullptr);
 

@@ -18,20 +18,24 @@ from kubernetes_tpu.models.oracle import solve_serial
 from kubernetes_tpu.models.snapshot import encode_snapshot
 
 
-def mk_node(name, cpu_m=4000, mem=8 << 30, labels=None):
+def mk_node(name, cpu_m=4000, mem=8 << 30, labels=None, extra=None):
+    cap = {"cpu": Quantity(f"{cpu_m}m"), "memory": Quantity(mem)}
+    for k, v in (extra or {}).items():
+        cap[k] = Quantity(v)
     return api.Node(
         metadata=api.ObjectMeta(name=name, labels=labels or {}),
-        spec=api.NodeSpec(capacity={"cpu": Quantity(f"{cpu_m}m"),
-                                    "memory": Quantity(mem)}))
+        spec=api.NodeSpec(capacity=cap))
 
 
 def mk_pod(name, ns="default", cpu_m=0, mem=0, host="", labels=None,
-           node_selector=None, host_ports=(), pds=()):
+           node_selector=None, host_ports=(), pds=(), extra=None):
     limits = {}
     if cpu_m:
         limits["cpu"] = Quantity(f"{cpu_m}m")
     if mem:
         limits["memory"] = Quantity(mem)
+    for k, v in (extra or {}).items():
+        limits[k] = Quantity(v)
     return api.Pod(
         metadata=api.ObjectMeta(name=name, namespace=ns, uid=f"uid-{ns}-{name}",
                                 labels=labels or {}),
@@ -220,3 +224,75 @@ def test_fuzz_equivalence(seed):
     existing = [random_pod(f"e{i}", True) for i in range(n_existing)]
     pending = [random_pod(f"p{i}", False) for i in range(n_pending)]
     assert_equivalent(nodes, existing, pending, services)
+
+
+# -- R-dimensional resources (BASELINE config 3: 3 resource dimensions) -----
+
+def test_third_resource_dimension_constrains():
+    """A GPU dimension advertised by some nodes: pods requesting GPUs only
+    fit where capacity remains; the solver and serial oracle agree."""
+    nodes = [mk_node("gpu0", extra={"nvidia.com/gpu": 2}),
+             mk_node("gpu1", extra={"nvidia.com/gpu": 1}),
+             mk_node("plain")]
+    pending = [mk_pod(f"g{i}", cpu_m=100, mem=64 << 20,
+                      extra={"nvidia.com/gpu": 1}) for i in range(4)]
+    serial = assert_equivalent(nodes, [], pending)
+    # 3 GPUs exist in total; the 4th pod must fail
+    assert sorted(h for h in serial if h) == ["gpu0", "gpu0", "gpu1"]
+    assert serial.count(None) == 1
+
+
+def test_extra_dimension_changes_least_requested_average():
+    """With R=3 the LeastRequested average divides by 3 (sum // R); nodes
+    advertising idle extra capacity score differently than an R=2 encode
+    would. Equivalence must hold — both paths use the same universe."""
+    nodes = [mk_node("a", cpu_m=1000, mem=1 << 30,
+                     extra={"ephemeral-storage": 100 << 30}),
+             mk_node("b", cpu_m=1000, mem=1 << 30)]
+    existing = [mk_pod("e0", cpu_m=500, mem=512 << 20, host="a"),
+                mk_pod("e1", cpu_m=100, mem=64 << 20, host="b")]
+    pending = [mk_pod(f"p{i}", cpu_m=100, mem=64 << 20,
+                      extra={"ephemeral-storage": 10 << 30} if i % 2 else None)
+               for i in range(6)]
+    assert_equivalent(nodes, existing, pending)
+
+
+def test_request_only_resource_is_unschedulable():
+    """An extended resource no node advertises cannot be satisfied: the
+    requesting pods fail everywhere (strict dim_fits semantics), while
+    zero-request pods keep the reference fast path."""
+    nodes = [mk_node("n0"), mk_node("n1")]
+    pending = [mk_pod("p0", extra={"fpga": 4}),          # request-only dim
+               mk_pod("p1", cpu_m=100, extra={"fpga": 1}),
+               mk_pod("p2")]                             # requests nothing
+    serial = assert_equivalent(nodes, [], pending)
+    assert serial[0] is None and serial[1] is None and serial[2] is not None
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_fuzz_equivalence_r_dimensional(seed):
+    """Fuzz with a third + fourth resource dimension in the mix."""
+    rng = random.Random(1000 + seed)
+    nodes = []
+    for i in range(rng.randint(2, 10)):
+        extra = {}
+        if rng.random() < 0.6:
+            extra["nvidia.com/gpu"] = rng.choice([1, 2, 4])
+        if rng.random() < 0.4:
+            extra["ephemeral-storage"] = rng.choice([50 << 30, 200 << 30])
+        nodes.append(mk_node(f"n{i}", cpu_m=rng.choice([1000, 2000, 4000]),
+                             mem=rng.choice([2 << 30, 8 << 30]), extra=extra))
+    def rpod(name, may_have_host):
+        extra = {}
+        if rng.random() < 0.4:
+            extra["nvidia.com/gpu"] = rng.choice([1, 2])
+        if rng.random() < 0.3:
+            extra["ephemeral-storage"] = rng.choice([10 << 30, 40 << 30])
+        kw = dict(cpu_m=rng.choice([0, 100, 500]),
+                  mem=rng.choice([0, 64 << 20, 1 << 30]), extra=extra)
+        if may_have_host:
+            kw["host"] = rng.choice([n.metadata.name for n in nodes] + [""])
+        return mk_pod(name, **kw)
+    existing = [rpod(f"e{i}", True) for i in range(rng.randint(0, 15))]
+    pending = [rpod(f"p{i}", False) for i in range(rng.randint(1, 30))]
+    assert_equivalent(nodes, existing, pending)

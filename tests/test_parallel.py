@@ -71,7 +71,7 @@ def test_padding_nodes_never_win():
     snap = encode_snapshot(nodes, existing, pending, services)
     mesh = make_mesh(pods_axis=1)
     inp, n = pad_inputs_for_mesh(snapshot_to_inputs(snap), mesh)
-    assert inp.cap_cpu.shape[0] == 8 and n == 3
+    assert inp.cap.shape[0] == 8 and n == 3
     chosen, _ = solve_sharded(snapshot_to_inputs(snap), mesh)
     assert chosen.max() < 3  # padding indices unreachable
     assert decisions_to_names(snap, chosen) == solve_serial(
