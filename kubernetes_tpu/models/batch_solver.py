@@ -69,6 +69,7 @@ def ensure_x64() -> None:
 import jax.numpy as jnp
 import numpy as np
 
+from kubernetes_tpu.models import gang
 from kubernetes_tpu.models.policy import BatchPolicy
 from kubernetes_tpu.models.snapshot import ClusterSnapshot
 from kubernetes_tpu.ops.kernels import (
@@ -112,6 +113,7 @@ class SolverInputs(NamedTuple):
     pod_gid: jnp.ndarray
     pod_group_member: jnp.ndarray
     group_counts: jnp.ndarray
+    gang_start: jnp.ndarray      # [P] bool — rollback checkpoint markers
     # policy extensions (zero-size planes when unused)
     score_static: jnp.ndarray    # [N] i32
     node_aff_vals: jnp.ndarray   # [N, L] i32
@@ -214,6 +216,9 @@ def snapshot_to_inputs(snap: ClusterSnapshot) -> SolverInputs:
         pod_gid=jnp.asarray(snap.pod_gid),
         pod_group_member=jnp.asarray(snap.pod_group_member),
         group_counts=jnp.asarray(snap.group_counts),
+        gang_start=jnp.asarray(snap.pod_run_start
+                               if snap.pod_run_start is not None
+                               else np.ones(P, bool)),
         score_static=jnp.asarray(score_static.astype(np.int32)),
         node_aff_vals=jnp.asarray(node_aff_vals.astype(np.int32)),
         pod_aff_static=jnp.asarray(pod_aff_static.astype(np.int32)),
@@ -226,16 +231,24 @@ def snapshot_to_inputs(snap: ClusterSnapshot) -> SolverInputs:
 
 @functools.partial(jax.jit,
                    static_argnames=("w_lr", "w_spread", "w_equal", "unroll",
-                                    "pol"))
+                                    "pol", "gangs"))
 def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
               w_equal: int = 0, unroll: int = 1,
-              pol: Optional[BatchPolicy] = None
+              pol: Optional[BatchPolicy] = None, gangs: bool = False
               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Solve one wave. Returns (chosen_node_idx[P] int32 — -1 unschedulable,
     scores[P] int32 — the winning combined score, -1 if unschedulable).
 
     ``pol`` is the static policy description; when omitted, the default
-    provider's plugin set with the given legacy weights applies."""
+    provider's plugin set with the given legacy weights applies.
+
+    ``gangs`` enables all-or-nothing PodGroup runs (models/gang.py): the
+    scan carries a checkpoint of its committed state from each run's first
+    member; a failing member restores it — later pods schedule as if the
+    failed group never placed — and blocks the run's remaining members.
+    Callers then drop the failed runs' earlier tentative choices with
+    gang.apply_all_or_nothing. Off by default: the checkpoint copy doubles
+    the carry, so waves without gangs compile the original program."""
     if pol is None:
         pol = BatchPolicy(w_lr=w_lr, w_spread=w_spread, w_equal=w_equal)
     N, R = inp.cap.shape
@@ -286,11 +299,14 @@ def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
                  inp.node_ports, inp.node_pds, inp.group_counts,
                  inp.anchor_vals0, inp.has_anchor0)
 
-    def step(carry: Carry, xs):
+    def step(carry: Carry, xs, blocked=None):
         (static_row, req, pod_ports, pod_pds,
          tie_hi, tie_lo, gid, member, aff_static) = xs
 
         feasible = static_row
+        if blocked is not None:
+            # remaining members of an already-failed gang place nowhere
+            feasible = feasible & ~blocked
         if pol.use_resources:
             # Filter: resources over all R dims (predicates.go:127-152 —
             # a pod requesting zero of everything always fits; pre-exceeded
@@ -396,15 +412,44 @@ def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
     xs = (static_mask, inp.req, inp.pod_ports, inp.pod_pds,
           inp.tie_hi, inp.tie_lo, inp.pod_gid, inp.pod_group_member,
           inp.pod_aff_static)
-    _, (chosen, scores) = jax.lax.scan(step, init, xs, unroll=unroll)
+    if not gangs:
+        _, (chosen, scores) = jax.lax.scan(step, init, xs, unroll=unroll)
+        return chosen, scores
+
+    def gang_step(carry, x):
+        state, ckpt, failed = carry
+        core, start = x[:-1], x[-1]
+        # a new scheduling unit begins: checkpoint the committed state
+        ckpt = jax.tree.map(lambda s, c: jnp.where(start, s, c), state, ckpt)
+        failed = failed & ~start
+        new_state, (chosen, win) = step(state, core, blocked=failed)
+        failed = failed | (chosen < 0)
+        # rollback: a failed run's commits (including this step's no-op)
+        # are undone, pinning the state at the checkpoint until the run ends
+        new_state = jax.tree.map(lambda c, n: jnp.where(failed, c, n),
+                                 ckpt, new_state)
+        return (new_state, ckpt, failed), (chosen, win)
+
+    _, (chosen, scores) = jax.lax.scan(
+        gang_step, (init, init, jnp.bool_(False)),
+        xs + (inp.gang_start,), unroll=unroll)
     return chosen, scores
 
 
 def solve(snap: ClusterSnapshot) -> Tuple[np.ndarray, np.ndarray]:
-    """Host entry: encode -> device -> solve -> host decisions."""
+    """Host entry: encode -> device -> solve -> host decisions (including
+    the all-or-nothing gang post-pass when the wave has PodGroups)."""
     inp = snapshot_to_inputs(snap)
-    chosen, scores = solve_jit(inp, pol=snap.policy)
-    return np.asarray(chosen), np.asarray(scores)
+    has_gangs = snap.has_gangs
+    chosen, scores = solve_jit(inp, pol=snap.policy, gangs=has_gangs)
+    chosen = np.asarray(chosen)
+    scores = np.asarray(scores)
+    if has_gangs:
+        chosen = gang.apply_all_or_nothing(snap.pod_rid, chosen)
+        # keep the chosen/score pairing: rolled-back members' tentative
+        # winning scores are as stale as their hosts
+        scores = np.where(chosen < 0, np.int32(NEG), scores)
+    return chosen, scores
 
 
 def decisions_to_names(snap: ClusterSnapshot, chosen: np.ndarray):

@@ -1,0 +1,112 @@
+"""Gang (PodGroup) scheduling — all-or-nothing placement within a wave.
+
+The reference has no gang scheduler (its scheduleOne loop is strictly
+per-pod, plugin/pkg/scheduler/scheduler.go:87-119); this is the
+coscheduling extension the BASELINE "1k PodGroups x 8 pods all-or-nothing"
+config exercises, designed wave-native: a pod group either fully places
+within the wave or places not at all, with the solver rolling its
+sequential-commit state back so later pods schedule as if the failed group
+never existed.
+
+Pods declare membership through annotations (the out-of-tree coscheduling
+convention):
+
+- ``scheduler.kubernetes.io/group-name``: the PodGroup name; groups are
+  namespace-scoped, so the gang key is (namespace, group-name);
+- ``scheduler.kubernetes.io/group-min-members``: optional quorum — a wave
+  containing fewer members than this fails the present members immediately
+  (requeue + backoff) without solving them, the batch analog of a Permit
+  plugin denying until quorum arrives.
+
+Semantics are defined over *runs*: maximal stretches of consecutive
+wave pods sharing a gang key. ``order_wave`` makes runs contiguous (stable
+first-appearance order), so a well-formed wave has exactly one run per
+group; the solver and the serial gang oracle both operate run-wise, so
+they agree by construction even on adversarial orderings.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from kubernetes_tpu.api import types as api
+
+__all__ = [
+    "GANG_NAME_ANNOTATION", "GANG_MIN_MEMBERS_ANNOTATION",
+    "gang_key", "gang_min_members", "order_wave", "pod_run_ids",
+    "apply_all_or_nothing",
+]
+
+GANG_NAME_ANNOTATION = "scheduler.kubernetes.io/group-name"
+GANG_MIN_MEMBERS_ANNOTATION = "scheduler.kubernetes.io/group-min-members"
+
+
+def gang_key(pod: api.Pod) -> Optional[Tuple[str, str]]:
+    """(namespace, group-name) for gang members, None for singletons."""
+    name = (pod.metadata.annotations or {}).get(GANG_NAME_ANNOTATION)
+    if not name:
+        return None
+    return (pod.metadata.namespace, name)
+
+
+def gang_min_members(pod: api.Pod) -> int:
+    """The group quorum a member declares (0 = no quorum)."""
+    raw = (pod.metadata.annotations or {}).get(GANG_MIN_MEMBERS_ANNOTATION)
+    try:
+        return int(raw) if raw else 0
+    except ValueError:
+        return 0
+
+
+def order_wave(pods: Sequence[api.Pod]) -> List[api.Pod]:
+    """Reorder a wave so each gang's members are contiguous, preserving the
+    first-appearance order of scheduling units (singletons and gangs) and
+    the relative order of members within a gang — the wave analog of the
+    FIFO's arrival order."""
+    units: Dict[object, List[api.Pod]] = {}
+    order: List[object] = []
+    for i, p in enumerate(pods):
+        key = gang_key(p) or ("", f"\x00singleton-{i}")
+        if key not in units:
+            units[key] = []
+            order.append(key)
+        units[key].append(p)
+    return [p for key in order for p in units[key]]
+
+
+def pod_run_ids(pods: Sequence[api.Pod]) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-pod (run_id, run_start) arrays. run_id is -1 for singletons and
+    a dense index per maximal run of consecutive same-gang pods otherwise;
+    run_start marks the first pod of every scheduling unit (every
+    singleton, and the first member of each run) — where the solver
+    checkpoints its rollback state."""
+    P = len(pods)
+    rid = np.full(P, -1, np.int32)
+    start = np.ones(P, bool)
+    prev_key = object()
+    next_rid = 0
+    for j, p in enumerate(pods):
+        key = gang_key(p)
+        if key is not None and key == prev_key:
+            rid[j] = rid[j - 1]
+            start[j] = False
+        elif key is not None:
+            rid[j] = next_rid
+            next_rid += 1
+        prev_key = key
+    return rid, start
+
+
+def apply_all_or_nothing(rid: np.ndarray, chosen: np.ndarray) -> np.ndarray:
+    """Host post-pass: nullify every member of a run containing a failed
+    member. The solver already rolled its state back in-scan, so earlier
+    members' tentative hosts are stale the moment a later member fails —
+    this drops them from the output too."""
+    chosen = np.asarray(chosen).copy()
+    in_gang = rid >= 0
+    failed_runs = np.unique(rid[in_gang & (chosen < 0)])
+    if failed_runs.size:
+        chosen[np.isin(rid, failed_runs) & in_gang] = -1
+    return chosen

@@ -15,6 +15,7 @@ import copy
 from typing import List, Optional, Sequence
 
 from kubernetes_tpu.api import types as api
+from kubernetes_tpu.models import gang as gang_mod
 from kubernetes_tpu.scheduler import plugins as schedplugins
 from kubernetes_tpu.scheduler.generic import FitError, GenericScheduler
 from kubernetes_tpu.scheduler.listers import (
@@ -31,10 +32,16 @@ def solve_serial(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
                  pending_pods: Sequence[api.Pod],
                  services: Sequence[api.Service] = (),
                  provider: str = schedplugins.DEFAULT_PROVIDER,
-                 policy: Optional[schedplugins.Policy] = None
-                 ) -> List[Optional[str]]:
+                 policy: Optional[schedplugins.Policy] = None,
+                 gangs: bool = False) -> List[Optional[str]]:
     """Serial reference decisions for a wave. A ``policy`` replaces the
-    provider's plugin sets entirely (CreateFromConfig, factory.go:88-104)."""
+    provider's plugin sets entirely (CreateFromConfig, factory.go:88-104).
+
+    With ``gangs=True``, PodGroup runs (models/gang.py) are all-or-nothing:
+    members commit one by one exactly as above, but a member failing rolls
+    the whole run's commits back, fails every member of the run, and the
+    walk resumes after it — the semantics the in-scan checkpoint/rollback
+    path must reproduce bit-for-bit."""
     node_list = api.NodeList(items=list(nodes))
     committed: List[api.Pod] = list(existing_pods)
     pod_lister = FakePodLister(committed)  # shared, mutated via committed
@@ -51,18 +58,45 @@ def solve_serial(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
         predicates = schedplugins.get_predicates(keys["predicates"], args)
         priorities = schedplugins.get_priorities(keys["priorities"], args)
     scheduler = GenericScheduler(predicates, priorities, pod_lister)
-
-    decisions: List[Optional[str]] = []
     minion_lister = FakeMinionLister(node_list)
-    for pod in pending_pods:
+
+    def schedule_one(pod) -> Optional[str]:
         try:
             host = scheduler.schedule(pod, minion_lister)
         except FitError:
-            decisions.append(None)
-            continue
-        decisions.append(host)
+            return None
         bound = copy.deepcopy(pod)
         bound.spec.host = host
         bound.status.host = host
         committed.append(bound)  # visible to the next decision via pod_lister
+        return host
+
+    pending = list(pending_pods)
+    if not gangs:
+        return [schedule_one(p) for p in pending]
+
+    rid, _start = gang_mod.pod_run_ids(pending)
+    decisions: List[Optional[str]] = [None] * len(pending)
+    j = 0
+    while j < len(pending):
+        if rid[j] < 0:                      # singleton
+            decisions[j] = schedule_one(pending[j])
+            j += 1
+            continue
+        run = [j]
+        while j + len(run) < len(pending) and rid[j + len(run)] == rid[j]:
+            run.append(j + len(run))
+        mark = len(committed)
+        ok = True
+        for k in run:
+            host = schedule_one(pending[k])
+            decisions[k] = host
+            if host is None:
+                ok = False
+                break
+        if not ok:                          # rollback the whole run
+            del committed[mark:]
+            for k in run:
+                decisions[k] = None
+        j = run[-1] + 1
     return decisions

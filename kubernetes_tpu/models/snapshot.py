@@ -45,6 +45,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from kubernetes_tpu.api import types as api
+from kubernetes_tpu.models import gang
 from kubernetes_tpu.models.policy import BatchPolicy, DEFAULT_BATCH_POLICY
 from kubernetes_tpu.scheduler import predicates as _preds
 from kubernetes_tpu.scheduler.generic import (
@@ -126,6 +127,9 @@ class ClusterSnapshot:
     pod_gid: np.ndarray          # [P] i32, -1 = no service
     pod_group_member: np.ndarray  # [P, G] bool — pod's labels match group's selector
     group_counts: np.ndarray     # [G, N+1] i32 (slot N: unassigned/unknown hosts)
+    # gang (PodGroup) runs — models/gang.py; rid -1 = singleton
+    pod_rid: np.ndarray = None       # [P] i32 run id
+    pod_run_start: np.ndarray = None  # [P] bool — checkpoint marker
     # policy extensions (minimal shapes when the policy doesn't use them)
     score_static: np.ndarray = None    # [N] i32 — NodeLabelPriority terms
     node_aff_vals: np.ndarray = None   # [N, L] i32 value codes, -1 absent
@@ -146,6 +150,10 @@ class ClusterSnapshot:
     @property
     def n_pods(self) -> int:
         return len(self.pod_names)
+
+    @property
+    def has_gangs(self) -> bool:
+        return self.pod_rid is not None and bool((self.pod_rid >= 0).any())
 
 
 def _label_items(meta_labels: Optional[Dict[str, str]]):
@@ -246,6 +254,7 @@ def encode_snapshot(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
                                         v.source.gce_persistent_disk.pd_name)))
         if p.spec.host:
             pod_host_idx[j] = node_index.get(p.spec.host, -2)
+    pod_rid, pod_run_start = gang.pod_run_ids(pending_pods)
     tie = _fnv1a64_batch([pod_tie_break_key(p) for p in pending_pods])
     tie_hi = (tie >> np.uint64(32)).astype(np.int64)
     tie_lo = (tie & np.uint64(0xFFFFFFFF)).astype(np.int64)
@@ -488,6 +497,7 @@ def encode_snapshot(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
         pod_host_idx=pod_host_idx, tie_hi=tie_hi, tie_lo=tie_lo,
         pod_gid=pod_gid, pod_group_member=pod_group_member,
         group_counts=group_counts,
+        pod_rid=pod_rid, pod_run_start=pod_run_start,
         score_static=score_static,
         node_aff_vals=node_aff_vals, pod_aff_static=pod_aff_static,
         anchor_vals0=anchor_vals0, has_anchor0=has_anchor0,

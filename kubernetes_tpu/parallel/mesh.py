@@ -70,6 +70,7 @@ def pad_inputs_for_mesh(inp: SolverInputs, mesh: Mesh) -> Tuple[SolverInputs, in
         pod_host_idx=inp.pod_host_idx, tie_hi=inp.tie_hi, tie_lo=inp.tie_lo,
         pod_gid=inp.pod_gid, pod_group_member=inp.pod_group_member,
         group_counts=pad_n(inp.group_counts, axis=1),
+        gang_start=inp.gang_start,
         score_static=pad_n(inp.score_static),
         node_aff_vals=pad_n(inp.node_aff_vals, fill=-1),
         pod_aff_static=inp.pod_aff_static,
@@ -101,6 +102,7 @@ def _input_shardings(mesh: Mesh) -> SolverInputs:
         # counts: small [G, N+1] — the +1 overflow slot breaks even node
         # sharding; replicate (GSPMD gathers the one-hot update, tiny)
         group_counts=rep,
+        gang_start=rep,
         score_static=node,
         node_aff_vals=node2d,
         pod_aff_static=rep,
@@ -112,9 +114,10 @@ def _input_shardings(mesh: Mesh) -> SolverInputs:
 
 def solve_sharded(inp: SolverInputs, mesh: Optional[Mesh] = None,
                   w_lr: int = 1, w_spread: int = 1, w_equal: int = 0,
-                  pol=None) -> Tuple[np.ndarray, np.ndarray]:
+                  pol=None, gangs: bool = False) -> Tuple[np.ndarray, np.ndarray]:
     """Run solve_jit under a device mesh. Decisions are identical to the
-    single-device path; only the layout changes."""
+    single-device path; only the layout changes. Gang callers apply
+    gang.apply_all_or_nothing to the returned decisions, as with solve."""
     mesh = mesh or make_mesh()
     padded, n = pad_inputs_for_mesh(inp, mesh)
     shardings = _input_shardings(mesh)
@@ -122,7 +125,7 @@ def solve_sharded(inp: SolverInputs, mesh: Optional[Mesh] = None,
     with mesh:
         chosen, scores = solve_jit(SolverInputs(*placed), w_lr=w_lr,
                                    w_spread=w_spread, w_equal=w_equal,
-                                   pol=pol)
+                                   pol=pol, gangs=gangs)
     chosen = np.asarray(chosen)
     scores = np.asarray(scores)
     # padded nodes are infeasible, so indices never point past n; no remap

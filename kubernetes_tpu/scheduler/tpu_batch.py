@@ -25,11 +25,8 @@ from typing import List, Optional
 
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.client.record import EventRecorder
-from kubernetes_tpu.models.batch_solver import (
-    decisions_to_names,
-    snapshot_to_inputs,
-    solve_jit,
-)
+from kubernetes_tpu.models import gang
+from kubernetes_tpu.models.batch_solver import decisions_to_names, solve
 from kubernetes_tpu.models.policy import BatchPolicy, batch_policy_from
 from kubernetes_tpu.models.snapshot import encode_snapshot
 from kubernetes_tpu.scheduler.driver import ConfigFactory, SchedulerConfig
@@ -80,10 +77,45 @@ class BatchScheduler:
     def _default_solve(self, nodes, existing, pending, services):
         snap = encode_snapshot(nodes, existing, pending, services,
                                policy=self.batch_policy)
-        chosen, _ = solve_jit(snapshot_to_inputs(snap), pol=self.batch_policy)
-        import numpy as np
+        chosen, _ = solve(snap)  # includes the gang all-or-nothing post-pass
+        return decisions_to_names(snap, chosen)
 
-        return decisions_to_names(snap, np.asarray(chosen))
+    def _gate_gang_quorum(self, pods: List[api.Pod],
+                          existing: List[api.Pod] = ()
+                          ) -> tuple[List[api.Pod], List[api.Pod]]:
+        """Split the wave into (schedulable, quorum-failed): a gang whose
+        membership is below its declared min-members fails its present
+        members up front (requeue + backoff) — the batch analog of a Permit
+        plugin denying until quorum arrives — instead of solving a partial
+        group as if it were whole.
+
+        Quorum is aggregated per group (max of the members' declarations,
+        so one unannotated member can't sneak a partial group past the
+        gate) and counts already-placed members of the group from the
+        cluster alongside the wave's: a straggler whose siblings bound in
+        an earlier wave (or whose own bind lost a CAS race and was
+        requeued) schedules once the group total reaches quorum, instead
+        of starving forever on its own wave count."""
+        present: dict = {}
+        quorum: dict = {}
+        for p in pods:
+            k = gang.gang_key(p)
+            if k is not None:
+                present[k] = present.get(k, 0) + 1
+                quorum[k] = max(quorum.get(k, 0), gang.gang_min_members(p))
+        for p in existing:
+            k = gang.gang_key(p)
+            if k in present and (p.status.host or p.spec.host):
+                present[k] += 1
+        ok: List[api.Pod] = []
+        starved: List[api.Pod] = []
+        for p in pods:
+            k = gang.gang_key(p)
+            if k is not None and present[k] < quorum[k]:
+                starved.append(p)
+            else:
+                ok.append(p)
+        return ok, starved
 
     def schedule_wave(self, timeout: Optional[float] = None) -> int:
         """Drain, solve, commit. Returns the number of pods bound."""
@@ -93,6 +125,21 @@ class BatchScheduler:
             nodes = c.minion_lister.list().items
             existing = c.modeler.list()
             services = self.factory.service_store.list()
+        except Exception as e:
+            for pod in pending:
+                self._record(pod, "FailedScheduling", "Error scheduling wave: %s", e)
+                c.error(pod, e)
+            return 0
+        pending, starved = self._gate_gang_quorum(pending, existing)
+        for pod in starved:
+            err = FitError(pod, {})
+            self._record(pod, "FailedScheduling",
+                         "Pod group below min-members quorum")
+            c.error(pod, err)
+        if not pending:
+            return 0
+        pending = gang.order_wave(pending)
+        try:
             decisions = self.solve_fn(nodes, existing, pending, services)
         except Exception as e:
             # a failed solve must not drop the drained wave: hand every pod

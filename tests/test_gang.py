@@ -1,0 +1,212 @@
+"""Gang (PodGroup) all-or-nothing scheduling — solver vs serial gang oracle.
+
+The equivalence contract extends to gangs: the in-scan checkpoint/rollback
+path plus the host all-or-nothing post-pass must agree bit-for-bit with the
+serial oracle's commit/rollback walk (models/oracle.solve_serial gangs=True)
+on every wave.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.quantity import Quantity
+from kubernetes_tpu.models import gang
+from kubernetes_tpu.models.batch_solver import decisions_to_names, solve
+from kubernetes_tpu.models.oracle import solve_serial
+from kubernetes_tpu.models.snapshot import encode_snapshot
+
+
+def mk_node(name, cpu_m=4000, mem=8 << 30):
+    return api.Node(
+        metadata=api.ObjectMeta(name=name),
+        spec=api.NodeSpec(capacity={"cpu": Quantity(f"{cpu_m}m"),
+                                    "memory": Quantity(mem)}))
+
+
+def mk_pod(name, ns="default", cpu_m=0, mem=0, group=None, min_members=None,
+           labels=None):
+    ann = {}
+    if group:
+        ann[gang.GANG_NAME_ANNOTATION] = group
+    if min_members is not None:
+        ann[gang.GANG_MIN_MEMBERS_ANNOTATION] = str(min_members)
+    limits = {}
+    if cpu_m:
+        limits["cpu"] = Quantity(f"{cpu_m}m")
+    if mem:
+        limits["memory"] = Quantity(mem)
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace=ns, uid=f"uid-{ns}-{name}",
+                                annotations=ann, labels=labels or {}),
+        spec=api.PodSpec(containers=[api.Container(
+            name="c", image="i",
+            resources=api.ResourceRequirements(limits=limits))]))
+
+
+def assert_equivalent(nodes, existing, pending, services=()):
+    serial = solve_serial(nodes, existing, pending, services, gangs=True)
+    snap = encode_snapshot(nodes, existing, pending, services)
+    chosen, _ = solve(snap)
+    batch = decisions_to_names(snap, chosen)
+    assert batch == serial, (
+        f"divergence:\n  serial={serial}\n  batch ={batch}")
+    return serial
+
+
+# -- unit helpers -----------------------------------------------------------
+
+def test_order_wave_groups_contiguously():
+    pods = [mk_pod("a1", group="a"), mk_pod("s1"), mk_pod("b1", group="b"),
+            mk_pod("a2", group="a"), mk_pod("s2"), mk_pod("b2", group="b")]
+    ordered = [p.metadata.name for p in gang.order_wave(pods)]
+    assert ordered == ["a1", "a2", "s1", "b1", "b2", "s2"]
+
+
+def test_pod_run_ids():
+    pods = [mk_pod("a1", group="a"), mk_pod("a2", group="a"), mk_pod("s"),
+            mk_pod("b1", group="b")]
+    rid, start = gang.pod_run_ids(pods)
+    assert rid.tolist() == [0, 0, -1, 1]
+    assert start.tolist() == [True, False, True, True]
+
+
+def test_run_ids_namespace_scoped():
+    pods = [mk_pod("x", ns="ns1", group="g"), mk_pod("y", ns="ns2", group="g")]
+    rid, start = gang.pod_run_ids(pods)
+    assert rid.tolist() == [0, 1] and start.tolist() == [True, True]
+
+
+def test_apply_all_or_nothing():
+    rid = np.array([0, 0, -1, 1, 1], np.int32)
+    chosen = np.array([3, -1, 2, 0, 1], np.int32)
+    out = gang.apply_all_or_nothing(rid, chosen)
+    assert out.tolist() == [-1, -1, 2, 0, 1]
+
+
+# -- solver equivalence -----------------------------------------------------
+
+def test_gang_fits_entirely():
+    nodes = [mk_node(f"n{i}", cpu_m=1000, mem=2 << 30) for i in range(4)]
+    pending = [mk_pod(f"g{i}", cpu_m=500, mem=256 << 20, group="job")
+               for i in range(8)]
+    serial = assert_equivalent(nodes, [], pending)
+    assert None not in serial  # 8 x 500m onto 4 x 1000m exactly fits
+
+
+def test_gang_rolls_back_when_member_fails():
+    """5 members x 600m onto 2 x 1000m nodes: the 4th member fails, so the
+    whole gang must vacate — and the singleton after it gets a full node."""
+    nodes = [mk_node("a", cpu_m=1000, mem=1 << 30),
+             mk_node("b", cpu_m=1000, mem=1 << 30)]
+    pending = [mk_pod(f"g{i}", cpu_m=600, mem=64 << 20, group="big")
+               for i in range(5)]
+    pending.append(mk_pod("solo", cpu_m=900, mem=64 << 20))
+    serial = assert_equivalent(nodes, [], pending)
+    assert serial[:5] == [None] * 5
+    assert serial[5] is not None  # rollback freed the capacity
+
+
+def test_failed_gang_frees_state_for_later_gang():
+    nodes = [mk_node("a", cpu_m=1000, mem=1 << 30)]
+    pending = ([mk_pod(f"x{i}", cpu_m=400, mem=64 << 20, group="wontfit")
+                for i in range(3)] +          # 1200m > 1000m -> fails
+               [mk_pod(f"y{i}", cpu_m=500, mem=64 << 20, group="fits")
+                for i in range(2)])           # 1000m fits after rollback
+    serial = assert_equivalent(nodes, [], pending)
+    assert serial[:3] == [None] * 3 and None not in serial[3:]
+
+
+def test_gang_with_service_spreading_rolls_back_counts():
+    """Committed gang members bump spreading counts; rollback must restore
+    them or later pods see phantom peers."""
+    nodes = [mk_node(f"n{i}", cpu_m=1000, mem=1 << 30) for i in range(3)]
+    svc = api.Service(metadata=api.ObjectMeta(name="web", namespace="default"),
+                      spec=api.ServiceSpec(port=80, selector={"app": "w"}))
+    pending = ([mk_pod(f"g{i}", cpu_m=800, mem=64 << 20, group="heavy",
+                       labels={"app": "w"}) for i in range(4)] +  # fails (4x800 > 3x1000)
+               [mk_pod(f"p{i}", labels={"app": "w"}) for i in range(3)])
+    serial = assert_equivalent(nodes, [], pending, [svc])
+    assert serial[:4] == [None] * 4
+
+
+def test_singletons_between_gangs():
+    nodes = [mk_node(f"n{i}", cpu_m=2000, mem=4 << 30) for i in range(3)]
+    pending = [mk_pod("s0", cpu_m=100),
+               mk_pod("a0", cpu_m=300, group="a"), mk_pod("a1", cpu_m=300, group="a"),
+               mk_pod("s1", cpu_m=100),
+               mk_pod("b0", cpu_m=9000, group="b"),  # fails alone
+               mk_pod("s2", cpu_m=100)]
+    serial = assert_equivalent(nodes, [], pending)
+    assert serial[4] is None and serial[5] is not None
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_fuzz_gang_equivalence(seed):
+    rng = random.Random(7000 + seed)
+    nodes = [mk_node(f"n{i}", cpu_m=rng.choice([1000, 2000]),
+                     mem=rng.choice([2 << 30, 4 << 30]))
+             for i in range(rng.randint(2, 8))]
+    pending = []
+    for u in range(rng.randint(1, 10)):
+        if rng.random() < 0.6:
+            size = rng.randint(2, 6)
+            cpu = rng.choice([200, 400, 800])
+            pending += [mk_pod(f"u{u}m{i}", cpu_m=cpu, mem=64 << 20,
+                               group=f"grp{u}") for i in range(size)]
+        else:
+            pending.append(mk_pod(f"u{u}", cpu_m=rng.choice([0, 100, 500]),
+                                  mem=rng.choice([0, 64 << 20])))
+    existing = [mk_pod(f"e{i}", cpu_m=rng.choice([100, 300]), mem=32 << 20)
+                for i in range(rng.randint(0, 6))]
+    for e in existing:
+        e.status.host = rng.choice([n.metadata.name for n in nodes] + [""])
+    assert_equivalent(nodes, existing, pending)
+
+
+# -- BatchScheduler integration --------------------------------------------
+
+def test_quorum_gate():
+    from kubernetes_tpu.scheduler.tpu_batch import BatchScheduler
+
+    pods = [mk_pod("m0", group="j", min_members=3),
+            mk_pod("m1", group="j", min_members=3),
+            mk_pod("solo")]
+    ok, starved = BatchScheduler._gate_gang_quorum(None, pods)
+    assert [p.metadata.name for p in starved] == ["m0", "m1"]
+    assert [p.metadata.name for p in ok] == ["solo"]
+
+    pods.append(mk_pod("m2", group="j", min_members=3))
+    ok, starved = BatchScheduler._gate_gang_quorum(None, pods)
+    assert starved == [] and len(ok) == 4
+
+
+def test_quorum_aggregates_over_members():
+    """One unannotated member must not sneak a partial group past the gate:
+    the group quorum is the max of its members' declarations."""
+    from kubernetes_tpu.scheduler.tpu_batch import BatchScheduler
+
+    pods = [mk_pod("m0", group="j", min_members=3),
+            mk_pod("m1", group="j")]  # no quorum annotation of its own
+    ok, starved = BatchScheduler._gate_gang_quorum(None, pods)
+    assert [p.metadata.name for p in starved] == ["m0", "m1"]
+    assert ok == []
+
+
+def test_quorum_counts_already_bound_siblings():
+    """A straggler whose siblings already bound (earlier wave, or its own
+    bind lost a CAS race and was requeued) passes the gate once the group
+    total reaches quorum — no permanent starvation."""
+    from kubernetes_tpu.scheduler.tpu_batch import BatchScheduler
+
+    straggler = [mk_pod("m7", group="j", min_members=8)]
+    bound = [mk_pod(f"m{i}", group="j", min_members=8) for i in range(7)]
+    for p in bound:
+        p.status.host = "node-1"
+    ok, starved = BatchScheduler._gate_gang_quorum(None, straggler, bound)
+    assert starved == [] and [p.metadata.name for p in ok] == ["m7"]
+    # with only 6 bound siblings the straggler still waits
+    ok, starved = BatchScheduler._gate_gang_quorum(None, straggler, bound[:6])
+    assert [p.metadata.name for p in starved] == ["m7"]
