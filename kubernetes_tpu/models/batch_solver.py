@@ -94,7 +94,6 @@ class SolverInputs(NamedTuple):
     per-dimension gcd-scaled wave fits, else int64; port/pd sets are packed
     uint32 bitmask words."""
 
-    n_scored: jnp.ndarray        # [] i32 — LeastRequested divisor (see snapshot)
     cap: jnp.ndarray             # [N, R]
     fit_used: jnp.ndarray        # [N, R]
     fit_exceeded: jnp.ndarray
@@ -176,7 +175,7 @@ def snapshot_to_inputs(snap: ClusterSnapshot) -> SolverInputs:
     rdt = np.int32 if use_i32 else np.int64
 
     N = snap.n_nodes
-    P = snap.n_pods
+    P = snap.req.shape[0]  # includes pod-axis padding (n_pods is the real count)
     G = snap.group_counts.shape[0]
     score_static = (snap.score_static if snap.score_static is not None
                     else np.zeros(N, np.int32))
@@ -198,7 +197,6 @@ def snapshot_to_inputs(snap: ClusterSnapshot) -> SolverInputs:
     zone_labeled = node_zone >= 0                             # [A, N]
 
     return SolverInputs(
-        n_scored=jnp.asarray(np.int32(snap.n_scored)),
         cap=jnp.asarray(cap.astype(rdt)),
         fit_used=jnp.asarray(fit_used.astype(rdt)),
         fit_exceeded=jnp.asarray(snap.fit_exceeded),
@@ -260,6 +258,12 @@ def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
     # always dims 0,1 — are unconstrained at zero capacity (reference
     # parity); extended dims are strict, so a GPU pod can't land GPU-less
     unconstrained = (inp.cap == 0) & (jnp.arange(R) < 2)[None, :]  # [N, R]
+    # extra dims a node advertises — the per-step LeastRequested divisor is
+    # 2 + however many of these some FEASIBLE node advertises, because the
+    # serial path prioritizes over the filtered node list and so derives
+    # its resource universe from exactly that subset
+    # (generic_scheduler.go:70-75; priorities.least_requested_priority)
+    adv_extra = (inp.cap != 0) & (jnp.arange(R) >= 2)[None, :]     # [N, R]
 
     if pol.all_infeasible:
         # no nonzero-weight priorities: prioritizeNodes emits nothing and
@@ -343,12 +347,16 @@ def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
         score = jnp.zeros(N, jnp.int32)
         if pol.w_lr:
             # Score: LeastRequested (priorities.go:41-75 — all-pods usage),
-            # averaged over the scored dims (sum // n_scored == the
-            # reference's (cpu+mem)/2 when only cpu+memory are advertised;
-            # request-only dims have zero capacity and so score 0)
+            # averaged over the dims the FEASIBLE nodes advertise (sum //
+            # n_dyn == the reference's (cpu+mem)/2 when only cpu+memory are
+            # advertised; dims advertised by no feasible node score 0 on
+            # every node, so only the divisor varies with the filter)
             total = carry.score_used + req[None, :]
+            n_dyn = (jnp.asarray(2, rdt) +
+                     jnp.sum((adv_extra & feasible[:, None]).any(axis=0)
+                             ).astype(rdt))
             lr = (_calculate_score(total, inp.cap).sum(axis=1)
-                  // inp.n_scored.astype(rdt)).astype(jnp.int32)
+                  // n_dyn).astype(jnp.int32)
             score = score + lr * pol.w_lr
         if pol.w_spread:
             # Score: ServiceSpreading (spreading.go:37-86)
@@ -453,5 +461,8 @@ def solve(snap: ClusterSnapshot) -> Tuple[np.ndarray, np.ndarray]:
 
 
 def decisions_to_names(snap: ClusterSnapshot, chosen: np.ndarray):
-    """Map node indices back to host names; None = unschedulable."""
-    return [snap.node_names[i] if i >= 0 else None for i in chosen]
+    """Map node indices back to host names; None = unschedulable. Slices
+    off pod-axis padding (the incremental encoder pow-2 buckets P with
+    never-feasible null rows)."""
+    return [snap.node_names[i] if i >= 0 else None
+            for i in chosen[:len(snap.pod_names)]]

@@ -54,7 +54,7 @@ from kubernetes_tpu.scheduler.generic import (
     pod_tie_break_key,
 )
 
-__all__ = ["ClusterSnapshot", "encode_snapshot"]
+__all__ = ["ClusterSnapshot", "encode_snapshot", "greedy_fit_accumulators"]
 
 
 def _fnv1a64_batch(keys: List[str]) -> np.ndarray:
@@ -78,11 +78,39 @@ def _fnv1a64_batch(keys: List[str]) -> np.ndarray:
         h = np.where(c < lens, nh, h)
     return h
 
-_PAD = 8  # minimum vocabulary padding (keeps matmul shapes nonzero)
-
-
-def _pad_to(n: int, multiple: int = _PAD) -> int:
-    return max(multiple, ((n + multiple - 1) // multiple) * multiple)
+def greedy_fit_accumulators(cap: np.ndarray, score_used: np.ndarray,
+                            pods_in_order) -> Tuple[np.ndarray, np.ndarray]:
+    """Greedy Filter accumulators (CheckPodsExceedingCapacity :104-124):
+    when a node's total existing usage fits its capacity, every prefix fit
+    too — the greedy result equals the sum and nothing exceeded. Only the
+    (rare) overflowing nodes walk ``pods_in_order`` — an iterable of
+    (host_idx, req_vec[R]) in existing-list order (host_idx >= N =
+    off-list). Shared by the full and incremental encoders so the
+    order-exact rule can never drift between them. Per-dim fit rule is
+    predicates.dim_fits: cpu/memory zero-capacity is unconstrained;
+    extended dims are strict."""
+    N, R = cap.shape
+    fit_used = score_used.copy()
+    fit_exceeded = np.zeros(N, bool)
+    is_core = np.arange(R) < 2
+    unconstrained = (cap == 0) & is_core[None, :]
+    all_fit = (unconstrained | (score_used <= cap)).all(axis=1)
+    if not all_fit.all():
+        slow = set(np.nonzero(~all_fit)[0].tolist())
+        per_host: Dict[int, np.ndarray] = {
+            i: np.zeros(R, np.int64) for i in slow}
+        for i, e_req in pods_in_order:
+            i = int(i)
+            if i not in per_host:
+                continue
+            used = per_host[i]
+            if bool((unconstrained[i] | (cap[i] - used >= e_req)).all()):
+                per_host[i] = used + e_req
+            else:
+                fit_exceeded[i] = True
+        for i, used in per_host.items():
+            fit_used[i] = used
+    return fit_used, fit_exceeded
 
 
 def _pow2_pad(n: int, minimum: int = 8) -> int:
@@ -259,9 +287,11 @@ def encode_snapshot(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
     tie_hi = (tie >> np.uint64(32)).astype(np.int64)
     tie_lo = (tie & np.uint64(0xFFFFFFFF)).astype(np.int64)
 
-    K = _pad_to(len(port_vocab))
-    K2 = _pad_to(len(sel_vocab))
-    K3 = _pad_to(len(pd_vocab))
+    # pow-2 buckets on every variable axis (like the group axis below), so
+    # churning vocabularies re-use at most log2 distinct compiled shapes
+    K = _pow2_pad(len(port_vocab))
+    K2 = _pow2_pad(len(sel_vocab))
+    K3 = _pow2_pad(len(pd_vocab))
 
     def scatter_true(pairs, rows, cols) -> np.ndarray:
         out = np.zeros((rows, cols), bool)
@@ -326,34 +356,8 @@ def encode_snapshot(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
     score_used = np.zeros((N, R), np.int64)
     np.add.at(score_used, e_host[on_node], e_req[on_node])
 
-    # greedy Filter accumulators (CheckPodsExceedingCapacity :104-124):
-    # when a node's total existing usage fits its capacity, every prefix fit
-    # too — the greedy result equals the sum and nothing exceeded. Only the
-    # (rare) overflowing nodes need the sequential in-order walk.
-    fit_used = score_used.copy()
-    fit_exceeded = np.zeros(N, bool)
-    # per-dim fit rule (predicates.dim_fits): cpu/memory zero-capacity is
-    # unconstrained; extended dims are strict
-    is_core = np.arange(R) < 2
-    unconstrained = (cap == 0) & is_core[None, :]
-    all_fit = (unconstrained | (score_used <= cap)).all(axis=1)
-    if not all_fit.all():
-        slow = set(np.nonzero(~all_fit)[0].tolist())
-        per_host: Dict[int, np.ndarray] = {
-            i: np.zeros(R, np.int64) for i in slow}
-        for e in range(E):
-            i = int(e_host[e])
-            if i not in per_host:
-                continue
-            used = per_host[i]
-            fits = bool((unconstrained[i] |
-                         (cap[i] - used >= e_req[e])).all())
-            if fits:
-                per_host[i] = used + e_req[e]
-            else:
-                fit_exceeded[i] = True
-        for i, used in per_host.items():
-            fit_used[i] = used
+    fit_used, fit_exceeded = greedy_fit_accumulators(
+        cap, score_used, zip(e_host.tolist(), e_req))
 
     # -- service groups (vectorized) ---------------------------------------
     # group = (namespace, index of FIRST service whose selector matches the

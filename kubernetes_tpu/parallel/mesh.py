@@ -58,7 +58,6 @@ def pad_inputs_for_mesh(inp: SolverInputs, mesh: Mesh) -> Tuple[SolverInputs, in
         return jnp.pad(x, widths, constant_values=fill)
 
     return SolverInputs(
-        n_scored=inp.n_scored,
         cap=pad_n(inp.cap), fit_used=pad_n(inp.fit_used),
         fit_exceeded=pad_n(inp.fit_exceeded, fill=True),
         score_used=pad_n(inp.score_used),
@@ -90,7 +89,6 @@ def _input_shardings(mesh: Mesh) -> SolverInputs:
     node2d = s("nodes", None)
     rep = s()
     return SolverInputs(
-        n_scored=rep,
         cap=node2d, fit_used=node2d, fit_exceeded=node,
         score_used=node2d,
         node_ports=node2d, node_sel=node2d, node_pds=node2d,

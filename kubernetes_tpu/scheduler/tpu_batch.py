@@ -27,6 +27,7 @@ from kubernetes_tpu.api import types as api
 from kubernetes_tpu.client.record import EventRecorder
 from kubernetes_tpu.models import gang
 from kubernetes_tpu.models.batch_solver import decisions_to_names, solve
+from kubernetes_tpu.models.incremental import IncrementalEncoder
 from kubernetes_tpu.models.policy import BatchPolicy, batch_policy_from
 from kubernetes_tpu.models.snapshot import encode_snapshot
 from kubernetes_tpu.scheduler.driver import ConfigFactory, SchedulerConfig
@@ -57,6 +58,15 @@ class BatchScheduler:
         self.solve_fn = solve_fn or self._default_solve
         self.batch_policy = batch_policy or batch_policy_from(
             getattr(config, "provider", None), getattr(config, "policy", None))
+        try:
+            # delta-maintained node planes + sticky vocabularies: per-wave
+            # encode cost is O(changed pods), and pow-2 bucketing keeps the
+            # compiled-shape count bounded under churn
+            self._encoder = IncrementalEncoder(self.batch_policy)
+        except ValueError:
+            # CheckServiceAffinity policies are arrival-order dependent;
+            # full re-encode per wave stays authoritative
+            self._encoder = None
         self._stop = threading.Event()
 
     # -- wave assembly ------------------------------------------------------
@@ -75,8 +85,11 @@ class BatchScheduler:
 
     # -- solving ------------------------------------------------------------
     def _default_solve(self, nodes, existing, pending, services):
-        snap = encode_snapshot(nodes, existing, pending, services,
-                               policy=self.batch_policy)
+        if self._encoder is not None:
+            snap = self._encoder.encode(nodes, existing, pending, services)
+        else:
+            snap = encode_snapshot(nodes, existing, pending, services,
+                                   policy=self.batch_policy)
         chosen, _ = solve(snap)  # includes the gang all-or-nothing post-pass
         return decisions_to_names(snap, chosen)
 
@@ -103,6 +116,8 @@ class BatchScheduler:
             if k is not None:
                 present[k] = present.get(k, 0) + 1
                 quorum[k] = max(quorum.get(k, 0), gang.gang_min_members(p))
+        if not present or not any(quorum.values()):
+            return list(pods), []  # gang-free wave: skip the O(cluster) scan
         for p in existing:
             k = gang.gang_key(p)
             if k in present and (p.status.host or p.spec.host):

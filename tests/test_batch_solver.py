@@ -269,6 +269,21 @@ def test_request_only_resource_is_unschedulable():
     assert serial[0] is None and serial[1] is None and serial[2] is not None
 
 
+def test_least_requested_divisor_follows_filtered_nodes():
+    """The serial path prioritizes over the FILTERED node list, so its
+    LeastRequested universe — and divisor — shrinks when the only node
+    advertising an extra dim is filtered out. Regression: the solver must
+    derive the divisor per pod from the feasible nodes, not the wave."""
+    nodes = [mk_node("gpu", extra={"nvidia.com/gpu": 2}),
+             mk_node("a"), mk_node("b", cpu_m=2000)]
+    # the gpu node is knocked out by a port conflict, not resources
+    existing = [mk_pod("holder", host="gpu", host_ports=(8080,))]
+    pending = [mk_pod(f"p{i}", cpu_m=500, mem=512 << 20, host_ports=(8080,))
+               for i in range(3)]
+    serial = assert_equivalent(nodes, existing, pending)
+    assert "gpu" not in serial
+
+
 @pytest.mark.parametrize("seed", range(6))
 def test_fuzz_equivalence_r_dimensional(seed):
     """Fuzz with a third + fourth resource dimension in the mix."""

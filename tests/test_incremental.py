@@ -1,0 +1,272 @@
+"""IncrementalEncoder vs full encoder — decision equivalence under churn.
+
+The incremental encoder's arrays differ from the full encoder's (sticky
+vocabulary order, pow-2 padding, resident group rows), but the DECISIONS the
+solver derives from them must be identical for every wave, and both must
+match the serial oracle. Fuzzed over multi-wave churn traces with pod
+creates/deletes, binds, node-label dependence, services, gangs, and extended
+resources.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.quantity import Quantity
+from kubernetes_tpu.models import gang
+from kubernetes_tpu.models.batch_solver import (
+    decisions_to_names,
+    snapshot_to_inputs,
+    solve,
+)
+from kubernetes_tpu.models.incremental import IncrementalEncoder
+from kubernetes_tpu.models.oracle import solve_serial
+from kubernetes_tpu.models.policy import BatchPolicy
+from kubernetes_tpu.models.snapshot import encode_snapshot
+
+
+def mk_node(name, cpu_m=2000, mem=4 << 30, labels=None, extra=None):
+    cap = {"cpu": Quantity(f"{cpu_m}m"), "memory": Quantity(mem)}
+    for k, v in (extra or {}).items():
+        cap[k] = Quantity(v)
+    return api.Node(metadata=api.ObjectMeta(name=name, labels=labels or {}),
+                    spec=api.NodeSpec(capacity=cap))
+
+
+_uid = [0]
+
+
+def mk_pod(name, ns="default", cpu_m=0, mem=0, host="", labels=None,
+           node_selector=None, host_ports=(), pds=(), extra=None, group=None):
+    limits = {}
+    if cpu_m:
+        limits["cpu"] = Quantity(f"{cpu_m}m")
+    if mem:
+        limits["memory"] = Quantity(mem)
+    for k, v in (extra or {}).items():
+        limits[k] = Quantity(v)
+    ann = {}
+    if group:
+        ann[gang.GANG_NAME_ANNOTATION] = group
+    _uid[0] += 1
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace=ns,
+                                uid=f"uid-{_uid[0]}", labels=labels or {},
+                                annotations=ann),
+        spec=api.PodSpec(
+            host=host, node_selector=node_selector or {},
+            containers=[api.Container(
+                name="c", image="i",
+                ports=[api.ContainerPort(container_port=80 + i, host_port=p)
+                       for i, p in enumerate(host_ports)],
+                resources=api.ResourceRequirements(limits=limits))],
+            volumes=[api.Volume(name=f"v{i}", source=api.VolumeSource(
+                gce_persistent_disk=api.GCEPersistentDiskVolumeSource(
+                    pd_name=pd))) for i, pd in enumerate(pds)]),
+        status=api.PodStatus(host=host))
+
+
+def assert_wave_equivalent(enc, nodes, existing, pending, services=()):
+    """Incremental decisions == full-encode decisions == serial oracle."""
+    inc = enc.encode(nodes, existing, pending, services)
+    chosen_inc, _ = solve(inc)
+    got = decisions_to_names(inc, chosen_inc)
+    full = encode_snapshot(nodes, existing, pending, services,
+                           policy=enc.policy)
+    chosen_full, _ = solve(full)
+    want = decisions_to_names(full, chosen_full)
+    assert got == want, f"incremental={got}\nfull       ={want}"
+    serial = solve_serial(nodes, existing, pending, services, gangs=True)
+    assert want == serial, f"batch={want}\nserial={serial}"
+    return got
+
+
+def test_single_wave_matches_full():
+    enc = IncrementalEncoder()
+    nodes = [mk_node(f"n{i}") for i in range(4)]
+    pending = [mk_pod(f"p{i}", cpu_m=100, mem=64 << 20) for i in range(6)]
+    assert_wave_equivalent(enc, nodes, [], pending)
+
+
+def test_pod_axis_padding_is_inert():
+    """Wave sizes 1..9 share pow-2 buckets; padding rows never place."""
+    enc = IncrementalEncoder()
+    nodes = [mk_node(f"n{i}") for i in range(3)]
+    existing = []
+    for wave in range(1, 10):
+        pending = [mk_pod(f"w{wave}p{i}", cpu_m=50) for i in range(wave)]
+        got = assert_wave_equivalent(enc, nodes, existing, pending)
+        assert len(got) == wave
+        for p, h in zip(pending, got):
+            if h:
+                p.status.host = h
+                existing.append(p)
+
+
+def test_incremental_tracks_binds_and_deletes():
+    enc = IncrementalEncoder()
+    nodes = [mk_node("a", cpu_m=1000, mem=1 << 30),
+             mk_node("b", cpu_m=1000, mem=1 << 30)]
+    existing = []
+    # wave 1: fill node capacity
+    p1 = [mk_pod(f"p{i}", cpu_m=400, mem=128 << 20) for i in range(4)]
+    got = assert_wave_equivalent(enc, nodes, existing, p1)
+    for p, h in zip(p1, got):
+        p.status.host = h
+        existing.append(p)
+    # wave 2: cluster full (2x1000m - 4x400m = 200m free per node)
+    p2 = [mk_pod("q0", cpu_m=400, mem=128 << 20),
+          mk_pod("q1", cpu_m=400, mem=128 << 20)]
+    got = assert_wave_equivalent(enc, nodes, existing, p2)
+    assert got == [None, None]
+    # delete two pods (one per node under LR spreading), capacity frees up
+    del existing[0:2]
+    p3 = [mk_pod("r0", cpu_m=400, mem=128 << 20),
+          mk_pod("r1", cpu_m=400, mem=128 << 20)]
+    got = assert_wave_equivalent(enc, nodes, existing, p3)
+    assert None not in got
+
+
+def test_node_change_triggers_consistent_rebuild():
+    enc = IncrementalEncoder()
+    nodes = [mk_node("a"), mk_node("b")]
+    pending = [mk_pod("p0", cpu_m=100)]
+    assert_wave_equivalent(enc, nodes, [], pending)
+    nodes = nodes + [mk_node("c", labels={"zone": "z2"})]
+    pending = [mk_pod("p1", cpu_m=100, node_selector={"zone": "z2"})]
+    got = assert_wave_equivalent(enc, nodes, [], pending)
+    assert got == ["c"]
+
+
+def test_label_policy_planes_supported():
+    pol = BatchPolicy(label_presence=((("blessed",), True),),
+                      label_prefs=(("fast", True, 2),),
+                      anti_affinity=(("zone", 1),))
+    enc = IncrementalEncoder(pol)
+    nodes = [mk_node("a", labels={"blessed": "1", "zone": "z1"}),
+             mk_node("b", labels={"blessed": "1", "fast": "1", "zone": "z2"}),
+             mk_node("c", labels={"zone": "z1"})]  # not blessed -> filtered
+    svc = api.Service(metadata=api.ObjectMeta(name="s", namespace="default"),
+                      spec=api.ServiceSpec(port=80, selector={"app": "x"}))
+    pending = [mk_pod(f"p{i}", labels={"app": "x"}) for i in range(4)]
+
+    inc = enc.encode(nodes, [], pending, [svc])
+    chosen_inc, _ = solve(inc)
+    got = decisions_to_names(inc, chosen_inc)
+    full = encode_snapshot(nodes, [], pending, [svc], policy=pol)
+    chosen_full, _ = solve(full)
+    assert got == decisions_to_names(full, chosen_full)
+    assert "c" not in got
+
+
+def test_existing_pod_counts_in_every_matching_group():
+    """An existing pod whose labels satisfy several services' selectors is
+    a spreading peer of ALL of them (full encoder's member_exist matrix),
+    not just of its own first service — regression for the incremental
+    single-group counting bug."""
+    enc = IncrementalEncoder()
+    nodes = [mk_node("n0", cpu_m=4000, mem=8 << 30),
+             mk_node("n1", cpu_m=4000, mem=8 << 30)]
+    services = [
+        api.Service(metadata=api.ObjectMeta(name="s0", namespace="default"),
+                    spec=api.ServiceSpec(port=80, selector={"a": "1"})),
+        api.Service(metadata=api.ObjectMeta(name="s1", namespace="default"),
+                    spec=api.ServiceSpec(port=80, selector={"b": "2"})),
+    ]
+    # bound pod matches BOTH selectors; loader pod biases n1's resources
+    both = mk_pod("both", labels={"a": "1", "b": "2"}, host="n0")
+    loader = mk_pod("load", cpu_m=2000, mem=2 << 30, host="n1")
+    existing = [both, loader]
+    # warm the encoder's resident planes before the decisive wave
+    assert_wave_equivalent(enc, nodes, existing, [mk_pod("warm")], services)
+    # pending pod matches only s1 — 'both' must count as its n0 peer
+    pending = [mk_pod("p", labels={"b": "2"})]
+    assert_wave_equivalent(enc, nodes, existing, pending, services)
+
+
+def test_affinity_policy_rejected():
+    with pytest.raises(ValueError):
+        IncrementalEncoder(BatchPolicy(affinity_labels=("rack",)))
+
+
+def test_compiled_shape_count_bounded_under_churn():
+    """Steady-state churn must re-use compiled programs: track the set of
+    distinct solver input shape signatures across 30 waves of varying size
+    and content; the pow-2 buckets keep it small."""
+    enc = IncrementalEncoder()
+    rng = random.Random(5)
+    nodes = [mk_node(f"n{i}") for i in range(16)]
+    existing = []
+    shapes = set()
+    for wave in range(30):
+        size = rng.randint(3, 9)  # spans the 4-, 8- and 16-pod buckets
+        pending = [mk_pod(f"w{wave}p{i}", cpu_m=rng.choice([50, 100]),
+                          mem=64 << 20,
+                          host_ports=(rng.choice([8080, 9090]),)
+                          if rng.random() < 0.3 else ())
+                   for i in range(size)]
+        snap = enc.encode(nodes, existing, pending)
+        inp = snapshot_to_inputs(snap)
+        shapes.add(tuple((a.shape, str(a.dtype)) for a in inp))
+        chosen, _ = solve(snap)
+        for p, h in zip(pending, decisions_to_names(snap, chosen)):
+            if h:
+                p.status.host = h
+                existing.append(p)
+        while len(existing) > 40:    # deletes churn the planes too
+            existing.pop(rng.randrange(len(existing)))
+    # one shape per touched pow-2 pod bucket (4/8/16); nothing per-wave
+    assert len(shapes) <= 3, f"{len(shapes)} distinct compiled shapes"
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_fuzz_churn_equivalence(seed):
+    rng = random.Random(3000 + seed)
+    zones = ["z1", "z2"]
+    nodes = [mk_node(f"n{i}", cpu_m=rng.choice([1000, 2000]),
+                     mem=rng.choice([2 << 30, 4 << 30]),
+                     labels={"zone": rng.choice(zones)} if rng.random() < 0.6
+                     else {},
+                     extra={"nvidia.com/gpu": 2} if rng.random() < 0.3
+                     else None)
+             for i in range(rng.randint(3, 10))]
+    # overlapping selectors: one pod can satisfy several services
+    sels = [{"app": "a0"}, {"app": "a1"}, {"tier": "web"},
+            {"app": "a0", "tier": "web"}]
+    services = [api.Service(
+        metadata=api.ObjectMeta(name=f"svc{k}", namespace="default"),
+        spec=api.ServiceSpec(port=80, selector=sels[k]))
+        for k in range(rng.randint(0, 4))]
+    enc = IncrementalEncoder()
+    existing = []
+    for wave in range(rng.randint(2, 5)):
+        pending = []
+        for i in range(rng.randint(1, 12)):
+            kw = dict(cpu_m=rng.choice([0, 100, 400]),
+                      mem=rng.choice([0, 64 << 20, 256 << 20]))
+            if rng.random() < 0.4:
+                kw["labels"] = {"app": f"a{rng.randint(0, 2)}"}
+                if rng.random() < 0.5:
+                    kw["labels"]["tier"] = "web"
+            if rng.random() < 0.25:
+                kw["host_ports"] = (rng.choice([8080, 9090, 7070]),)
+            if rng.random() < 0.2:
+                kw["node_selector"] = {"zone": rng.choice(zones)}
+            if rng.random() < 0.15:
+                kw["pds"] = (rng.choice(["pd1", "pd2"]),)
+            if rng.random() < 0.2:
+                kw["extra"] = {"nvidia.com/gpu": 1}
+            if rng.random() < 0.25:
+                kw["group"] = f"g{wave}x{rng.randint(0, 1)}"
+            pending.append(mk_pod(f"w{wave}p{i}", **kw))
+        pending = gang.order_wave(pending)
+        got = assert_wave_equivalent(enc, nodes, existing, pending, services)
+        for p, h in zip(pending, got):
+            if h:
+                p.status.host = h
+                existing.append(p)
+        for _ in range(rng.randint(0, 4)):
+            if existing:
+                existing.pop(rng.randrange(len(existing)))
