@@ -95,6 +95,7 @@ class SolverInputs(NamedTuple):
     uint32 bitmask words."""
 
     cap: jnp.ndarray             # [N, R]
+    advertises: jnp.ndarray      # [N, R] bool — capacity key present
     fit_used: jnp.ndarray        # [N, R]
     fit_exceeded: jnp.ndarray
     score_used: jnp.ndarray      # [N, R]
@@ -198,6 +199,7 @@ def snapshot_to_inputs(snap: ClusterSnapshot) -> SolverInputs:
 
     return SolverInputs(
         cap=jnp.asarray(cap.astype(rdt)),
+        advertises=jnp.asarray(snap.advertised),
         fit_used=jnp.asarray(fit_used.astype(rdt)),
         fit_exceeded=jnp.asarray(snap.fit_exceeded),
         score_used=jnp.asarray(score_used.astype(rdt)),
@@ -262,8 +264,10 @@ def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
     # 2 + however many of these some FEASIBLE node advertises, because the
     # serial path prioritizes over the filtered node list and so derives
     # its resource universe from exactly that subset
-    # (generic_scheduler.go:70-75; priorities.least_requested_priority)
-    adv_extra = (inp.cap != 0) & (jnp.arange(R) >= 2)[None, :]     # [N, R]
+    # (generic_scheduler.go:70-75; priorities.least_requested_priority).
+    # Name presence, not cap != 0: a zero-quantity advertisement still
+    # widens the serial universe (resource_universe iterates keys).
+    adv_extra = inp.advertises & (jnp.arange(R) >= 2)[None, :]     # [N, R]
 
     if pol.all_infeasible:
         # no nonzero-weight priorities: prioritizeNodes emits nothing and

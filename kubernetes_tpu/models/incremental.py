@@ -115,7 +115,6 @@ class IncrementalEncoder:
         self._pds = _Vocab()
         self._ns = _Vocab()
         self._resource_names: List[str] = []
-        self._n_scored = 0
         # resident planes (allocated by _rebuild_nodes)
         self._N = 0
 
@@ -132,12 +131,14 @@ class IncrementalEncoder:
             return True
         key = self._nodes_key
         for i, n in enumerate(nodes):
-            cached_id, cached_fp = key[i]
-            if id(n) == cached_id:
+            cached_obj, cached_fp = key[i]
+            if n is cached_obj:
                 continue  # same object the store handed out before
+                # (the cache holds the reference, so CPython can't reuse
+                # the address for a different node behind our back)
             if self._node_fp(n) != cached_fp:
                 return True
-            key[i] = (id(n), cached_fp)  # relisted but identical
+            key[i] = (n, cached_fp)  # relisted but identical
         return False
 
     def _rebuild_nodes(self, nodes: Sequence[api.Node],
@@ -146,7 +147,7 @@ class IncrementalEncoder:
         """Node set/order/labels/capacity changed: rebuild every resident
         plane (node order defines the tie-break axis, so there is no safe
         partial update on reorder). Sticky vocabularies survive."""
-        self._nodes_key = [(id(n), self._node_fp(n)) for n in nodes]
+        self._nodes_key = [(n, self._node_fp(n)) for n in nodes]
         self._N = N = len(nodes)
         self._node_names = [n.metadata.name for n in nodes]
         self._node_index = {nm: i for i, nm in enumerate(self._node_names)}
@@ -158,15 +159,16 @@ class IncrementalEncoder:
         old = self._resource_names
         extras = [r for r in old if r not in scored]
         self._resource_names = scored + extras
-        self._n_scored = len(scored)
         self._rix = {name: r for r, name in enumerate(self._resource_names)}
         R = len(self._resource_names)
         self._cap = np.zeros((N, R), np.int64)
+        self._advertised = np.zeros((N, R), bool)
         for i, n in enumerate(nodes):
             for name, q in (n.spec.capacity or {}).items():
                 r = self._rix.get(name)
                 if r is not None:
                     self._cap[i, r] = _preds.resource_value(name, q)
+                    self._advertised[i, r] = True
 
         self._score_used = np.zeros((N, R), np.int64)
         self._port_cnt = np.zeros((N, self._ports.cap), np.int32)
@@ -286,6 +288,7 @@ class IncrementalEncoder:
             r = self._rix[name] = len(self._resource_names)
             self._resource_names.append(name)
             self._cap = np.pad(self._cap, ((0, 0), (0, 1)))
+            self._advertised = np.pad(self._advertised, ((0, 0), (0, 1)))
             self._score_used = np.pad(self._score_used, ((0, 0), (0, 1)))
         return r
 
@@ -486,6 +489,9 @@ class IncrementalEncoder:
         if cap.shape[1] < R:
             cap = np.pad(cap, ((0, 0), (0, R - cap.shape[1])))
             self._cap = cap
+        if self._advertised.shape[1] < R:
+            self._advertised = np.pad(
+                self._advertised, ((0, 0), (0, R - self._advertised.shape[1])))
         score_used = self._score_used
         if score_used.shape[1] < R:
             score_used = np.pad(score_used, ((0, 0), (0, R - score_used.shape[1])))
@@ -519,8 +525,8 @@ class IncrementalEncoder:
         return ClusterSnapshot(
             node_names=self._node_names,
             resource_names=list(self._resource_names),
-            n_scored=self._n_scored,
-            cap=cap, fit_used=fit_used, fit_exceeded=fit_exceeded,
+            cap=cap, advertised=self._advertised,
+            fit_used=fit_used, fit_exceeded=fit_exceeded,
             score_used=score_used,
             node_ports=self._port_cnt > 0,
             node_sel=self._node_sel,

@@ -129,11 +129,14 @@ class ClusterSnapshot:
     node_names: List[str]
     # R-dimensional resource planes (int64: memory bytes exceed int32).
     # resource_names[0:2] is always [cpu, memory] (reference parity), then
-    # node-advertised extras (the scored universe, n_scored total), then
-    # request-only dims (constrain but never score).
+    # node-advertised extras, then request-only dims (constrain but never
+    # score). ``advertised`` records capacity-key PRESENCE per node — a
+    # zero-quantity advertisement still widens the serial LeastRequested
+    # universe (resource_universe iterates names), so the solver's per-pod
+    # divisor must see it even though cap == 0.
     resource_names: List[str]
-    n_scored: int
     cap: np.ndarray              # [N, R] i64 (cpu col in milli-units)
+    advertised: np.ndarray       # [N, R] bool — capacity key present
     fit_used: np.ndarray         # [N, R] i64 greedy-fitting usage (Filter)
     fit_exceeded: np.ndarray     # [N] bool — an existing pod already didn't fit
     score_used: np.ndarray       # [N, R] i64 all-pods usage (Score)
@@ -204,7 +207,7 @@ def encode_snapshot(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
     # path (scheduler.predicates.resource_universe / resource_value): the
     # scored dims (cpu, memory, node-advertised extras) come first; dims
     # only requested by pods are appended — they constrain (dim_fits) but
-    # score zero everywhere, so LeastRequested divides by n_scored only.
+    # score zero everywhere and never widen the LeastRequested divisor.
     scored = _preds.resource_universe(nodes)
     seen = set(scored)
     request_only: List[str] = []
@@ -215,15 +218,16 @@ def encode_snapshot(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
                     seen.add(name)
                     request_only.append(name)
     resource_names = scored + sorted(request_only)
-    n_scored = len(scored)
     R = len(resource_names)
     rindex = {name: r for r, name in enumerate(resource_names)}
     cap = np.zeros((N, R), np.int64)
+    advertised = np.zeros((N, R), bool)
     for i, n in enumerate(nodes):
         for name, q in (n.spec.capacity or {}).items():
             r = rindex.get(name)
             if r is not None:
                 cap[i, r] = _preds.resource_value(name, q)
+                advertised[i, r] = True
 
     # -- service selector vocabulary (needed by the pod passes) -------------
     services = list(services)
@@ -490,8 +494,9 @@ def encode_snapshot(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
 
     return ClusterSnapshot(
         node_names=[n.metadata.name for n in nodes],
-        resource_names=resource_names, n_scored=n_scored,
-        cap=cap, fit_used=fit_used, fit_exceeded=fit_exceeded,
+        resource_names=resource_names,
+        cap=cap, advertised=advertised,
+        fit_used=fit_used, fit_exceeded=fit_exceeded,
         score_used=score_used,
         node_ports=node_ports, node_sel=node_sel, node_pds=node_pds,
         node_extra_ok=extra_ok,

@@ -58,7 +58,9 @@ def pad_inputs_for_mesh(inp: SolverInputs, mesh: Mesh) -> Tuple[SolverInputs, in
         return jnp.pad(x, widths, constant_values=fill)
 
     return SolverInputs(
-        cap=pad_n(inp.cap), fit_used=pad_n(inp.fit_used),
+        cap=pad_n(inp.cap),
+        advertises=pad_n(inp.advertises, fill=False),
+        fit_used=pad_n(inp.fit_used),
         fit_exceeded=pad_n(inp.fit_exceeded, fill=True),
         score_used=pad_n(inp.score_used),
         node_ports=pad_n(inp.node_ports), node_sel=pad_n(inp.node_sel),
@@ -89,7 +91,7 @@ def _input_shardings(mesh: Mesh) -> SolverInputs:
     node2d = s("nodes", None)
     rep = s()
     return SolverInputs(
-        cap=node2d, fit_used=node2d, fit_exceeded=node,
+        cap=node2d, advertises=node2d, fit_used=node2d, fit_exceeded=node,
         score_used=node2d,
         node_ports=node2d, node_sel=node2d, node_pds=node2d,
         node_extra_ok=node,

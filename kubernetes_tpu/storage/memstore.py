@@ -25,6 +25,8 @@ reference has: only the apiserver talks to etcd.
 
 from __future__ import annotations
 
+import bisect
+import heapq
 import threading
 import time
 from dataclasses import dataclass, field
@@ -90,6 +92,14 @@ class MemStore:
     def __init__(self, clock: Callable[[], float] = time.monotonic):
         self._lock = threading.Condition()
         self._data: Dict[str, KV] = {}
+        # sorted key index: list(prefix) is a bisect range scan instead of
+        # an O(cluster) sort+filter — at 50k pods a per-create admission
+        # LIST otherwise dominates the apiserver's create path
+        self._keys: List[str] = []
+        # expiry heap: only TTL'd keys are swept, so the common no-TTL op
+        # costs O(1) instead of a full-store scan (entries may be stale
+        # after rewrites; validated against the live KV when popped)
+        self._ttl_heap: List[Tuple[float, str]] = []
         self._index = 0
         self._history: List[StoreEvent] = []
         self._clock = clock
@@ -110,12 +120,26 @@ class MemStore:
     def _expired(self, kv: KV) -> bool:
         return kv.expiration is not None and self._clock() >= kv.expiration
 
+    def _insert_key_locked(self, key: str) -> None:
+        if key not in self._data:
+            bisect.insort(self._keys, key)
+
+    def _remove_key_locked(self, key: str) -> None:
+        i = bisect.bisect_left(self._keys, key)
+        if i < len(self._keys) and self._keys[i] == key:
+            del self._keys[i]
+
     def _sweep_locked(self) -> None:
+        if not self._ttl_heap:
+            return
         now = self._clock()
-        dead = [k for k, kv in self._data.items()
-                if kv.expiration is not None and now >= kv.expiration]
-        for k in dead:
-            kv = self._data.pop(k)
+        while self._ttl_heap and self._ttl_heap[0][0] <= now:
+            _, k = heapq.heappop(self._ttl_heap)
+            kv = self._data.get(k)
+            if kv is None or kv.expiration is None or kv.expiration > now:
+                continue  # rewritten since this heap entry; still alive
+            self._remove_key_locked(k)
+            del self._data[k]
             self._index += 1
             self._record_locked(StoreEvent("expire", k, self._index, None, kv))
 
@@ -152,7 +176,12 @@ class MemStore:
             self._sweep_locked()
             if prefix and not prefix.endswith("/"):
                 prefix = prefix + "/"
-            out = [kv for k, kv in sorted(self._data.items()) if k.startswith(prefix)]
+            i = bisect.bisect_left(self._keys, prefix)
+            out = []
+            keys = self._keys
+            while i < len(keys) and keys[i].startswith(prefix):
+                out.append(self._data[keys[i]])
+                i += 1
             return out, self._index
 
     # -- writes ------------------------------------------------------------
@@ -165,7 +194,10 @@ class MemStore:
             self._index += 1
             kv = KV(key, value, self._index, self._index,
                     self._clock() + ttl if ttl else None)
+            self._insert_key_locked(key)
             self._data[key] = kv
+            if kv.expiration is not None:
+                heapq.heappush(self._ttl_heap, (kv.expiration, key))
             self._record_locked(StoreEvent("create", key, self._index, kv, None))
             return kv
 
@@ -178,7 +210,10 @@ class MemStore:
             self._index += 1
             kv = KV(key, value, prev.created_index if prev else self._index,
                     self._index, self._clock() + ttl if ttl else None)
+            self._insert_key_locked(key)
             self._data[key] = kv
+            if kv.expiration is not None:
+                heapq.heappush(self._ttl_heap, (kv.expiration, key))
             self._record_locked(
                 StoreEvent("set" if prev else "create", key, self._index, kv, prev))
             return kv
@@ -200,6 +235,8 @@ class MemStore:
             kv = KV(key, value, prev.created_index, self._index,
                     self._clock() + ttl if ttl else None)
             self._data[key] = kv
+            if kv.expiration is not None:
+                heapq.heappush(self._ttl_heap, (kv.expiration, key))
             self._record_locked(StoreEvent("compareAndSwap", key, self._index, kv, prev))
             return kv
 
@@ -214,6 +251,7 @@ class MemStore:
                 raise ErrCASConflict(
                     f"{key}: index mismatch (have {prev.modified_index}, want {prev_index})")
             del self._data[key]
+            self._remove_key_locked(key)
             self._index += 1
             self._record_locked(StoreEvent("delete", key, self._index, None, prev))
             return prev

@@ -269,6 +269,18 @@ def test_request_only_resource_is_unschedulable():
     assert serial[0] is None and serial[1] is None and serial[2] is not None
 
 
+def test_zero_quantity_advertisement_widens_divisor():
+    """A node advertising {'nvidia.com/gpu': 0} (e.g. drained device
+    plugin) still widens the serial LeastRequested universe — the divisor
+    counts advertised NAMES, not nonzero capacities. Regression for the
+    solver deriving adv_extra from cap != 0."""
+    nodes = [mk_node("drained", extra={"nvidia.com/gpu": 0}),
+             mk_node("a"), mk_node("b", cpu_m=2000)]
+    existing = [mk_pod("e0", cpu_m=1000, mem=2 << 30, host="a")]
+    pending = [mk_pod(f"p{i}", cpu_m=500, mem=512 << 20) for i in range(4)]
+    assert_equivalent(nodes, existing, pending)
+
+
 def test_least_requested_divisor_follows_filtered_nodes():
     """The serial path prioritizes over the FILTERED node list, so its
     LeastRequested universe — and divisor — shrinks when the only node
