@@ -291,11 +291,19 @@ def check_equivalence(tag, snap, chosen_np, nodes, existing, pending,
     return rate
 
 
-def run_solver_config(tag, n_nodes, n_pods, gate_nodes, gate_pods,
+def run_solver_config(tag, n_nodes, n_pods, gate_nodes=0, gate_pods=0,
                      policy=None, three_resources=False, gang_groups=0,
-                     gang_size=8, profile=None, full_gate=False):
-    """Benchmark one solver-path config; gate on a slice (or the full wave
-    when full_gate). Returns the result dict or None on gate failure."""
+                     gang_size=8, profile=None, full_gate=False,
+                     gate_budget_s=75.0):
+    """Benchmark one solver-path config. Gate variants: full_gate runs the
+    serial oracle over the whole wave; gate_pods/gate_nodes take a fixed
+    slice; gate_pods=0 with gate_nodes=0 sizes the pod slice to
+    ``gate_budget_s`` of measured serial-oracle time over the FULL node
+    axis (the serial cost scales with node count, so a full 10k x 5k
+    oracle is ~20min — budget-sized slices keep the node-axis effects,
+    where divergence would hide, while fitting the bench watchdog; the
+    complete full-scale run is recorded out-of-band in FULLGATE_r03.json).
+    Returns the result dict or None on gate failure."""
     log(f"[{tag}] building {n_pods} pods x {n_nodes} nodes"
         + (" (3 resources)" if three_resources else "")
         + (f" ({gang_groups} gangs x {gang_size})" if gang_groups else ""))
@@ -314,14 +322,28 @@ def run_solver_config(tag, n_nodes, n_pods, gate_nodes, gate_pods,
         g_snap, g_chosen = snap, chosen_np
         res["gate"] = f"full-oracle-{len(pending)}x{len(nodes)}"
     else:
-        g_nodes = nodes[:gate_nodes]
+        g_nodes = nodes[:gate_nodes] if gate_nodes else nodes
         keep = {n.metadata.name for n in g_nodes}
         g_exist = [p for p in existing if p.status.host in keep]
         if gang_groups:
             per = max(1, gate_pods // gang_size)
             g_pend = pending[: per * gang_size]
-        else:
+        elif gate_pods:
             g_pend = pending[:gate_pods]
+        else:
+            # budget-sized over the full node axis: probe the serial rate,
+            # then take as many pods as gate_budget_s affords
+            from kubernetes_tpu.models.oracle import solve_serial
+            probe = pending[:30]
+            t0 = time.perf_counter()
+            solve_serial(g_nodes, g_exist, probe, services, policy=policy,
+                         gangs=True)
+            rate = len(probe) / max(time.perf_counter() - t0, 1e-9)
+            n_gate = max(200, min(len(pending), int(rate * gate_budget_s)))
+            g_pend = pending[:n_gate]
+            log(f"[{tag}] oracle probe {rate:.1f} pods/s -> gate sized to "
+                f"{n_gate} pods x {len(g_nodes)} nodes "
+                f"(~{gate_budget_s:.0f}s budget)")
         from kubernetes_tpu.models.batch_solver import solve
         from kubernetes_tpu.models.snapshot import encode_snapshot
         g_snap = encode_snapshot(g_nodes, g_exist, g_pend, services,
@@ -428,7 +450,11 @@ def run_churn_config(tag, n_nodes, n_pods, rate_pods_per_s, wave_size=1024):
             while size >= 1:
                 feed(f"warm{round_}x{size}", size)
                 warm += size
-                wait_bound(warm)
+                if not wait_bound(warm):
+                    log(f"[{tag}] CHURN FAILURE: warmup bucket {size} "
+                        f"(round {round_}) did not bind within 120s "
+                        f"({bound_total()}/{warm} bound)")
+                    return None
                 size //= 4
         log(f"[{tag}] warmup: {warm} pods bound across wave buckets; "
             f"starting the clock")
@@ -524,8 +550,17 @@ def child(argv) -> int:
     )
 
     s = args.smoke
-    want = set(args.configs.split(",")) if args.configs != "all" else {
-        "north_star", "basic", "affinity", "binpack3", "gang", "churn"}
+    known = {"north_star", "basic", "affinity", "binpack3", "gang", "churn"}
+    want = set(args.configs.split(",")) if args.configs != "all" else known
+    unknown = want - known
+    if unknown:
+        log(f"[bench-child] unknown --configs: {sorted(unknown)}; "
+            f"known: {sorted(known)}")
+        print(json.dumps({
+            "metric": "pods_scheduled_per_sec", "value": 0.0,
+            "unit": "pods/s", "vs_baseline": 0.0,
+            "error": f"unknown configs: {sorted(unknown)}"}))
+        return 2
     configs = {}
     failed = []
 
@@ -547,13 +582,15 @@ def child(argv) -> int:
         else:
             configs[tag] = r
 
+    # north star: budget-sized oracle gate over the FULL node axis (a
+    # complete 10k x 5k serial oracle is ~20min; FULLGATE_r03.json records
+    # the out-of-band full-scale equivalence run)
     run("north_star", run_solver_config,
         args.nodes or (100 if s else 5_000),
         args.pods or (500 if s else 10_000),
-        gate_nodes=0, gate_pods=0, full_gate=True, profile=args.profile)
+        full_gate=s, profile=args.profile)
     run("basic", run_solver_config,
-        50 if s else 500, 100 if s else 1_000,
-        gate_nodes=0, gate_pods=0, full_gate=True)
+        50 if s else 500, 100 if s else 1_000, full_gate=True)
     run("affinity", run_solver_config,
         100 if s else 5_000, 200 if s else 5_000,
         gate_nodes=100 if s else 600, gate_pods=200 if s else 600,

@@ -27,6 +27,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--cloud-provider", "--cloud_provider", default="")
     p.add_argument("--event-ttl", "--event_ttl", type=float, default=3600.0)
     p.add_argument("--kubelet-port", "--kubelet_port", type=int, default=10250)
+    p.add_argument("--data-dir", "--data_dir", default="",
+                   help="persist cluster state here (WAL + snapshots); "
+                        "empty = in-memory only (the etcd_servers analog: "
+                        "ref cmd/kube-apiserver/app/server.go etcd flags)")
     return p
 
 
@@ -53,7 +57,13 @@ def build_server(opts, ready_event: Optional[threading.Event] = None):
         with open(opts.authorization_policy_file) as f:
             authorizer = ABACAuthorizer.from_text(f.read())
 
+    store = None
+    if getattr(opts, "data_dir", ""):
+        from kubernetes_tpu.storage.durable import DurableStore
+        store = DurableStore(opts.data_dir)
+
     master = Master(MasterConfig(
+        store=store,
         portal_net=opts.portal_net,
         admission_control=tuple(
             x for x in opts.admission_control.split(",") if x),

@@ -1,0 +1,240 @@
+"""DurableStore — WAL + snapshot persistence behind the MemStore contract.
+
+The contract (VERDICT r2 #6): same CAS semantics, same watch window,
+resourceVersions preserved across restart; kill the apiserver and the
+cluster comes back, reflectors resuming from their pre-crash
+resourceVersion. (ref: pkg/tools/etcd_helper.go:311-345 AtomicUpdate,
+etcd_helper_watch.go:47-57 resourceVersion semantics.)
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from kubernetes_tpu import watch as watchpkg
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.quantity import Quantity
+from kubernetes_tpu.storage.durable import DurableStore
+from kubernetes_tpu.storage.memstore import ErrCASConflict, ErrKeyNotFound
+
+
+def reopen(d):
+    """Simulate a crash + restart: a brand-new store on the same dir (the
+    old instance is simply abandoned, as SIGKILL would)."""
+    return DurableStore(str(d))
+
+
+def test_state_and_index_survive_restart(tmp_path):
+    s = DurableStore(str(tmp_path))
+    kv1 = s.create("/registry/pods/default/a", "A")
+    s.create("/registry/pods/default/b", "B")
+    s.set("/registry/pods/default/a", "A2")
+    s.delete("/registry/pods/default/b")
+    idx = s.index
+
+    r = reopen(tmp_path)
+    assert r.index == idx
+    got = r.get("/registry/pods/default/a")
+    assert got.value == "A2"
+    assert got.created_index == kv1.created_index  # creation RV preserved
+    with pytest.raises(ErrKeyNotFound):
+        r.get("/registry/pods/default/b")
+    kvs, list_idx = r.list("/registry/pods")
+    assert [k.key for k in kvs] == ["/registry/pods/default/a"]
+    assert list_idx == idx
+
+
+def test_cas_against_precrash_resource_version(tmp_path):
+    s = DurableStore(str(tmp_path))
+    kv = s.create("/k", "v1")
+    r = reopen(tmp_path)
+    # stale CAS fails exactly as before the crash
+    r.set("/k", "v2")
+    with pytest.raises(ErrCASConflict):
+        r.compare_and_swap("/k", "v3", kv.modified_index)
+    # fresh CAS succeeds
+    cur = r.get("/k")
+    out = r.compare_and_swap("/k", "v3", cur.modified_index)
+    assert out.value == "v3"
+
+
+def test_watch_window_survives_restart(tmp_path):
+    """A watcher resuming from a pre-crash index sees every later event,
+    including deletes (whose replay needs the persisted prev state)."""
+    s = DurableStore(str(tmp_path))
+    s.create("/r/x", "1")
+    resume_from = s.index
+    s.set("/r/x", "2")
+    s.create("/r/y", "Y")
+    s.delete("/r/y")
+
+    r = reopen(tmp_path)
+    w = r.watch("/r", from_index=resume_from)
+    evs = []
+    for ev in w:
+        evs.append((ev.object.action, ev.object.key))
+        if len(evs) == 3:
+            w.stop()
+    assert evs == [("set", "/r/x"), ("create", "/r/y"), ("delete", "/r/y")]
+    # the delete replay carries the prior object
+    assert evs[2][0] == "delete"
+
+
+def test_delete_replay_prev_state(tmp_path):
+    s = DurableStore(str(tmp_path))
+    s.create("/r/z", "payload")
+    resume = s.index
+    s.delete("/r/z")
+    r = reopen(tmp_path)
+    w = r.watch("/r", from_index=resume)
+    ev = next(iter(w))
+    w.stop()
+    assert ev.object.action == "delete"
+    assert ev.object.prev_kv is not None and ev.object.prev_kv.value == "payload"
+
+
+def test_compaction_truncates_wal_and_preserves_everything(tmp_path):
+    s = DurableStore(str(tmp_path), compact_every=10)
+    for i in range(25):  # crosses two compactions
+        s.set(f"/r/k{i % 7}", f"v{i}")
+    assert os.path.exists(tmp_path / "snapshot.json")
+    wal_lines = open(tmp_path / "wal.log").read().strip().splitlines()
+    assert len(wal_lines) < 25  # truncated at least once
+    idx = s.index
+    r = reopen(tmp_path)
+    assert r.index == idx
+    for i in range(7):
+        assert r.get(f"/r/k{i}")  # all keys alive
+
+
+def test_wal_compacts_across_restarts(tmp_path):
+    """A server restarting before reaching compact_every must still
+    snapshot eventually: the replayed WAL counts toward the budget, so
+    the WAL cannot grow without bound across restart cycles."""
+    for cycle in range(4):
+        s = DurableStore(str(tmp_path), compact_every=10)
+        for i in range(4):  # always under the threshold per process life
+            s.set(f"/r/c{cycle}i{i}", "v")
+        s._wal_f.close()
+    # 16 mutations over 4 lives with threshold 10: a snapshot must exist
+    # and the live WAL must be shorter than the full history
+    assert os.path.exists(tmp_path / "snapshot.json")
+    wal_lines = open(tmp_path / "wal.log").read().strip().splitlines()
+    assert len(wal_lines) < 16
+    r = reopen(tmp_path)
+    for cycle in range(4):
+        for i in range(4):
+            assert r.get(f"/r/c{cycle}i{i}").value == "v"
+
+
+def test_torn_wal_tail_is_ignored(tmp_path):
+    s = DurableStore(str(tmp_path))
+    s.create("/a", "1")
+    s.create("/b", "2")
+    with open(tmp_path / "wal.log", "a") as f:
+        f.write('{"a": "create", "k": "/c", "i"')  # torn mid-crash write
+    r = reopen(tmp_path)
+    assert r.get("/a").value == "1"
+    assert r.get("/b").value == "2"
+    with pytest.raises(ErrKeyNotFound):
+        r.get("/c")
+
+
+def test_ttl_rebased_to_wall_clock(tmp_path):
+    s = DurableStore(str(tmp_path))
+    s.set("/ttl/k", "v", ttl=30.0)
+    r = reopen(tmp_path)
+    kv = r.get("/ttl/k")
+    assert kv.expiration is not None
+    remaining = kv.expiration - time.monotonic()
+    assert 25.0 < remaining <= 30.5  # survived with its deadline intact
+
+
+def test_master_cluster_state_survives_restart(tmp_path):
+    """Full stack: objects created through the Master + typed client exist
+    after a restart with their resourceVersions, and a reflector-style
+    watch resumes from the pre-crash RV."""
+    from kubernetes_tpu.apiserver.master import Master, MasterConfig
+    from kubernetes_tpu.client.client import Client, InProcessTransport
+
+    c1 = Client(InProcessTransport(Master(MasterConfig(
+        store=DurableStore(str(tmp_path))))))
+    c1.nodes().create(api.Node(
+        metadata=api.ObjectMeta(name="n1"),
+        spec=api.NodeSpec(capacity={"cpu": Quantity("4")})))
+    pod = c1.pods().create(api.Pod(
+        metadata=api.ObjectMeta(name="p1", namespace="default"),
+        spec=api.PodSpec(containers=[api.Container(name="c", image="i")])))
+    rv = pod.metadata.resource_version
+    pods_rv = c1.pods().list().metadata.resource_version
+
+    # crash + restart
+    c2 = Client(InProcessTransport(Master(MasterConfig(
+        store=DurableStore(str(tmp_path))))))
+    got = c2.pods().get("p1")
+    assert got.metadata.resource_version == rv
+    assert [n.metadata.name for n in c2.nodes().list().items] == ["n1"]
+
+    # reflector resume: watch pods from the pre-crash list RV, then mutate
+    w = c2.pods().watch(resource_version=pods_rv)
+    got.spec.host = "n1"
+    got.status.host = "n1"
+    c2.pods().update(got)
+    ev = next(iter(w))
+    w.stop()
+    assert ev.type == watchpkg.MODIFIED
+    assert ev.object.spec.host == "n1"
+
+
+@pytest.mark.skipif(not hasattr(signal, "SIGKILL"), reason="posix only")
+def test_sigkill_apiserver_and_resume(tmp_path):
+    """The VERDICT contract verbatim: create cluster state over HTTP,
+    SIGKILL the apiserver, restart on the same data dir, state intact."""
+    data_dir = str(tmp_path / "data")
+    script = (
+        "import sys, threading; sys.path.insert(0, %r)\n"
+        "from kubernetes_tpu.cmd.apiserver import apiserver_server\n"
+        "apiserver_server(['--port', '18231', '--data-dir', %r])\n"
+        % (os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+           data_dir))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen([sys.executable, "-c", script], env=env,
+                            stderr=subprocess.PIPE)
+    import urllib.request
+    try:
+        base = "http://127.0.0.1:18231"
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            try:
+                urllib.request.urlopen(base + "/healthz", timeout=1)
+                break
+            except OSError:
+                if proc.poll() is not None:
+                    raise AssertionError(
+                        proc.stderr.read().decode(errors="replace"))
+                time.sleep(0.2)
+        req = urllib.request.Request(
+            base + "/api/v1/namespaces/default/pods",
+            json.dumps({
+                "kind": "Pod", "apiVersion": "v1",
+                "metadata": {"name": "survivor"},
+                "spec": {"containers": [{"name": "c", "image": "img"}]},
+            }).encode(), {"Content-Type": "application/json"})
+        created = json.loads(urllib.request.urlopen(req).read())
+        rv = created["metadata"]["resourceVersion"]
+    finally:
+        proc.kill()          # SIGKILL: no shutdown hooks run
+        proc.wait(timeout=10)
+
+    # restart in-process on the same data dir
+    from kubernetes_tpu.apiserver.master import Master, MasterConfig
+    from kubernetes_tpu.client.client import Client, InProcessTransport
+    client = Client(InProcessTransport(Master(MasterConfig(
+        store=DurableStore(data_dir)))))
+    got = client.pods().get("survivor")
+    assert got.metadata.resource_version == rv
