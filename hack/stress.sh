@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Concurrency stress sweep (the KUBE_RACE analog, ref: hack/test-go.sh:50):
+# runs hack/stress.py under maximal thread-interleaving against both
+# scheduler paths. Usage: hack/stress.sh [seconds-per-run]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+SECONDS_PER_RUN="${1:-20}"
+export JAX_PLATFORMS=cpu
+echo "== stress: serial scheduler (${SECONDS_PER_RUN}s) =="
+python hack/stress.py --seconds "$SECONDS_PER_RUN"
+echo "== stress: tpu-batch scheduler (${SECONDS_PER_RUN}s) =="
+python hack/stress.py --seconds "$SECONDS_PER_RUN" --batch
+echo "stress sweep CLEAN"

@@ -22,6 +22,7 @@ behind identical plumbing.
 from __future__ import annotations
 
 import copy
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
@@ -42,6 +43,9 @@ from kubernetes_tpu.client.cache import (
 from kubernetes_tpu.client.record import EventRecorder
 from kubernetes_tpu.scheduler import plugins as schedplugins
 from kubernetes_tpu.scheduler.generic import GenericScheduler
+from kubernetes_tpu.util import metrics
+
+_log = logging.getLogger("kubernetes_tpu.scheduler")
 
 __all__ = ["Scheduler", "SchedulerConfig", "SimpleModeler", "PodBackoff",
            "ConfigFactory", "filter_schedulable_nodes"]
@@ -139,12 +143,21 @@ class Scheduler:
         self._stop.set()
 
     def _loop(self) -> None:
+        # per-pod failures are evented + requeued inside schedule_one
+        # (c.error); anything escaping to here is an infrastructure fault
+        # that must not spin silently (ref: util.HandleCrash + glog — every
+        # reference loop logs its crashes, scheduler.go:90-119)
+        errs = metrics.default_registry().counter(
+            "scheduler_loop_errors_total",
+            "exceptions escaping the serial scheduling loop")
         while not self._stop.is_set():
             try:
                 self.schedule_one(timeout=0.2)
             except TimeoutError:
                 continue
             except Exception:
+                errs.inc()
+                _log.exception("scheduler loop error (backing off 10ms)")
                 time.sleep(0.01)
 
     def _record(self, pod, reason, fmt, *args):

@@ -19,6 +19,7 @@ error handler requeues it and the next wave re-solves against fresh state.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import List, Optional
@@ -32,8 +33,11 @@ from kubernetes_tpu.models.policy import BatchPolicy, batch_policy_from
 from kubernetes_tpu.models.snapshot import encode_snapshot
 from kubernetes_tpu.scheduler.driver import ConfigFactory, SchedulerConfig
 from kubernetes_tpu.scheduler.generic import FitError
+from kubernetes_tpu.util import metrics
 
 __all__ = ["BatchScheduler"]
+
+_log = logging.getLogger("kubernetes_tpu.scheduler.tpu_batch")
 
 
 class BatchScheduler:
@@ -204,12 +208,20 @@ class BatchScheduler:
         self._stop.set()
 
     def _loop(self) -> None:
+        # per-pod and per-wave failures are evented + requeued inside
+        # schedule_wave; an exception escaping to here is an infrastructure
+        # fault that must not spin silently
+        errs = metrics.default_registry().counter(
+            "scheduler_wave_loop_errors_total",
+            "exceptions escaping the tpu-batch wave loop")
         while not self._stop.is_set():
             try:
                 self.schedule_wave(timeout=0.2)
             except TimeoutError:
                 continue
             except Exception:
+                errs.inc()
+                _log.exception("wave loop error (backing off 10ms)")
                 time.sleep(0.01)
 
     def _record(self, pod, reason, fmt, *args):

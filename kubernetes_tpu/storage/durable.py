@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import heapq
 import json
+import logging
 import os
 import time
 from typing import Callable, Optional
@@ -38,6 +39,16 @@ from typing import Callable, Optional
 from kubernetes_tpu.storage.memstore import KV, MemStore, StoreEvent
 
 __all__ = ["DurableStore"]
+
+_log = logging.getLogger("kubernetes_tpu.storage.durable")
+
+
+def _parses(line: bytes) -> bool:
+    try:
+        json.loads(line)
+        return True
+    except ValueError:
+        return False
 
 _SNAP = "snapshot.json"
 _WAL = "wal.log"
@@ -116,13 +127,7 @@ class DurableStore(MemStore):
     def _compact_locked(self) -> None:
         snap = {
             "index": self._index,
-            "kvs": [
-                {"k": kv.key, "v": kv.value, "c": kv.created_index,
-                 "m": kv.modified_index,
-                 **({"e": self._exp_to_wall(kv.expiration)}
-                    if kv.expiration is not None else {})}
-                for kv in (self._data[k] for k in self._keys)
-            ],
+            "kvs": [self._kv_dict(self._data[k]) for k in self._keys],
             # the watch window survives restart so reflectors can resume
             # from a pre-crash resourceVersion without relisting; prev_kv
             # is persisted too — delete replay delivers the prior object
@@ -197,17 +202,44 @@ class DurableStore(MemStore):
                     self._kv_from_dict(d.get("kv")),
                     self._kv_from_dict(d.get("pv"))))
         wal_path = os.path.join(self._dir, _WAL)
-        if os.path.exists(wal_path):
-            with open(wal_path, encoding="utf-8") as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        d = json.loads(line)
-                    except ValueError:
-                        break  # torn tail write from a crash: stop replay
-                    self._recovered_records += 1
-                    if d["i"] <= self._snap_index_guard:
-                        continue  # pre-snapshot entry (crash mid-compact)
-                    self._apply_entry(d)
+        if not os.path.exists(wal_path):
+            return
+        with open(wal_path, "rb") as f:
+            data = f.read()
+        good_end = 0
+        bad_at = None
+        pos = 0
+        for raw in data.splitlines(keepends=True):
+            line = raw.strip()
+            pos += len(raw)
+            if not line:
+                good_end = pos
+                continue
+            try:
+                d = json.loads(line)
+            except ValueError:
+                bad_at = pos - len(raw)
+                break  # torn/corrupt record: stop replay at the last good one
+            good_end = pos
+            self._recovered_records += 1
+            if d["i"] <= self._snap_index_guard:
+                continue  # pre-snapshot entry (crash mid-compact)
+            self._apply_entry(d)
+        if bad_at is not None:
+            # Truncate to the last good record: reopening in append mode
+            # would otherwise weld the next write onto the torn fragment,
+            # and the NEXT restart would discard that merged line plus
+            # everything after it (silent data loss + index regression).
+            discarded = len(data) - good_end
+            tail = data[good_end:]
+            # a parseable line after the bad one means mid-file corruption,
+            # not a crash-torn tail — surface it loudly either way
+            midfile = any(_parses(l) for l in tail.splitlines()[1:])
+            _log.error(
+                "WAL %s: unparseable record at byte %d; discarding %d "
+                "trailing bytes (%s) and truncating to last good record",
+                wal_path, bad_at, discarded,
+                "MID-FILE CORRUPTION — parseable records were lost"
+                if midfile else "torn tail from a crash")
+            with open(wal_path, "r+b") as f:
+                f.truncate(good_end)

@@ -145,6 +145,23 @@ def test_torn_wal_tail_is_ignored(tmp_path):
         r.get("/c")
 
 
+def test_writes_after_torn_tail_survive_second_restart(tmp_path):
+    """Regression: the torn fragment must be truncated on recovery —
+    appending onto it would weld the next record into one unparseable
+    line, and the restart after THAT would silently drop every
+    post-first-crash write and regress the index."""
+    s = DurableStore(str(tmp_path))
+    s.create("/a", "1")
+    with open(tmp_path / "wal.log", "a") as f:
+        f.write('{"a": "create", "k": "/torn", "i"')  # crash mid-write
+    r1 = reopen(tmp_path)
+    r1.create("/after-crash", "2")   # written onto a now-clean WAL
+    idx = r1.index
+    r2 = reopen(tmp_path)
+    assert r2.get("/after-crash").value == "2"
+    assert r2.index == idx           # no index regression
+
+
 def test_ttl_rebased_to_wall_clock(tmp_path):
     s = DurableStore(str(tmp_path))
     s.set("/ttl/k", "v", ttl=30.0)

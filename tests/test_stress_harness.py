@@ -1,0 +1,23 @@
+"""Smoke the concurrency stress harness (hack/stress.py — the KUBE_RACE
+analog, ref: hack/test-go.sh:50). Full sweeps run via hack/stress.sh; CI
+keeps one short run per scheduler path green."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.parametrize("mode", ["serial", "batch"])
+def test_stress_harness_converges(mode):
+    cmd = [sys.executable, os.path.join(ROOT, "hack", "stress.py"),
+           "--seconds", "5", "--writers", "3"]
+    if mode == "batch":
+        cmd.append("--batch")
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=120,
+                       env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0, f"stress {mode} failed:\n{r.stdout}\n{r.stderr}"
+    assert "CLEAN" in r.stdout
