@@ -1,27 +1,32 @@
-"""Default scheme wiring — registered versions and conversions.
+"""Default scheme wiring — registered versions, conversions, defaults.
 
 ref: pkg/api/latest/latest.go — declares the supported external versions
-("v1" current, "v1beta1" legacy) and registers every kind plus conversion
-functions. The v1beta1 conversions exercise the same seam the reference uses
-for its hand-written v1beta1/v1beta2 conversions
-(ref: pkg/api/v1beta1/conversion.go): metadata fields are flattened to the
-top level and ``name`` is spelled ``id``.
+("v1" current, "v1beta1"/"v1beta2" legacy) and registers every kind plus
+conversion functions, defaulting, kind aliases, and field-label
+conversions. The legacy wire format lives in kubernetes_tpu.api.v1beta1:
+a genuinely restructured sibling (flat metadata with ``id``,
+desiredState/currentState envelopes, manifest-nested pod specs,
+one-of-object restart policies, "Minion", "podID", "ip:port" endpoints)
+exercising the same seam the reference used for its hand-written
+v1beta1/v1beta2 conversions (ref: pkg/api/v1beta1/conversion.go).
+v1beta2 shares v1beta1's wire shape — in the reference the two differ
+only in minor defaulting (ref: pkg/api/v1beta2/ is generated from
+v1beta1 with small deltas); v1beta3 introduced the nested metadata that
+became v1, which is our "v1" here.
 """
 
 from __future__ import annotations
 
 from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api import v1beta1
 from kubernetes_tpu.runtime.scheme import Scheme
 
 __all__ = ["scheme", "VERSIONS", "LATEST_VERSION", "new_scheme"]
 
 LATEST_VERSION = "v1"
 OLDEST_VERSION = "v1beta1"
-# v1beta2 shares v1beta1's flattened-metadata wire shape — in the reference
-# the two differ only in minor defaulting (ref: pkg/api/v1beta2/ is
-# generated from v1beta1 with small deltas); v1beta3 introduced the nested
-# metadata that became v1, which is our "v1" here.
 VERSIONS = ("v1", "v1beta1", "v1beta2")
+_LEGACY = ("v1beta1", "v1beta2")
 
 _ALL_KINDS = (
     api.Pod, api.PodList,
@@ -39,59 +44,26 @@ _ALL_KINDS = (
     api.DeleteOptions,
 )
 
-# Metadata fields flattened to top level in v1beta1 (name is spelled "id").
-_META_FLAT = (
-    ("name", "id"),
-    ("namespace", "namespace"),
-    ("uid", "uid"),
-    ("resourceVersion", "resourceVersion"),
-    ("creationTimestamp", "creationTimestamp"),
-    ("deletionTimestamp", "deletionTimestamp"),
-    ("selfLink", "selfLink"),
-    ("labels", "labels"),
-    ("annotations", "annotations"),
-    ("generateName", "generateName"),
-)
-
-
-def _v1beta1_encode(wire: dict) -> dict:
-    """internal wire -> v1beta1 wire: flatten metadata (ref: v1beta1/conversion.go)."""
-    wire = dict(wire)
-    meta = wire.pop("metadata", None)
-    if isinstance(meta, dict):
-        for internal_name, beta_name in _META_FLAT:
-            if internal_name in meta:
-                wire[beta_name] = meta[internal_name]
-    items = wire.get("items")
-    if isinstance(items, list):
-        wire["items"] = [_v1beta1_encode(i) if isinstance(i, dict) else i for i in items]
-    return wire
-
-
-def _v1beta1_decode(wire: dict) -> dict:
-    """v1beta1 wire -> internal wire: nest metadata back."""
-    wire = dict(wire)
-    meta = {}
-    for internal_name, beta_name in _META_FLAT:
-        if beta_name in wire:
-            meta[internal_name] = wire.pop(beta_name)
-    if meta:
-        wire["metadata"] = meta
-    items = wire.get("items")
-    if isinstance(items, list):
-        wire["items"] = [_v1beta1_decode(i) if isinstance(i, dict) else i for i in items]
-    return wire
-
 
 def new_scheme() -> Scheme:
     s = Scheme(default_version=LATEST_VERSION)
-    s.add_known_types("v1", *_ALL_KINDS)
-    s.add_known_types("v1beta1", *_ALL_KINDS)
-    s.add_known_types("v1beta2", *_ALL_KINDS)
+    for v in VERSIONS:
+        s.add_known_types(v, *_ALL_KINDS)
     for t in _ALL_KINDS:
         kind = getattr(t, "kind", t.__name__) or t.__name__
-        s.add_conversion("v1beta1", kind, _v1beta1_encode, _v1beta1_decode)
-        s.add_conversion("v1beta2", kind, _v1beta1_encode, _v1beta1_decode)
+        for v in _LEGACY:
+            s.add_conversion(v, kind, v1beta1.encode_for(kind),
+                             v1beta1.decode_for(kind))
+    for v in _LEGACY:
+        for wire_kind, kind in v1beta1.KIND_ALIASES.items():
+            s.add_kind_alias(v, wire_kind, kind)
+        for kind, fn in v1beta1.DEFAULTERS.items():
+            s.add_defaulter(v, kind, fn)
+        for kind, fn in v1beta1.FIELD_LABELS.items():
+            s.add_field_label_conversion(v, kind, fn)
+    # v1 applies the same era defaults on decode (ref: v1beta3/defaults.go)
+    for kind, fn in v1beta1.DEFAULTERS.items():
+        s.add_defaulter("v1", kind, fn)
     return s
 
 

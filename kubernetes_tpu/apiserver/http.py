@@ -43,6 +43,30 @@ from kubernetes_tpu.util import metrics as metrics_pkg
 __all__ = ["APIServer"]
 
 
+def _convert_field_selector(apisrv, version: str, resource: str,
+                            sel: str) -> str:
+    """Rewrite a field selector from the request version's label vocabulary
+    to the internal one (ref: pkg/api/v1beta1/conversion.go field-label
+    conversion funcs; registered per kind in api/latest.py)."""
+    from kubernetes_tpu.api.fields import FieldSelector, parse_field_selector
+
+    try:
+        _, registry = apisrv.master._registry(resource)
+        obj_type = registry.obj_type
+        kind = getattr(obj_type, "kind", "") or obj_type.__name__
+    except Exception:
+        return sel
+    try:
+        fs = parse_field_selector(sel)
+    except ValueError:
+        return sel  # the registry layer surfaces the parse error uniformly
+    out = []
+    for f, op, v in fs.requirements:
+        nf, nv = apisrv.scheme.convert_field_label(version, kind, f, v)
+        out.append((nf, op, nv))
+    return str(FieldSelector(out))
+
+
 def _merge_patch(target: Any, patch: Any) -> Any:
     """RFC 7386 JSON merge patch (ref: resthandler.go:205 PatchResource)."""
     if not isinstance(patch, dict):
@@ -250,6 +274,12 @@ class _Handler(BaseHTTPRequestHandler):
         label_sel = query.get("labelSelector", query.get("labels", ""))
         field_sel = query.get("fieldSelector", query.get("fields", ""))
         rv = query.get("resourceVersion", "")
+        if field_sel:
+            # field labels are a per-version vocabulary (v1beta1
+            # "DesiredState.Host" == internal "spec.host"; ref:
+            # pkg/api/v1beta1/conversion.go field-label funcs)
+            field_sel = _convert_field_selector(apisrv, version, resource,
+                                                field_sel)
 
         if watching:
             if method != "GET":

@@ -37,6 +37,16 @@ class Scheme:
         self._transforms: Dict[Tuple[str, str], Tuple[WireTransform, WireTransform]] = {}
         # kind -> internal type (shared across versions)
         self._internal: Dict[str, Type] = {}
+        # (version, wire kind) -> internal kind and back (e.g. v1beta1
+        # "Minion" <-> Node, ref: pkg/api/v1beta1/register.go)
+        self._kind_aliases: Dict[Tuple[str, str], str] = {}
+        self._kind_alias_out: Dict[Tuple[str, str], str] = {}
+        # (version, kind) -> defaulter(obj), applied on decode
+        # (ref: pkg/api/v1beta1/defaults.go addDefaultingFuncs)
+        self._defaulters: Dict[Tuple[str, str], Callable[[Any], None]] = {}
+        # (version, kind) -> fn(label, value) -> (internal label, value)
+        # (ref: pkg/api/v1beta1/conversion.go field-label funcs)
+        self._field_labels: Dict[Tuple[str, str], Callable] = {}
 
     # -- registration -------------------------------------------------------
     def add_known_types(self, version: str, *types_: Type) -> None:
@@ -54,6 +64,29 @@ class Scheme:
         """Register wire transforms for a (version, kind) pair
         (ref: conversion.Scheme.AddConversionFuncs)."""
         self._transforms[(version, kind)] = (encode, decode)
+
+    def add_kind_alias(self, version: str, wire_kind: str, kind: str) -> None:
+        """A version may spell a kind differently on the wire."""
+        self._kind_aliases[(version, wire_kind)] = kind
+        self._kind_alias_out[(version, kind)] = wire_kind
+
+    def add_defaulter(self, version: str, kind: str,
+                      fn: Callable[[Any], None]) -> None:
+        """Defaulting pass applied to objects decoded from this version."""
+        self._defaulters[(version, kind)] = fn
+
+    def add_field_label_conversion(self, version: str, kind: str,
+                                   fn: Callable) -> None:
+        """fn(label, value) -> (internal label, value) for field selectors
+        expressed in this version's vocabulary."""
+        self._field_labels[(version, kind)] = fn
+
+    def convert_field_label(self, version: str, kind: str,
+                            label: str, value: str):
+        fn = self._field_labels.get((version, kind))
+        if fn is None:
+            return label, value
+        return fn(label, value)
 
     def versions(self):
         return sorted(self._types)
@@ -88,7 +121,7 @@ class Scheme:
         enc, _ = self._transforms.get((version, kind), (None, None))
         if enc is not None:
             wire = enc(wire)
-        wire["kind"] = kind
+        wire["kind"] = self._kind_alias_out.get((version, kind), kind)
         wire["apiVersion"] = version
         return wire
 
@@ -105,11 +138,15 @@ class Scheme:
         version = wire.pop("apiVersion", "") or default_version or self.default_version
         if not kind:
             raise ValueError("unable to decode: 'kind' is not set")
+        kind = self._kind_aliases.get((version, kind), kind)
         t = self.type_for(version, kind)
         _, dec = self._transforms.get((version, kind), (None, None))
         if dec is not None:
             wire = dec(wire)
         obj = from_wire(t, wire)
+        defaulter = self._defaulters.get((version, kind))
+        if defaulter is not None:
+            defaulter(obj)
         return obj
 
     def decode(self, data, default_kind: str = "", default_version: str = "") -> Any:
