@@ -29,7 +29,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kubernetes_tpu.models.batch_solver import SolverInputs, solve_jit
 
-__all__ = ["make_mesh", "pad_inputs_for_mesh", "solve_sharded"]
+__all__ = ["make_mesh", "pad_inputs_for_mesh", "solve_sharded",
+           "shard_memory_report"]
 
 
 def make_mesh(devices=None, pods_axis: int = 1) -> Mesh:
@@ -110,6 +111,45 @@ def _input_shardings(mesh: Mesh) -> SolverInputs:
         zone_labeled=s(None, "nodes"),
         zone_onehot=s(None, "nodes", None),
     )
+
+
+def shard_memory_report(inp: SolverInputs, mesh: Mesh) -> dict:
+    """Bytes per device for one wave under the mesh's shardings: the
+    (padded, as actually allocated) inputs plus the scan carry, which
+    duplicates the mutable planes on-device. The multi-chip dryrun logs
+    this for the 5k-node planes so HBM headroom is visible without TPU
+    hardware."""
+    padded, _ = pad_inputs_for_mesh(inp, mesh)
+    shardings = _input_shardings(mesh)
+    shards = mesh.shape["nodes"]
+
+    def nbytes(a) -> int:
+        return int(np.prod(a.shape)) * a.dtype.itemsize
+
+    per_device = 0
+    replicated = 0
+    for arr, sh in zip(padded, shardings):
+        b = nbytes(arr)
+        if "nodes" in sh.spec:
+            per_device += b // shards  # padded: node axis divides evenly
+        else:
+            replicated += b
+    # the lax.scan carry holds live copies of the mutable planes
+    # (kubernetes_tpu.models.batch_solver solve_jit Carry); same layout
+    carry_sharded = sum(nbytes(a) for a in (
+        padded.fit_used, padded.score_used, padded.node_ports,
+        padded.node_pds)) // shards
+    carry_replicated = sum(nbytes(a) for a in (
+        padded.group_counts, padded.anchor_vals0, padded.has_anchor0))
+    return {
+        "devices": int(np.prod(list(mesh.shape.values()))),
+        "node_shards": shards,
+        "sharded_bytes_per_device": per_device,
+        "replicated_bytes_per_device": replicated,
+        "carry_bytes_per_device": carry_sharded + carry_replicated,
+        "total_bytes_per_device": (per_device + replicated
+                                   + carry_sharded + carry_replicated),
+    }
 
 
 def solve_sharded(inp: SolverInputs, mesh: Optional[Mesh] = None,
