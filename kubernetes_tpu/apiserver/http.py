@@ -238,6 +238,8 @@ class _Handler(BaseHTTPRequestHandler):
             payload, ok = apisrv.validate_components()
             self._send_json(200 if ok else 500, json.dumps(payload))
             return 200 if ok else 500
+        if head == "debug" and len(parts) >= 2 and parts[1] == "pprof":
+            return self._handle_pprof(parts[2:], query)
         if head != "api":
             raise errors.new_not_found("path", "/" + "/".join(parts))
         if len(parts) == 1:
@@ -359,7 +361,23 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(b"\r\n")
         self.wfile.flush()
 
+    def _handle_pprof(self, rest, query) -> int:
+        """ref: pprof endpoints every reference binary exposes
+        (pkg/master/master.go:431-435)."""
+        from kubernetes_tpu.util import pprof
+
+        which = rest[0] if rest else ""
+        body = pprof.handle(which, query.get("seconds", ""))
+        if body is None:
+            raise errors.new_not_found("pprof", which)
+        self._send_text(200, body)
+        return 200
+
     def _stream_watch(self, watcher: watchpkg.Watcher, version: str):
+        from kubernetes_tpu.util import websocket as ws
+
+        if ws.wants_websocket(self.headers):
+            return self._stream_watch_websocket(watcher, version)
         apisrv = self.server.api  # type: ignore[attr-defined]
         apisrv.track_watcher(watcher)
         self.send_response(200)
@@ -383,6 +401,65 @@ class _Handler(BaseHTTPRequestHandler):
             watcher.stop()
             apisrv.untrack_watcher(watcher)
             self.close_connection = True
+
+    def _stream_watch_websocket(self, watcher: watchpkg.Watcher,
+                                version: str):
+        """Watch events as WebSocket text frames, one event per message
+        (ref: pkg/apiserver/watch.go:62-126 — the websocket variant the
+        reference serves alongside chunked JSON, negotiated by Upgrade)."""
+        from kubernetes_tpu.util import websocket as ws
+
+        apisrv = self.server.api  # type: ignore[attr-defined]
+        apisrv.track_watcher(watcher)
+        self.send_response_only(101, "Switching Protocols")
+        self.send_header("Upgrade", "websocket")
+        self.send_header("Connection", "Upgrade")
+        self.send_header("Sec-WebSocket-Accept", ws.accept_key(
+            self.headers.get("Sec-WebSocket-Key", "")))
+        self.end_headers()
+
+        # one writer lock: PONGs from the reader thread and event frames
+        # from this thread interleave bytes otherwise (sendall is not
+        # atomic once the TCP send buffer fills)
+        wlock = threading.Lock()
+
+        # client frames: PING -> PONG, CLOSE (or EOF) -> stop the watcher
+        def reader():
+            try:
+                while True:
+                    frame = ws.read_frame(self.rfile)
+                    if frame is None or frame[0] == ws.OP_CLOSE:
+                        break
+                    if frame[0] == ws.OP_PING:
+                        with wlock:
+                            ws.send_pong(self.wfile, frame[1])
+            except OSError:
+                pass
+            finally:
+                watcher.stop()
+
+        threading.Thread(target=reader, daemon=True,
+                         name="ws-watch-reader").start()
+        try:
+            for ev in watcher:
+                try:
+                    obj_wire = json.loads(
+                        apisrv.scheme.encode(ev.object, version))
+                except Exception:
+                    obj_wire = {"kind": "Status", "status": "Failure",
+                                "message": "encode error"}
+                frame = json.dumps({"type": ev.type, "object": obj_wire})
+                with wlock:
+                    ws.send_text(self.wfile, frame.encode("utf-8"))
+            with wlock:
+                ws.send_close(self.wfile)
+        except (BrokenPipeError, ConnectionResetError, socket.timeout):
+            pass
+        finally:
+            watcher.stop()
+            apisrv.untrack_watcher(watcher)
+            self.close_connection = True
+        return 101
 
     # ----- proxy / redirect (ref: pkg/apiserver/{proxy,redirect}.go) -----
 

@@ -116,6 +116,13 @@ class _Handler(BaseHTTPRequestHandler):
         if head == "metrics":
             return self._send(200, ks.metrics.render_text().encode(),
                               "text/plain; version=0.0.4")
+        if head == "debug" and len(parts) >= 2 and parts[1] == "pprof":
+            # ref: every reference binary mounts pprof (master.go:431-435)
+            from kubernetes_tpu.util import pprof
+            body = pprof.handle(parts[2] if len(parts) > 2 else "",
+                                query.get("seconds", ""))
+            if body is not None:
+                return self._send_text(200, body)
         self._send_text(404, f"unknown path /{'/'.join(parts)}\n")
 
     # -- endpoints ---------------------------------------------------------

@@ -1,0 +1,126 @@
+"""/debug/pprof — per-binary profiling endpoints.
+
+ref: pkg/master/master.go:431-435 and plugin/cmd/kube-scheduler/app/
+server.go:82-90 expose Go's net/http/pprof on every binary. The Python
+analogs served here:
+
+- ``/debug/pprof/``         index
+- ``/debug/pprof/goroutine`` (alias ``stack``): every live thread's stack
+- ``/debug/pprof/profile?seconds=N``: statistical CPU profile — samples
+  all threads' frames via sys._current_frames() at ~100Hz for N seconds
+  and renders a flat self+cumulative report (the text form of a pprof
+  CPU profile)
+- ``/debug/pprof/heap``: tracemalloc top allocation sites (tracing starts
+  on first request, so the first snapshot is a baseline)
+
+All return plain text; wired into the apiserver and kubelet HTTP servers.
+"""
+
+from __future__ import annotations
+
+import collections
+import sys
+import threading
+import time
+import traceback
+import tracemalloc
+from typing import Dict, Tuple
+
+__all__ = ["dump_stacks", "cpu_profile", "heap_profile", "index", "handle"]
+
+
+def handle(which: str, seconds_arg: str = "") -> "str | None":
+    """Shared endpoint dispatch for every binary's /debug/pprof mount.
+    Returns the response text, or None for an unknown endpoint."""
+    if which in ("", "index"):
+        return index()
+    if which in ("goroutine", "stack"):
+        return dump_stacks()
+    if which == "profile":
+        try:
+            seconds = float(seconds_arg or "5")
+        except ValueError:
+            seconds = 5.0
+        return cpu_profile(seconds)
+    if which == "heap":
+        return heap_profile()
+    return None
+
+
+def index() -> str:
+    return ("/debug/pprof/\n"
+            "  goroutine  — live thread stacks\n"
+            "  profile    — CPU profile (?seconds=N, default 5)\n"
+            "  heap       — top allocation sites (tracemalloc)\n")
+
+
+def dump_stacks() -> str:
+    """Every live thread's stack (the goroutine-dump analog)."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for ident, frame in sys._current_frames().items():
+        out.append(f"thread {names.get(ident, '?')} ({ident}):")
+        out.extend(l.rstrip("\n")
+                   for l in traceback.format_stack(frame))
+        out.append("")
+    return "\n".join(out)
+
+
+def cpu_profile(seconds: float = 5.0, hz: int = 100) -> str:
+    """Statistical whole-process CPU profile: sample every thread's stack
+    for ``seconds`` and report where time is spent. Self = frames on top,
+    cumulative = frames anywhere on a sampled stack."""
+    seconds = max(0.1, min(seconds, 60.0))
+    interval = 1.0 / hz
+    me = threading.get_ident()
+    self_counts: Dict[Tuple[str, int, str], int] = collections.Counter()
+    cum_counts: Dict[Tuple[str, int, str], int] = collections.Counter()
+    samples = 0
+    deadline = time.monotonic() + seconds
+    while time.monotonic() < deadline:
+        for ident, frame in sys._current_frames().items():
+            if ident == me:
+                continue
+            samples += 1
+            seen = set()
+            top = True
+            f = frame
+            while f is not None:
+                key = (f.f_code.co_filename, f.f_lineno, f.f_code.co_name)
+                if top:
+                    self_counts[key] += 1
+                    top = False
+                if key not in seen:
+                    cum_counts[key] += 1
+                    seen.add(key)
+                f = f.f_back
+        time.sleep(interval)
+    lines = [f"cpu profile: {samples} samples over {seconds:.1f}s "
+             f"({hz}Hz, all threads except profiler)",
+             f"{'self':>6} {'cum':>6}  location"]
+    ranked = sorted(cum_counts, key=lambda k: (-self_counts[k],
+                                               -cum_counts[k]))
+    for key in ranked[:40]:
+        fn, line, name = key
+        lines.append(f"{self_counts[key]:>6} {cum_counts[key]:>6}  "
+                     f"{name} ({fn}:{line})")
+    return "\n".join(lines) + "\n"
+
+
+def heap_profile(top: int = 30) -> str:
+    """Top allocation sites. tracemalloc begins on first call — the first
+    snapshot is the baseline for later ones."""
+    if not tracemalloc.is_tracing():
+        tracemalloc.start(10)
+        return ("tracemalloc started; this snapshot is the baseline — "
+                "request again to see allocations\n")
+    snap = tracemalloc.take_snapshot()
+    stats = snap.statistics("lineno")
+    cur, peak = tracemalloc.get_traced_memory()
+    lines = [f"heap: {cur:,} bytes live, {peak:,} peak since tracing began",
+             f"{'bytes':>12} {'count':>8}  location"]
+    for s in stats[:top]:
+        frame = s.traceback[0]
+        lines.append(f"{s.size:>12,} {s.count:>8}  "
+                     f"{frame.filename}:{frame.lineno}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,154 @@
+"""WebSocket watch streaming + /debug/pprof endpoints.
+
+ref: pkg/apiserver/watch.go:62-126 (the websocket watch variant) and the
+pprof mounts every reference binary exposes (pkg/master/master.go:431-435).
+The websocket test is a real RFC 6455 client: handshake over a raw
+socket, masked CLOSE, unmasked server frames parsed byte-by-byte.
+"""
+
+import base64
+import io
+import json
+import os
+import socket
+import struct
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.apiserver.http import APIServer
+from kubernetes_tpu.apiserver.master import Master
+from kubernetes_tpu.client.client import Client, InProcessTransport
+from kubernetes_tpu.util import websocket as ws
+
+
+@pytest.fixture()
+def server():
+    m = Master()
+    srv = APIServer(m, host="127.0.0.1", port=0).start()
+    yield srv, Client(InProcessTransport(m))
+    srv.stop()
+
+
+def _ws_connect(host, port, path):
+    """Raw RFC 6455 client handshake; returns the connected socket."""
+    s = socket.create_connection((host, port), timeout=10)
+    key = base64.b64encode(os.urandom(16)).decode()
+    req = (f"GET {path} HTTP/1.1\r\n"
+           f"Host: {host}:{port}\r\n"
+           "Upgrade: websocket\r\n"
+           "Connection: Upgrade\r\n"
+           f"Sec-WebSocket-Key: {key}\r\n"
+           "Sec-WebSocket-Version: 13\r\n\r\n")
+    s.sendall(req.encode())
+    resp = b""
+    while b"\r\n\r\n" not in resp:
+        chunk = s.recv(4096)
+        if not chunk:
+            raise AssertionError(f"handshake EOF: {resp!r}")
+        resp += chunk
+    head, _, rest = resp.partition(b"\r\n\r\n")
+    assert b"101" in head.split(b"\r\n")[0], head
+    assert ws.accept_key(key).encode() in head
+    return s, rest
+
+
+def _read_frames(s, leftover, want):
+    buf = io.BytesIO(leftover)
+    frames = []
+    data = leftover
+    while len(frames) < want:
+        chunk = s.recv(4096)
+        if not chunk:
+            break
+        data += chunk
+        buf = io.BytesIO(data)
+        frames = []
+        while True:
+            frame = ws.read_frame(buf)
+            if frame is None:
+                break
+            frames.append(frame)
+    return frames
+
+
+def _send_masked_close(s):
+    mask = os.urandom(4)
+    payload = struct.pack(">H", 1000)
+    masked = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+    s.sendall(bytes([0x80 | ws.OP_CLOSE, 0x80 | len(payload)]) + mask + masked)
+
+
+def test_websocket_watch_streams_events(server):
+    srv, client = server
+    host, port = "127.0.0.1", srv.port
+
+    s, leftover = _ws_connect(
+        host, port, "/api/v1/namespaces/default/pods?watch=true")
+    # create after the watch is up: the event must arrive as a text frame
+    client.pods().create(api.Pod(
+        metadata=api.ObjectMeta(name="wsp", namespace="default"),
+        spec=api.PodSpec(containers=[api.Container(name="c", image="i")])))
+    frames = _read_frames(s, leftover, 1)
+    assert frames and frames[0][0] == ws.OP_TEXT
+    ev = json.loads(frames[0][1])
+    assert ev["type"] == "ADDED"
+    assert ev["object"]["metadata"]["name"] == "wsp"
+    _send_masked_close(s)
+    s.close()
+    # the server-side watcher must wind down (no leak)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and srv._watchers:
+        time.sleep(0.05)
+    assert not srv._watchers
+
+
+def test_websocket_watch_v1beta1_frames(server):
+    """The websocket variant honors the wire version too."""
+    srv, client = server
+    s, leftover = _ws_connect(
+        "127.0.0.1", srv.port, "/api/v1beta1/pods?namespace=default&watch=1")
+    client.pods().create(api.Pod(
+        metadata=api.ObjectMeta(name="legacy", namespace="default"),
+        spec=api.PodSpec(containers=[api.Container(name="c", image="i")])))
+    frames = _read_frames(s, leftover, 1)
+    ev = json.loads(frames[0][1])
+    assert ev["object"]["id"] == "legacy"          # flat v1beta1 metadata
+    assert "desiredState" in ev["object"]
+    _send_masked_close(s)
+    s.close()
+
+
+def test_pprof_endpoints(server):
+    srv, _ = server
+    base = f"http://127.0.0.1:{srv.port}/debug/pprof"
+    idx = urllib.request.urlopen(base + "/").read().decode()
+    assert "goroutine" in idx and "heap" in idx
+    stacks = urllib.request.urlopen(base + "/goroutine").read().decode()
+    assert "thread" in stacks and "File" not in stacks[:1]
+    prof = urllib.request.urlopen(base + "/profile?seconds=0.3").read().decode()
+    assert "samples over" in prof
+    heap1 = urllib.request.urlopen(base + "/heap").read().decode()
+    heap2 = urllib.request.urlopen(base + "/heap").read().decode()
+    assert "baseline" in heap1 or "bytes live" in heap1
+    assert "bytes live" in heap2
+
+
+def test_chunked_watch_still_default(server):
+    """No Upgrade header -> the original chunked-JSON stream."""
+    srv, client = server
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/api/v1/namespaces/default/pods"
+        "?watch=true")
+    resp = urllib.request.urlopen(req, timeout=10)
+    client.pods().create(api.Pod(
+        metadata=api.ObjectMeta(name="chunky", namespace="default"),
+        spec=api.PodSpec(containers=[api.Container(name="c", image="i")])))
+    line = resp.readline()
+    ev = json.loads(line)
+    assert ev["type"] == "ADDED" and \
+        ev["object"]["metadata"]["name"] == "chunky"
+    resp.close()
