@@ -35,7 +35,7 @@ _ALL_KINDS = (
     api.Endpoints, api.EndpointsList,
     api.Node, api.NodeList,
     api.Namespace, api.NamespaceList,
-    api.Binding,
+    api.Binding, api.BindingList, api.BindingResultList,
     api.Event, api.EventList,
     api.Secret, api.SecretList,
     api.LimitRange, api.LimitRangeList,

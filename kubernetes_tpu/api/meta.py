@@ -98,6 +98,7 @@ def default_rest_mapper() -> RESTMapper:
     m.add("nodes", "Node", api.Node, False, api.NodeList, aliases=("node", "minions", "minion"))
     m.add("namespaces", "Namespace", api.Namespace, False, api.NamespaceList,
           aliases=("namespace", "ns"))
+    m.add("bindings", "Binding", api.Binding, True, api.BindingList)
     m.add("events", "Event", api.Event, True, api.EventList, aliases=("event", "ev"))
     m.add("secrets", "Secret", api.Secret, True, api.SecretList, aliases=("secret",))
     m.add("limitranges", "LimitRange", api.LimitRange, True, api.LimitRangeList,

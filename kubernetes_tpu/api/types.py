@@ -611,6 +611,32 @@ class Binding:
     kind: str = "Binding"
 
 
+@dataclass
+class BindingList:
+    """A wave's bindings, committed in one transactional store pass — the
+    batch extension SURVEY §7 hard part (e) calls for (10k binds landing in
+    one wave must not pay 10k apiserver round-trips). Each item keeps the
+    reference's per-pod CAS semantics; results come back positionally."""
+
+    metadata: ListMeta = field(default_factory=ListMeta)
+    items: List[Binding] = field(default_factory=list)
+    kind: str = "BindingList"
+
+
+@dataclass
+class BindingResult:
+    pod_name: str = ""
+    error: str = ""      # empty = bound; else the per-pod failure message
+    code: int = 0        # HTTP-ish status code for the failure
+
+
+@dataclass
+class BindingResultList:
+    metadata: ListMeta = field(default_factory=ListMeta)
+    items: List[BindingResult] = field(default_factory=list)
+    kind: str = "BindingResultList"
+
+
 # ---------------------------------------------------------------------------
 # Status & options (ref: types.go:1167-1330)
 # ---------------------------------------------------------------------------

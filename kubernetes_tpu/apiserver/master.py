@@ -82,6 +82,7 @@ class Master:
             "services": self.services,
             "endpoints": self.endpoints,
             "nodes": self.nodes,
+            "bindings": self.bindings,
             "events": self.events,
             "namespaces": self.namespaces,
             "secrets": self.secrets,
@@ -129,7 +130,9 @@ class Master:
         items = getattr(obj, "items", None)
         if items is not None:
             for item in items:
-                item.metadata.self_link = self._self_link(resource, item)
+                # result kinds (e.g. BindingResult) carry no ObjectMeta
+                if isinstance(getattr(item, "metadata", None), api.ObjectMeta):
+                    item.metadata.self_link = self._self_link(resource, item)
             version = getattr(self.scheme, "version", "v1")
             if self.mapper.is_namespaced(resource) and namespace:
                 obj.metadata.self_link = \

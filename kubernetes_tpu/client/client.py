@@ -116,6 +116,12 @@ class _PodsClient(_ResourceClient):
         return self.t.request("create", self.resource, namespace=self.namespace,
                               name=binding.pod_name, subresource="binding", body=binding)
 
+    def bind_many(self, bindings: api.BindingList) -> api.BindingResultList:
+        """POST /bindings with a BindingList — one transactional store pass
+        for a whole wave (see api.BindingList); per-item results."""
+        return self.t.request("create", "bindings", namespace=self.namespace,
+                              body=bindings)
+
     def update_status(self, pod: api.Pod):
         return self.t.request("update", self.resource, namespace=self.namespace,
                               name=pod.metadata.name, subresource="status", body=pod)

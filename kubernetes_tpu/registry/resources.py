@@ -86,24 +86,81 @@ class BindingREST:
     def __init__(self, pod_registry: GenericRegistry):
         self.pods = pod_registry
 
+    @staticmethod
+    def _assign_fn(name: str, host: str):
+        def assign(pod: api.Pod) -> api.Pod:
+            if pod.spec.host:
+                raise errors.new_conflict(
+                    "Pod", name,
+                    f"pod {name} is already assigned to host {pod.spec.host!r}")
+            pod.spec.host = host
+            pod.status.host = host
+            return pod
+        return assign
+
     def create(self, ctx: Context, binding: api.Binding) -> api.Status:
+        if isinstance(binding, api.BindingList):
+            return self.create_many(ctx, binding)
         name = binding.pod_name or binding.metadata.name
         if not name:
             raise errors.new_bad_request("binding must name a pod")
         if not binding.host:
             raise errors.new_bad_request("binding must name a host")
         key = self.pods.key(ctx, name)
-
-        def assign(pod: api.Pod) -> api.Pod:
-            if pod.spec.host:
-                raise errors.new_conflict(
-                    "Pod", name, f"pod {name} is already assigned to host {pod.spec.host!r}")
-            pod.spec.host = binding.host
-            pod.status.host = binding.host
-            return pod
-
-        self.pods.helper.atomic_update(key, api.Pod, assign)
+        self.pods.helper.atomic_update(key, api.Pod,
+                                       self._assign_fn(name, binding.host))
         return api.Status(status=api.StatusSuccess)
+
+    def create_many(self, ctx: Context,
+                    bindings: api.BindingList) -> api.BindingResultList:
+        """One transactional store pass for a whole wave's bindings (the
+        batched form of the CAS bind; see api.BindingList). Every item is
+        scoped to the REQUEST namespace — authorization and admission ran
+        against that namespace only, so an item naming another namespace
+        is rejected per-item rather than silently escaping the checks
+        (callers batch per namespace; the scheduler does)."""
+        updates = []
+        results = [api.BindingResult() for _ in bindings.items]
+        slot_map = []
+        for i, b in enumerate(bindings.items):
+            name = b.pod_name or b.metadata.name
+            results[i].pod_name = name
+            if not name or not b.host:
+                results[i].error = "binding must name a pod and a host"
+                results[i].code = 400
+                continue
+            if b.metadata.namespace and b.metadata.namespace != ctx.namespace:
+                results[i].error = (
+                    f"binding namespace {b.metadata.namespace!r} does not "
+                    f"match request namespace {ctx.namespace!r}")
+                results[i].code = 403
+                continue
+            updates.append((self.pods.key(ctx, name),
+                            self._assign_fn(name, b.host)))
+            slot_map.append(i)
+        outcomes = self.pods.helper.atomic_update_many(api.Pod, updates)
+        for i, oc in zip(slot_map, outcomes):
+            if isinstance(oc, errors.StatusError):
+                results[i].error = oc.status.message
+                results[i].code = oc.status.code
+        return api.BindingResultList(items=results)
+
+    # only create is implemented; the storage map exposure must answer the
+    # other verbs with 405 like every resource, not AttributeError 500s
+    def get(self, ctx, name):
+        raise errors.new_method_not_supported("bindings", "get")
+
+    def list(self, ctx, *a, **kw):
+        raise errors.new_method_not_supported("bindings", "list")
+
+    def watch(self, ctx, *a, **kw):
+        raise errors.new_method_not_supported("bindings", "watch")
+
+    def update(self, ctx, obj):
+        raise errors.new_method_not_supported("bindings", "update")
+
+    def delete(self, ctx, name):
+        raise errors.new_method_not_supported("bindings", "delete")
 
 
 class PodStatusREST:

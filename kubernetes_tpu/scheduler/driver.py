@@ -336,6 +336,13 @@ class _Binder:
     def bind(self, binding: api.Binding) -> None:
         self.client.pods(binding.metadata.namespace).bind(binding)
 
+    def bind_many(self, namespace: str,
+                  bindings: api.BindingList) -> api.BindingResultList:
+        """Commit one namespace's wave bindings in one transactional store
+        pass (the batch seam the tpu-batch scheduler uses; per-pod CAS
+        semantics kept)."""
+        return self.client.pods(namespace).bind_many(bindings)
+
 
 class _NodeStoreInfo:
     """NodeInfo over the scheduler's node store (GetNodeInfo by name)."""

@@ -169,28 +169,63 @@ class BatchScheduler:
                 c.error(pod, e)
             return 0
 
-        bound = 0
+        placed = []
         for pod, host in zip(pending, decisions):
             if host is None:
                 err = FitError(pod, {})
                 self._record(pod, "FailedScheduling", "Error scheduling: %s", err)
                 c.error(pod, err)
-                continue
-            binding = api.Binding(
+            else:
+                placed.append((pod, host))
+        if not placed:
+            return 0
+
+        def mk_binding(pod, host) -> api.Binding:
+            return api.Binding(
                 metadata=api.ObjectMeta(name=pod.metadata.name,
                                         namespace=pod.metadata.namespace),
                 pod_name=pod.metadata.name, host=host)
-            try:
-                c.binder.bind(binding)
-            except Exception as e:
+
+        # one transactional store pass per namespace for the wave's
+        # bindings (SURVEY §7 hard part (e)); the batch endpoint scopes to
+        # the request namespace (authz/admission ran against it), so a
+        # multi-namespace wave groups first. Per-pod CAS semantics are
+        # preserved — a lost race invalidates only that pod, which requeues
+        bind_many = getattr(c.binder, "bind_many", None)
+        outcomes: List[Optional[Exception]] = [None] * len(placed)
+        if bind_many is not None:
+            by_ns: dict = {}
+            for idx, (pod, host) in enumerate(placed):
+                by_ns.setdefault(pod.metadata.namespace, []).append(idx)
+            for ns, idxs in by_ns.items():
+                blist = api.BindingList(items=[
+                    mk_binding(*placed[i]) for i in idxs])
+                try:
+                    results = bind_many(ns, blist)
+                    for i, r in zip(idxs, results.items):
+                        outcomes[i] = RuntimeError(r.error) if r.error \
+                            else None
+                except Exception as e:
+                    for i in idxs:
+                        outcomes[i] = e
+        else:  # custom binder without the batch seam: reference behavior
+            for idx, (pod, host) in enumerate(placed):
+                try:
+                    c.binder.bind(mk_binding(pod, host))
+                except Exception as e:
+                    outcomes[idx] = e
+
+        import copy as _copy
+
+        bound = 0
+        for (pod, host), err in zip(placed, outcomes):
+            if err is not None:
                 # lost a CAS race: requeue; next wave sees fresh state
-                self._record(pod, "FailedScheduling", "Binding rejected: %s", e)
-                c.error(pod, e)
+                self._record(pod, "FailedScheduling", "Binding rejected: %s", err)
+                c.error(pod, err)
                 continue
             self._record(pod, "Scheduled", "Successfully assigned %s to %s",
                          pod.metadata.name, host)
-            import copy as _copy
-
             assumed = _copy.deepcopy(pod)
             assumed.spec.host = host
             assumed.status.host = host

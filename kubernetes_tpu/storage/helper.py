@@ -147,6 +147,57 @@ class StoreHelper:
             return out
         raise errors.new_conflict(obj_type.__name__, key, "too many CAS retries")
 
+    def atomic_update_many(self, obj_type: Type,
+                           updates: "list[tuple[str, Callable[[Any], Any]]]",
+                           max_retries: int = 100) -> list:
+        """Batched read-modify-CAS over many keys — the wave-commit path
+        (SURVEY §7 hard part (e)): one get_many + one compare_and_swap_many
+        per round instead of two store round-trips per object. Each key is
+        independent (no all-or-nothing): the result list carries, per slot,
+        the updated object or the errors.StatusError that update raised /
+        the key's terminal store error. CAS-conflicted slots re-read and
+        retry, exactly like atomic_update, without holding back the rest.
+        """
+        results: list = [None] * len(updates)
+        live = list(range(len(updates)))
+        for _ in range(max_retries):
+            if not live:
+                return results
+            kvs = self.store.get_many([updates[i][0] for i in live])
+            batch = []            # (slot, key, encoded, prev_index)
+            for i, kv in zip(live, kvs):
+                key, fn = updates[i]
+                if kv is None:
+                    results[i] = errors.new_not_found(
+                        obj_type.__name__, key.rsplit("/", 1)[-1])
+                    continue
+                try:
+                    desired = fn(self._decode(kv))
+                except errors.StatusError as e:
+                    results[i] = e
+                    continue
+                batch.append((i, key, self._encode(desired), desired,
+                              kv.modified_index))
+            outcomes = self.store.compare_and_swap_many(
+                [(key, enc, prev) for _, key, enc, _, prev in batch])
+            live = []
+            for (i, key, _enc, desired, _prev), oc in zip(batch, outcomes):
+                if isinstance(oc, ErrCASConflict):
+                    live.append(i)        # lost a race: re-read and retry
+                elif isinstance(oc, ErrKeyNotFound):
+                    results[i] = errors.new_not_found(
+                        obj_type.__name__, key.rsplit("/", 1)[-1])
+                elif isinstance(oc, Exception):
+                    results[i] = errors.new_internal_error(str(oc))
+                else:
+                    out = copy.deepcopy(desired)
+                    accessor.set_resource_version(out, str(oc.modified_index))
+                    results[i] = out
+        for i in live:
+            results[i] = errors.new_conflict(obj_type.__name__, updates[i][0],
+                                             "too many CAS retries")
+        return results
+
     # -- watch --------------------------------------------------------------
     def watch(self, prefix: str, resource_version: str = "",
               filter_fn: Optional[Callable[[Any], bool]] = None,

@@ -169,6 +169,16 @@ class MemStore:
                 raise ErrKeyNotFound(key)
             return kv
 
+    def get_many(self, keys: List[str]) -> List[Optional[KV]]:
+        """Read several keys under one lock acquisition (None = absent).
+        Honors the same scripted "get" fault injection as get() so chaos
+        tests exercise the batched path identically."""
+        with self._lock:
+            for k in keys:
+                self._maybe_raise("get", k)
+            self._sweep_locked()
+            return [self._data.get(k) for k in keys]
+
     def list(self, prefix: str) -> Tuple[List[KV], int]:
         """All KVs under prefix (recursive) + the store index at read time."""
         with self._lock:
@@ -239,6 +249,41 @@ class MemStore:
                 heapq.heappush(self._ttl_heap, (kv.expiration, key))
             self._record_locked(StoreEvent("compareAndSwap", key, self._index, kv, prev))
             return kv
+
+    def compare_and_swap_many(self, items: List[Tuple[str, str, int]]
+                              ) -> List[object]:
+        """Batched CAS: each (key, value, prev_index) is applied
+        independently under ONE lock acquisition — the wave-commit
+        primitive (SURVEY §7 hard part (e): 10k binds landing in one wave
+        must not pay 10k lock round-trips). Per-item outcomes are returned
+        positionally (KV on success, StoreError on conflict/missing) so a
+        lost race invalidates only that item, exactly as the serial CAS
+        would; every success gets its own index + watch event in order."""
+        out: List[object] = []
+        with self._lock:
+            self._sweep_locked()
+            for key, value, prev_index in items:
+                try:
+                    self._maybe_raise("compare_and_swap", key)
+                except StoreError as e:
+                    out.append(e)
+                    continue
+                prev = self._data.get(key)
+                if prev is None:
+                    out.append(ErrKeyNotFound(key))
+                    continue
+                if prev.modified_index != prev_index:
+                    out.append(ErrCASConflict(
+                        f"{key}: index mismatch (have {prev.modified_index}, "
+                        f"want {prev_index})"))
+                    continue
+                self._index += 1
+                kv = KV(key, value, prev.created_index, self._index, None)
+                self._data[key] = kv
+                self._record_locked(
+                    StoreEvent("compareAndSwap", key, self._index, kv, prev))
+                out.append(kv)
+        return out
 
     def delete(self, key: str, prev_index: Optional[int] = None) -> KV:
         with self._lock:

@@ -29,9 +29,15 @@ from typing import Dict, Tuple
 __all__ = ["dump_stacks", "cpu_profile", "heap_profile", "index", "handle"]
 
 
+_profile_slot = None  # created lazily; one sampler at a time process-wide
+
+
 def handle(which: str, seconds_arg: str = "") -> "str | None":
     """Shared endpoint dispatch for every binary's /debug/pprof mount.
-    Returns the response text, or None for an unknown endpoint."""
+    Returns the response text, or None for an unknown endpoint. At most
+    one CPU profile runs at a time — stacked 100Hz all-thread samplers
+    under the GIL would degrade the very loops being profiled."""
+    global _profile_slot
     if which in ("", "index"):
         return index()
     if which in ("goroutine", "stack"):
@@ -41,7 +47,14 @@ def handle(which: str, seconds_arg: str = "") -> "str | None":
             seconds = float(seconds_arg or "5")
         except ValueError:
             seconds = 5.0
-        return cpu_profile(seconds)
+        if _profile_slot is None:
+            _profile_slot = threading.Semaphore(1)
+        if not _profile_slot.acquire(blocking=False):
+            return "a profile is already in progress; retry later\n"
+        try:
+            return cpu_profile(seconds)
+        finally:
+            _profile_slot.release()
     if which == "heap":
         return heap_profile()
     return None

@@ -193,3 +193,80 @@ def test_concurrent_waves_with_churning_deletes():
             "survivor pods never bound amid churn deletes"
     finally:
         sched.stop(); factory.stop()
+
+
+def test_batched_bindings_transactional_commit():
+    """The bindings batch endpoint: one store pass, per-item CAS results."""
+    m = Master()
+    admin = Client(InProcessTransport(m))
+    admin.nodes().create(mk_node("n0"))
+    for i in range(4):
+        admin.pods().create(mk_pod(f"b{i}"))
+    # pre-bind b2 so its slot conflicts
+    admin.pods().bind(api.Binding(
+        metadata=api.ObjectMeta(name="b2", namespace="default"),
+        pod_name="b2", host="n0"))
+    blist = api.BindingList(items=[
+        api.Binding(metadata=api.ObjectMeta(name=f"b{i}",
+                                            namespace="default"),
+                    pod_name=f"b{i}", host="n0")
+        for i in range(4)] + [
+        api.Binding(metadata=api.ObjectMeta(name="ghost",
+                                            namespace="default"),
+                    pod_name="ghost", host="n0"),
+        api.Binding(metadata=api.ObjectMeta(namespace="default"))])
+    results = admin.pods().bind_many(blist)
+    by_name = {r.pod_name: r for r in results.items}
+    assert by_name["b0"].error == "" and by_name["b1"].error == ""
+    assert by_name["b3"].error == ""
+    assert "already assigned" in by_name["b2"].error
+    assert by_name["ghost"].code == 404
+    assert by_name[""].code == 400
+    # winners really bound
+    for i in (0, 1, 3):
+        assert admin.pods().get(f"b{i}").spec.host == "n0"
+
+
+def test_two_batch_schedulers_race_batched_binds():
+    """Both schedulers commit whole waves through the batched CAS: still
+    exactly-once binding under contention."""
+    m = Master()
+    admin = Client(InProcessTransport(m))
+    for i in range(4):
+        admin.nodes().create(mk_node(f"n{i}"))
+    s1, f1 = start_batch(m, wave_size=32, linger=0.02)
+    s2, f2 = start_batch(m, wave_size=32, linger=0.02)
+    try:
+        time.sleep(0.3)
+        for i in range(64):
+            admin.pods().create(mk_pod(f"bb{i:03d}"))
+        assert wait_for(lambda: all_bound(admin, 64), timeout=45.0)
+        hosts = {p.metadata.name: p.spec.host
+                 for p in admin.pods().list().items}
+        time.sleep(0.3)
+        hosts2 = {p.metadata.name: p.spec.host
+                  for p in admin.pods().list().items}
+        assert hosts == hosts2
+    finally:
+        s1.stop(); s2.stop(); f1.stop(); f2.stop()
+
+
+def test_batched_bindings_reject_cross_namespace_items():
+    """Items naming another namespace are refused per-item: authz and
+    admission ran against the request namespace only."""
+    m = Master()
+    admin = Client(InProcessTransport(m))
+    admin.nodes().create(mk_node("n0"))
+    admin.pods().create(mk_pod("same-ns"))
+    blist = api.BindingList(items=[
+        api.Binding(metadata=api.ObjectMeta(name="same-ns",
+                                            namespace="default"),
+                    pod_name="same-ns", host="n0"),
+        api.Binding(metadata=api.ObjectMeta(name="sneaky",
+                                            namespace="victim"),
+                    pod_name="sneaky", host="n0")])
+    results = admin.pods().bind_many(blist)
+    by_name = {r.pod_name: r for r in results.items}
+    assert by_name["same-ns"].error == ""
+    assert by_name["sneaky"].code == 403
+    assert "does not match request namespace" in by_name["sneaky"].error

@@ -40,8 +40,10 @@ def test_density_pods_per_node(pods_per_node):
         cluster.client.replication_controllers().create(
             mk_rc("density", total))
         t0 = time.monotonic()
+        # generous budget: this box has 1 core and the suite runs other
+        # clusters' threads; the rate is asserted by the bench, not here
         assert cluster.wait_pods_running(total, label_selector="app=density",
-                                         timeout=60.0), \
+                                         timeout=180.0), \
             "density pods never all ran"
         elapsed = time.monotonic() - t0
         # every pod landed on a real node and is running there
