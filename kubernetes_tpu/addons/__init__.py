@@ -1,0 +1,1 @@
+"""Cluster addons (ref: cluster/addons/ — DNS, monitoring)."""

@@ -1,0 +1,201 @@
+"""Cluster monitoring addon — the heapster analog.
+
+ref: cluster/addons/cluster-monitoring/ (heapster + influxdb/grafana):
+the reference runs an aggregator that discovers nodes through the API,
+scrapes every kubelet's cAdvisor stats, and exposes cluster-level
+resource metrics. Same shape here:
+
+- node discovery via the node list-watch cache (the component pattern);
+- per-node scrape of the kubelet read-only server: /spec (MachineInfo)
+  and /stats (node ContainerStats), over a pluggable fetch seam — HTTP
+  against ``<address>:<kubelet-port>`` by default, injectable for the
+  in-process cluster harness;
+- aggregation into cluster totals (cores, memory capacity, cpu seconds,
+  memory usage, pods per node via the pod cache) re-exposed as
+  Prometheus gauges on its own /metrics endpoint plus a JSON summary at
+  /api/v1/model (heapster's model-API path).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.client.cache import Reflector, Store
+from kubernetes_tpu.util import metrics as metrics_pkg
+
+__all__ = ["Monitoring", "http_kubelet_fetcher"]
+
+
+def http_kubelet_fetcher(kubelet_port: int = 10250,
+                         timeout: float = 2.0) -> Callable:
+    """Default scrape seam: GET the kubelet read-only server over HTTP."""
+    def fetch(node: api.Node, path: str) -> Optional[dict]:
+        host = node.metadata.name
+        for addr in node.status.addresses:
+            if addr.address:
+                host = addr.address
+                break
+        try:
+            with urllib.request.urlopen(
+                    f"http://{host}:{kubelet_port}{path}",
+                    timeout=timeout) as r:
+                return json.loads(r.read())
+        except (OSError, ValueError):
+            return None
+    return fetch
+
+
+class Monitoring:
+    """Scrape kubelets, aggregate, expose. One resync per period."""
+
+    def __init__(self, client, fetch: Optional[Callable] = None,
+                 period_s: float = 5.0, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.client = client
+        self.fetch = fetch or http_kubelet_fetcher()
+        self.period_s = period_s
+        self.nodes = Store()
+        self.pods = Store()
+        self._reflectors = [
+            Reflector(client.nodes().list_watch(), self.nodes,
+                      name="monitoring-nodes"),
+            Reflector(client.pods(api.NamespaceAll).list_watch(
+                field_selector="spec.host!="), self.pods,
+                name="monitoring-pods"),
+        ]
+        self.registry = metrics_pkg.Registry()
+        self._g_nodes = self.registry.gauge(
+            "cluster_nodes", "nodes known to the monitoring addon")
+        self._g_ready = self.registry.gauge(
+            "cluster_nodes_scraped", "nodes whose kubelet answered")
+        self._g_cores = self.registry.gauge(
+            "cluster_machine_cores", "sum of node cores")
+        self._g_mem_cap = self.registry.gauge(
+            "cluster_machine_memory_bytes", "sum of node memory capacity")
+        self._g_cpu = self.registry.gauge(
+            "cluster_cpu_usage_core_seconds", "sum of node cpu seconds")
+        self._g_mem = self.registry.gauge(
+            "cluster_memory_usage_bytes", "sum of node memory usage")
+        self._g_pods = self.registry.gauge(
+            "cluster_pods_assigned", "pods bound to nodes")
+        self.model: Dict[str, dict] = {"nodes": {}, "cluster": {}}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._srv = ThreadingHTTPServer((host, port), _Handler)
+        self._srv.addon = self  # type: ignore[attr-defined]
+        self._threads = []
+
+    @property
+    def port(self) -> int:
+        return self._srv.server_address[1]
+
+    def start(self) -> "Monitoring":
+        for r in self._reflectors:
+            r.run()
+        self._threads = [
+            threading.Thread(target=self._scrape_loop, daemon=True,
+                             name="monitoring-scrape"),
+            threading.Thread(target=self._srv.serve_forever, daemon=True,
+                             name="monitoring-http"),
+        ]
+        for t in self._threads:
+            t.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        for r in self._reflectors:
+            r.stop()
+        self._srv.shutdown()
+        self._srv.server_close()
+
+    # -- scraping -----------------------------------------------------------
+    def scrape_once(self) -> dict:
+        nodes = self.nodes.list()
+        pods_per_node: Dict[str, int] = {}
+        for p in self.pods.list():
+            host = p.spec.host or p.status.host
+            if host:
+                pods_per_node[host] = pods_per_node.get(host, 0) + 1
+        per_node = {}
+        totals = {"cores": 0, "memory_capacity": 0,
+                  "cpu_usage_core_seconds": 0.0, "memory_usage": 0,
+                  "scraped": 0}
+        # scrape concurrently (as heapster does): a few dead kubelets at a
+        # 2s timeout each must not stretch one pass past the scrape period
+        with ThreadPoolExecutor(max_workers=min(16, max(1, len(nodes)))) \
+                as pool:
+            specs = list(pool.map(lambda n: self.fetch(n, "/spec"), nodes))
+            statses = list(pool.map(lambda n: self.fetch(n, "/stats"),
+                                    nodes))
+        for n, spec, stats in zip(nodes, specs, statses):
+            entry = {"pods": pods_per_node.get(n.metadata.name, 0),
+                     "up": spec is not None and stats is not None}
+            if spec:
+                entry["cores"] = spec.get("num_cores", 0)
+                entry["memory_capacity"] = spec.get("memory_capacity", 0)
+                totals["cores"] += entry["cores"]
+                totals["memory_capacity"] += entry["memory_capacity"]
+            if stats:
+                cpu = stats.get("cpu", {}).get("usage_core_seconds", 0.0)
+                mem = stats.get("memory", {}).get("usage_bytes", 0)
+                entry["cpu_usage_core_seconds"] = cpu
+                entry["memory_usage"] = mem
+                totals["cpu_usage_core_seconds"] += cpu
+                totals["memory_usage"] += mem
+            if entry["up"]:
+                totals["scraped"] += 1
+            per_node[n.metadata.name] = entry
+        totals["pods"] = sum(pods_per_node.values())
+        with self._lock:
+            self.model = {"nodes": per_node, "cluster": totals,
+                          "timestamp": time.time()}
+        self._g_nodes.set(len(nodes))
+        self._g_ready.set(totals["scraped"])
+        self._g_cores.set(totals["cores"])
+        self._g_mem_cap.set(totals["memory_capacity"])
+        self._g_cpu.set(totals["cpu_usage_core_seconds"])
+        self._g_mem.set(totals["memory_usage"])
+        self._g_pods.set(totals["pods"])
+        return self.model
+
+    def _scrape_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.scrape_once()
+            except Exception:
+                pass  # a dead kubelet must not kill the aggregator
+            self._stop.wait(self.period_s)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *a):  # quiet
+        pass
+
+    def do_GET(self):
+        addon: Monitoring = self.server.addon  # type: ignore[attr-defined]
+        if self.path.startswith("/metrics"):
+            body = addon.registry.render_text().encode()
+            ctype = "text/plain; version=0.0.4"
+        elif self.path.startswith("/api/v1/model"):
+            with addon._lock:
+                body = json.dumps(addon.model).encode()
+            ctype = "application/json"
+        elif self.path.startswith("/healthz"):
+            body, ctype = b"ok", "text/plain"
+        else:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)

@@ -50,6 +50,16 @@ def _version_change(argv):
     return version_change(argv)
 
 
+def _dns(argv):
+    from kubernetes_tpu.cmd.dns import dns_server
+    return dns_server(argv)
+
+
+def _monitoring(argv):
+    from kubernetes_tpu.cmd.monitoring import monitoring_server
+    return monitoring_server(argv)
+
+
 SERVERS = {
     "apiserver": _apiserver,
     "kube-apiserver": _apiserver,
@@ -65,6 +75,10 @@ SERVERS = {
     "kubernetes": _standalone,
     "version-change": _version_change,
     "kube-version-change": _version_change,
+    "dns": _dns,
+    "cluster-dns": _dns,
+    "monitoring": _monitoring,
+    "cluster-monitoring": _monitoring,
 }
 
 

@@ -1,0 +1,65 @@
+"""cluster-monitoring binary — the heapster-analog aggregator
+(ref: cluster/addons/cluster-monitoring deployment)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import threading
+from typing import List, Optional
+
+__all__ = ["monitoring_server", "main"]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="cluster-monitoring",
+                                exit_on_error=False)
+    p.add_argument("--master", default="http://127.0.0.1:8080",
+                   help="apiserver URL")
+    p.add_argument("--address", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=10251)
+    p.add_argument("--kubelet-port", "--kubelet_port", type=int,
+                   default=10250)
+    p.add_argument("--period", type=float, default=5.0,
+                   help="scrape period seconds")
+    return p
+
+
+def monitoring_server(argv: List[str],
+                      ready: Optional[threading.Event] = None,
+                      stop: Optional[threading.Event] = None) -> int:
+    from kubernetes_tpu.addons.monitoring import (
+        Monitoring,
+        http_kubelet_fetcher,
+    )
+    from kubernetes_tpu.client.client import Client
+    from kubernetes_tpu.client.http import HTTPTransport
+
+    try:
+        opts = build_parser().parse_args(argv)
+    except argparse.ArgumentError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    client = Client(HTTPTransport(opts.master))
+    mon = Monitoring(client, fetch=http_kubelet_fetcher(opts.kubelet_port),
+                     period_s=opts.period, host=opts.address,
+                     port=opts.port).start()
+    print(f"cluster-monitoring on http://{opts.address}:{mon.port} "
+          f"(/metrics, /api/v1/model)", file=sys.stderr)
+    if ready is not None:
+        ready.set()
+    stop = stop or threading.Event()
+    try:
+        stop.wait()
+    except KeyboardInterrupt:
+        pass
+    mon.stop()
+    return 0
+
+
+def main() -> int:
+    return monitoring_server(sys.argv[1:])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

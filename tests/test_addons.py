@@ -1,0 +1,172 @@
+"""Cluster addons: DNS (skydns analog) and monitoring (heapster analog).
+
+ref: cluster/addons/{dns,cluster-monitoring}. The DNS test speaks real
+RFC 1035 wire bytes over UDP; the monitoring test scrapes real kubelet
+read-only servers from the in-process cluster.
+"""
+
+import json
+import socket
+import struct
+import time
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.addons.dns import DNSServer
+from kubernetes_tpu.addons.monitoring import Monitoring
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.quantity import Quantity
+from kubernetes_tpu.apiserver.master import Master
+from kubernetes_tpu.client.client import Client, InProcessTransport
+
+
+def _query(addr, name, qtype=1, txid=0x1234):
+    q = struct.pack(">HHHHHH", txid, 0x0100, 1, 0, 0, 0)
+    for label in name.split("."):
+        q += bytes([len(label)]) + label.encode()
+    q += b"\x00" + struct.pack(">HH", qtype, 1)
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.settimeout(5)
+    s.sendto(q, addr)
+    resp, _ = s.recvfrom(512)
+    s.close()
+    (rtxid, flags, qd, an, _ns, _ar) = struct.unpack(">HHHHHH", resp[:12])
+    assert rtxid == txid
+    rcode = flags & 0xF
+    ip = None
+    if an:
+        # answer follows the echoed question: skip qname + qtype/qclass
+        pos = 12
+        while resp[pos] != 0:
+            pos += 1 + resp[pos]
+        pos += 5  # null + qtype + qclass
+        # answer: name ptr(2) type(2) class(2) ttl(4) rdlen(2) rdata
+        (rdlen,) = struct.unpack(">H", resp[pos + 10: pos + 12])
+        if rdlen == 4:
+            ip = socket.inet_ntoa(resp[pos + 12: pos + 16])
+    return rcode, ip
+
+
+@pytest.fixture()
+def cluster_client():
+    m = Master()
+    return Client(InProcessTransport(m))
+
+
+def _wait(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_dns_resolves_services(cluster_client):
+    client = cluster_client
+    web = client.services().create(api.Service(
+        metadata=api.ObjectMeta(name="web", namespace="default"),
+        spec=api.ServiceSpec(port=80, selector={"app": "web"})))
+    db = client.resource("services", "prod").create(api.Service(
+        metadata=api.ObjectMeta(name="db", namespace="prod"),
+        spec=api.ServiceSpec(port=5432, selector={"app": "db"})))
+    dns = DNSServer(client).start()
+    try:
+        assert _wait(lambda: dns.resolve("web.default.cluster.local"))
+        rcode, ip = _query(dns.addr, "web.default.cluster.local")
+        assert rcode == 0 and ip == web.spec.portal_ip
+        # short form defaults the namespace
+        rcode, ip = _query(dns.addr, "web.cluster.local")
+        assert rcode == 0 and ip == web.spec.portal_ip
+        # other namespaces, case-insensitive
+        rcode, ip = _query(dns.addr, "DB.Prod.Cluster.Local")
+        assert rcode == 0 and ip == db.spec.portal_ip
+        # unknown name -> NXDOMAIN
+        rcode, ip = _query(dns.addr, "ghost.default.cluster.local")
+        assert rcode == 3 and ip is None
+        # wrong domain -> NXDOMAIN
+        rcode, ip = _query(dns.addr, "web.default.example.com")
+        assert rcode == 3
+        # AAAA for an existing name: empty NOERROR
+        rcode, ip = _query(dns.addr, "web.default.cluster.local", qtype=28)
+        assert rcode == 0 and ip is None
+    finally:
+        dns.stop()
+
+
+def test_dns_tracks_service_churn(cluster_client):
+    client = cluster_client
+    dns = DNSServer(client).start()
+    try:
+        assert _query(dns.addr, "late.default.cluster.local")[0] == 3
+        client.services().create(api.Service(
+            metadata=api.ObjectMeta(name="late", namespace="default"),
+            spec=api.ServiceSpec(port=80, selector={"app": "x"})))
+        assert _wait(lambda: dns.resolve("late.default.cluster.local"))
+        client.services().delete("late")
+        assert _wait(
+            lambda: dns.resolve("late.default.cluster.local") is None)
+    finally:
+        dns.stop()
+
+
+def test_monitoring_aggregates_kubelet_stats():
+    from kubernetes_tpu.cluster import Cluster, ClusterConfig
+
+    cluster = Cluster(ClusterConfig(num_nodes=2, kubelet_http=True)).start()
+    try:
+        # fetch seam pointed at the in-process kubelet read-only servers
+        ports = {name: h.server.port
+                 for name, h in cluster.nodes.items()}
+
+        def fetch(node, path):
+            port = ports.get(node.metadata.name)
+            if port is None:
+                return None
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+                return json.loads(r.read())
+
+        mon = Monitoring(cluster.client, fetch=fetch, period_s=0.5).start()
+        try:
+            cluster.client.pods().create(api.Pod(
+                metadata=api.ObjectMeta(name="w0", namespace="default"),
+                spec=api.PodSpec(containers=[api.Container(
+                    name="c", image="img",
+                    resources=api.ResourceRequirements(limits={
+                        "cpu": Quantity("100m"),
+                        "memory": Quantity("64Mi")}))])))
+            assert _wait(lambda: (
+                mon.model.get("cluster", {}).get("scraped") == 2 and
+                mon.model["cluster"].get("pods", 0) >= 1), timeout=20)
+            model = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{mon.port}/api/v1/model").read())
+            assert set(model["nodes"]) == {"node-0", "node-1"}
+            assert model["cluster"]["cores"] > 0
+            assert model["cluster"]["memory_capacity"] > 0
+            text = urllib.request.urlopen(
+                f"http://127.0.0.1:{mon.port}/metrics").read().decode()
+            assert "cluster_nodes 2" in text
+            assert "cluster_nodes_scraped 2" in text
+        finally:
+            mon.stop()
+    finally:
+        cluster.stop()
+
+
+def test_dns_suffix_is_label_bounded(cluster_client):
+    """'webcluster.local' must not match domain 'cluster.local' — suffix
+    checks are label-bounded (regression)."""
+    client = cluster_client
+    client.services().create(api.Service(
+        metadata=api.ObjectMeta(name="web", namespace="default"),
+        spec=api.ServiceSpec(port=80, selector={"app": "web"})))
+    dns = DNSServer(client).start()
+    try:
+        assert _wait(lambda: dns.resolve("web.default.cluster.local"))
+        assert dns.resolve("webcluster.local") is None
+        assert dns.resolve("web.defaultcluster.local") is None
+        assert dns.resolve("cluster.local") is None
+    finally:
+        dns.stop()
