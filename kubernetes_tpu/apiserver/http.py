@@ -25,6 +25,7 @@ namespace-as-query-param (legacy v1beta1 style):
 from __future__ import annotations
 
 import json
+import logging
 import socket
 import threading
 import time
@@ -39,6 +40,8 @@ from kubernetes_tpu.api import errors
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.auth import AuthRequest
 from kubernetes_tpu.util import metrics as metrics_pkg
+
+_httplog = logging.getLogger("kubernetes_tpu.apiserver.httplog")
 
 __all__ = ["APIServer"]
 
@@ -176,8 +179,16 @@ class _Handler(BaseHTTPRequestHandler):
         finally:
             apisrv.metric_requests.inc(verb_label, self._metric_resource,
                                        self.client_address[0], str(code))
-            apisrv.metric_latency.observe(time.monotonic() - started,
-                                          verb_label, self._metric_resource)
+            elapsed = time.monotonic() - started
+            apisrv.metric_latency.observe(elapsed, verb_label,
+                                          self._metric_resource)
+            # request log (ref: pkg/httplog/log.go — method, path, status,
+            # latency per request; DEBUG so production defaults stay quiet
+            # like glog's v-levels, errors at INFO)
+            _httplog.log(
+                logging.INFO if code >= 500 else logging.DEBUG,
+                "%s %s -> %d (%.1fms) from %s", method, self.path, code,
+                elapsed * 1000.0, self.client_address[0])
 
     def _version_of(self, parts) -> str:
         apisrv = self.server.api  # type: ignore[attr-defined]

@@ -327,15 +327,24 @@ class ProcessRuntime(ContainerRuntime):
         with self._lock:
             self._images.discard(image)
 
-    def exec_in_container(self, container_id: str, cmd: List[str]) -> Tuple[int, str]:
+    def _exec_target(self, container_id: str):
+        """(env, cwd) for a live container, or an error string — shared
+        preamble of both exec paths; callers never hold the lock across
+        their IO."""
         with self._lock:
             p = self._procs.get(container_id)
             if p is None:
-                return 1, "no such container"
+                return None, "no such container"
             self._refresh(p)
             if not p.record.running:
-                return 1, "container not running"
-            env, cwd = dict(p.env), p.cwd
+                return None, "container not running"
+            return (dict(p.env), p.cwd), ""
+
+    def exec_in_container(self, container_id: str, cmd: List[str]) -> Tuple[int, str]:
+        target, err = self._exec_target(container_id)
+        if target is None:
+            return 1, err
+        env, cwd = target
         try:
             r = subprocess.run(cmd, env=env, cwd=cwd, timeout=15,
                                stdout=subprocess.PIPE,
@@ -346,6 +355,48 @@ class ProcessRuntime(ContainerRuntime):
             return 124, "exec timed out"
         except OSError as e:
             return 126, f"exec failed: {e}"
+
+    def exec_stream_in_container(self, container_id: str, cmd: List[str]):
+        """Live-stream the command's combined output, then the exit code —
+        the WebSocket exec path. The process runs with the container's
+        environment exactly like exec_in_container. Never yields while
+        holding the runtime lock (the consumer's socket write can stall
+        arbitrarily), and an abandoned stream kills + reaps the child."""
+        target, err = self._exec_target(container_id)
+        if target is None:
+            yield err.encode()
+            yield 1
+            return
+        env, cwd = target
+        try:
+            proc = subprocess.Popen(cmd, env=env, cwd=cwd,
+                                    stdout=subprocess.PIPE,
+                                    stderr=subprocess.STDOUT,
+                                    stdin=subprocess.DEVNULL)
+        except (OSError, ValueError) as e:  # ValueError: NUL in argv etc.
+            yield f"exec failed: {e}".encode()
+            yield 126
+            return
+        try:
+            assert proc.stdout is not None
+            while True:
+                chunk = proc.stdout.read1(65536)
+                if not chunk:
+                    break
+                yield chunk
+            yield proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            yield 124
+        finally:
+            # normal exit, timeout, or the consumer abandoning the
+            # generator (GeneratorExit): no orphans, no zombies
+            if proc.poll() is None:
+                proc.kill()
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass
+            proc.stdout.close()
 
     def container_logs(self, container_id: str, tail: int = 0) -> str:
         with self._lock:

@@ -119,6 +119,16 @@ class ContainerRuntime:
     def exec_in_container(self, container_id: str, cmd: List[str]) -> Tuple[int, str]:
         raise NotImplementedError
 
+    def exec_stream_in_container(self, container_id: str, cmd: List[str]):
+        """Yield output chunks (bytes) as the command produces them, then
+        the final exit code (int) as the last item — the streaming seam the
+        WebSocket exec upgrade serves. Default: wrap the blocking exec
+        (one chunk); ProcessRuntime streams live."""
+        code, output = self.exec_in_container(container_id, cmd)
+        if output:
+            yield output.encode("utf-8", "replace")
+        yield code
+
     def container_logs(self, container_id: str, tail: int = 0) -> str:
         """ref: dockertools GetKubeletDockerContainerLogs."""
         raise NotImplementedError

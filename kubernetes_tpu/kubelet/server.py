@@ -216,8 +216,41 @@ class _Handler(BaseHTTPRequestHandler):
             cmd = cmd[0].split()
         if not cmd:
             return self._send_text(400, "missing cmd\n")
+        from kubernetes_tpu.util import websocket as ws
+        if ws.wants_websocket(self.headers):
+            # streaming exec (the stream-upgrade seam the reference fills
+            # with SPDY, ref: pkg/util/httpstream/spdy/upgrade.go): output
+            # chunks as binary frames, exit code in the final text frame
+            self._ws_handshake(ws)
+            exit_code = 0
+            try:
+                for item in self.ks.runtime.exec_stream_in_container(
+                        rec.id, cmd):
+                    if isinstance(item, int):
+                        exit_code = item
+                    elif item:
+                        ws.send_binary(self.wfile, item)
+                ws.send_text(self.wfile,
+                             json.dumps({"exitCode": exit_code}).encode())
+                ws.send_close(self.wfile)
+            except Exception:
+                # after the 101 upgrade an HTTP error response would be
+                # garbage inside the websocket stream; just drop the
+                # connection (ref: SPDY upgrade has the same property)
+                pass
+            self.close_connection = True
+            return
         code, output = self.ks.runtime.exec_in_container(rec.id, cmd)
         self._send_text(200 if code == 0 else 500, output)
+
+    def _ws_handshake(self, ws) -> None:
+        self.send_response_only(101, "Switching Protocols")
+        self.send_header("Upgrade", "websocket")
+        self.send_header("Connection", "Upgrade")
+        self.send_header("Sec-WebSocket-Accept", ws.accept_key(
+            self.headers.get("Sec-WebSocket-Key", "")))
+        self.end_headers()
+        self.wfile.flush()
 
     def _handle_port_forward(self, rest, query) -> None:
         """Raw byte tunnel: 101 upgrade, then relay the HTTP connection to
@@ -236,6 +269,58 @@ class _Handler(BaseHTTPRequestHandler):
             backend = self.ks.port_forward_dial(pod, port)
         except OSError as e:
             return self._send_text(502, f"dial failed: {e}\n")
+        from kubernetes_tpu.util import websocket as ws
+        if ws.wants_websocket(self.headers):
+            # WebSocket port-forward: client binary frames -> backend,
+            # backend bytes -> binary frames (the reference's SPDY stream
+            # pair, per RFC 6455 instead)
+            self._ws_handshake(ws)
+            wlock = threading.Lock()
+
+            def pump_client():
+                try:
+                    while True:
+                        frame = ws.read_frame(self.rfile)
+                        if frame is None or frame[0] == ws.OP_CLOSE:
+                            # None = EOF or an over-MAX_FRAME length: the
+                            # tunnel closes cleanly either way (fragment
+                            # large messages; CONT frames relay fine)
+                            break
+                        if frame[0] == ws.OP_PING:
+                            with wlock:
+                                ws.send_pong(self.wfile, frame[1])
+                        elif frame[0] in (ws.OP_BIN, ws.OP_TEXT,
+                                          ws.OP_CONT) and frame[1]:
+                            backend.sendall(frame[1])
+                except OSError:
+                    pass
+                try:
+                    backend.shutdown(socket.SHUT_WR)
+                except OSError:
+                    pass
+
+            t = threading.Thread(target=pump_client, daemon=True,
+                                 name="ws-portforward")
+            t.start()
+            # same idle bound the raw-relay path enforces: a silently
+            # vanished client must not pin this thread forever
+            backend.settimeout(30.0)
+            try:
+                while True:
+                    data = backend.recv(65536)
+                    if not data:
+                        break
+                    with wlock:
+                        ws.send_binary(self.wfile, data)
+                with wlock:
+                    ws.send_close(self.wfile)
+            except (BrokenPipeError, ConnectionResetError, OSError,
+                    socket.timeout):
+                pass
+            finally:
+                backend.close()
+                self.close_connection = True
+            return
         self.send_response(101, "Switching Protocols")
         self.send_header("Upgrade", "tcp")
         self.send_header("Connection", "Upgrade")

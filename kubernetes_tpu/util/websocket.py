@@ -37,9 +37,8 @@ def wants_websocket(headers) -> bool:
         and bool(headers.get("Sec-WebSocket-Key"))
 
 
-def send_text(wfile, payload: bytes) -> None:
-    """One unmasked FIN text frame."""
-    header = bytearray([0x80 | OP_TEXT])
+def _send_frame(wfile, opcode: int, payload: bytes) -> None:
+    header = bytearray([0x80 | opcode])
     n = len(payload)
     if n < 126:
         header.append(n)
@@ -51,6 +50,16 @@ def send_text(wfile, payload: bytes) -> None:
         header += struct.pack(">Q", n)
     wfile.write(bytes(header) + payload)
     wfile.flush()
+
+
+def send_text(wfile, payload: bytes) -> None:
+    """One unmasked FIN text frame."""
+    _send_frame(wfile, OP_TEXT, payload)
+
+
+def send_binary(wfile, payload: bytes) -> None:
+    """One unmasked FIN binary frame."""
+    _send_frame(wfile, OP_BIN, payload)
 
 
 def send_close(wfile, code: int = 1000) -> None:

@@ -392,3 +392,103 @@ def test_kubectl_log_through_cluster():
         assert out.getvalue() == "container says hi\n"
     finally:
         cluster.stop()
+
+
+def _ws_upgrade(port, path):
+    import base64, os as _os
+    from kubernetes_tpu.util import websocket as ws
+    s = socket.create_connection(("127.0.0.1", port), timeout=10)
+    key = base64.b64encode(_os.urandom(16)).decode()
+    s.sendall((f"POST {path} HTTP/1.1\r\nHost: x\r\n"
+               "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+               f"Sec-WebSocket-Key: {key}\r\n"
+               "Sec-WebSocket-Version: 13\r\nContent-Length: 0\r\n\r\n"
+               ).encode())
+    resp = b""
+    while b"\r\n\r\n" not in resp:
+        chunk = s.recv(4096)
+        assert chunk, f"EOF during handshake: {resp!r}"
+        resp += chunk
+    head, _, leftover = resp.partition(b"\r\n\r\n")
+    assert b"101" in head.split(b"\r\n")[0], head
+    return s, leftover
+
+
+def _ws_collect(s, leftover):
+    import io
+    from kubernetes_tpu.util import websocket as ws
+    data = leftover
+    frames = []
+    while True:
+        buf = io.BytesIO(data)
+        frames = []
+        closed = False
+        while True:
+            f = ws.read_frame(buf)
+            if f is None:
+                break
+            frames.append(f)
+            if f[0] == ws.OP_CLOSE:
+                closed = True
+        if closed:
+            return frames
+        chunk = s.recv(4096)
+        if not chunk:
+            return frames
+        data += chunk
+
+
+def test_exec_over_websocket(server):
+    """Upgrade on /run streams output frames + a final exit-code frame
+    (the reference's SPDY exec seam, served as RFC 6455)."""
+    from kubernetes_tpu.util import websocket as ws
+    srv, kubelet, runtime, *_ = server
+    kubelet.sync_pods([mkpod()])
+    rec = wait_for_container(runtime, "u-1", "c")
+    runtime.exec_results[("c", ("echo", "hi"))] = (0, "hi\n")
+    s, leftover = _ws_upgrade(
+        srv.port, "/run/default/web/c?cmd=echo&cmd=hi")
+    frames = _ws_collect(s, leftover)
+    s.close()
+    kinds = [f[0] for f in frames]
+    assert ws.OP_CLOSE in kinds
+    out = b"".join(p for op, p in frames if op == ws.OP_BIN)
+    assert out == b"hi\n"
+    status = [json.loads(p) for op, p in frames if op == ws.OP_TEXT]
+    assert status and status[-1]["exitCode"] == 0
+
+
+def test_port_forward_over_websocket(server):
+    """Upgrade on /portForward relays binary frames both ways."""
+    import os as _os
+    from kubernetes_tpu.util import websocket as ws
+    srv, kubelet, runtime, *_ = server
+    backend = socket.socket()
+    backend.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    backend.bind(("127.0.0.1", 0))
+    backend.listen(1)
+    bport = backend.getsockname()[1]
+
+    def echo():
+        conn, _ = backend.accept()
+        data = conn.recv(4096)
+        conn.sendall(b"pf:" + data)
+        conn.close()
+
+    threading.Thread(target=echo, daemon=True).start()
+    srv._dial = lambda pod, port: socket.create_connection(
+        ("127.0.0.1", bport), timeout=5)
+    kubelet.sync_pods([mkpod()])
+    wait_for_container(runtime, "u-1", "c")
+
+    s, leftover = _ws_upgrade(srv.port,
+                              "/portForward/default/web?port=80")
+    # send one masked binary frame with the payload
+    mask = _os.urandom(4)
+    payload = b"ping-bytes"
+    masked = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+    s.sendall(bytes([0x80 | ws.OP_BIN, 0x80 | len(payload)]) + mask + masked)
+    frames = _ws_collect(s, leftover)
+    s.close()
+    out = b"".join(p for op, p in frames if op == ws.OP_BIN)
+    assert out == b"pf:ping-bytes"

@@ -227,3 +227,17 @@ def test_restart_policy_never_leaves_pod_dead(cluster):
     assert cluster.wait_for(lambda: len(attempts()) >= 1, timeout=30.0)
     time.sleep(1.0)  # several resync periods
     assert attempts() == [0], f"RestartPolicy Never restarted: {attempts()}"
+
+
+def test_exec_stream_live_output(runtime):
+    """ProcessRuntime streams output chunks as produced, exit code last."""
+    pod = mk_pod("streamer", command=["sleep", "30"])
+    rt = runtime
+    rt.pull_image("local/script")
+    cid = rt.create_container(pod, pod.spec.containers[0], 0)
+    rt.start_container(cid)
+    items = list(rt.exec_stream_in_container(
+        cid, ["sh", "-c", "echo first; echo second; exit 3"]))
+    assert items[-1] == 3
+    out = b"".join(i for i in items[:-1])
+    assert out == b"first\nsecond\n"
