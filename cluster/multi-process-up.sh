@@ -26,6 +26,13 @@ python -m kubernetes_tpu.cmd.kubelet --api-servers "${MASTER}" \
     --hostname-override "$(hostname)" --register-node --port 10250 \
     --root-dir /tmp/kubelet-tpu &
 PIDS+=($!)
+# addons (ref: cluster/addons/{dns,cluster-monitoring})
+python -m kubernetes_tpu.cmd.dns --master "${MASTER}" --port 10053 &
+PIDS+=($!)
+python -m kubernetes_tpu.cmd.monitoring --master "${MASTER}" --port 10251 &
+PIDS+=($!)
 
 echo "control plane up: ${MASTER} (Ctrl-C to stop)"
+echo "  dns:        udp://127.0.0.1:10053  (<svc>.<ns>.cluster.local)"
+echo "  monitoring: http://127.0.0.1:10251/api/v1/model"
 wait
