@@ -149,7 +149,14 @@ class Cluster:
             handle.kubelet.run(handle.config)
             if self.config.kubelet_http:
                 from kubernetes_tpu.kubelet.server import KubeletServer
-                handle.server = KubeletServer(handle.kubelet).start()
+                stats = None
+                if self.config.process_runtime:
+                    from kubernetes_tpu.kubelet.stats import (
+                        ProcessRuntimeStatsProvider,
+                    )
+                    stats = ProcessRuntimeStatsProvider(handle.runtime)
+                handle.server = KubeletServer(handle.kubelet,
+                                              stats=stats).start()
         return self
 
     def node_locator(self, name: str):

@@ -115,7 +115,13 @@ def build_kubelet(opts):
         threading.Thread(target=register, daemon=True,
                          name="kubelet-register").start()
 
-    server = KubeletServer(kubelet, host=opts.address, port=opts.port)
+    stats = None
+    if opts.container_runtime == "process":
+        # per-container /proc accounting: each container is a real process
+        from kubernetes_tpu.kubelet.stats import ProcessRuntimeStatsProvider
+        stats = ProcessRuntimeStatsProvider(runtime)
+    server = KubeletServer(kubelet, host=opts.address, port=opts.port,
+                           stats=stats)
     return kubelet, pod_config, sources, server
 
 

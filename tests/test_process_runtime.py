@@ -241,3 +241,25 @@ def test_exec_stream_live_output(runtime):
     assert items[-1] == 3
     out = b"".join(i for i in items[:-1])
     assert out == b"first\nsecond\n"
+
+
+def test_process_runtime_container_stats(runtime):
+    """ProcessRuntimeStatsProvider reads real /proc accounting for a live
+    container process (the cAdvisor per-container seam)."""
+    from kubernetes_tpu.kubelet.stats import ProcessRuntimeStatsProvider
+
+    pod = mk_pod("stat-me", command=["sleep", "30"])
+    pod.metadata.uid = "uid-stat"
+    rt = runtime
+    rt.pull_image("local/script")
+    cid = rt.create_container(pod, pod.spec.containers[0], 0)
+    rt.start_container(cid)
+    provider = ProcessRuntimeStatsProvider(rt)
+    st = provider.container_stats("uid-stat", "main")
+    assert st is not None
+    assert st.memory_usage_bytes > 0          # VmRSS of a live sleep
+    assert st.cpu_usage_core_seconds >= 0.0
+    assert provider.container_stats("uid-stat", "ghost") is None
+    # node-level numbers still come from /proc
+    node = provider.node_stats()
+    assert node.memory_usage_bytes > 0
