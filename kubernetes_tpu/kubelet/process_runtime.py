@@ -327,6 +327,48 @@ class ProcessRuntime(ContainerRuntime):
         with self._lock:
             self._images.discard(image)
 
+    def group_stats(self, container_id: str):
+        """(cpu_seconds, rss_bytes) summed over the container's whole
+        process group via /proc, or None when the group is gone — each
+        container IS a process group (spawned with process_group=0), so
+        pgrp matching gives the cgroup-equivalent accounting cAdvisor
+        would report, including forked children."""
+        with self._lock:
+            p = self._procs.get(container_id)
+            if p is None or p.popen is None:
+                return None
+            self._refresh(p)
+            if not p.record.running:
+                return None
+            pgid = p.popen.pid
+        try:
+            hz = float(os.sysconf("SC_CLK_TCK"))
+        except (ValueError, OSError):
+            hz = 100.0
+        cpu = 0.0
+        rss = 0
+        found = False
+        for entry in os.listdir("/proc"):
+            if not entry.isdigit():
+                continue
+            try:
+                with open(f"/proc/{entry}/stat") as f:
+                    # fields after the parenthesised comm (which may hold
+                    # spaces): state, ppid, pgrp, ... utime@11, stime@12
+                    rest = f.read().rpartition(")")[2].split()
+                if int(rest[2]) != pgid:
+                    continue
+                found = True
+                cpu += (int(rest[11]) + int(rest[12])) / hz
+                with open(f"/proc/{entry}/status") as f:
+                    for line in f:
+                        if line.startswith("VmRSS:"):
+                            rss += int(line.split()[1]) * 1024
+                            break
+            except (OSError, ValueError, IndexError):
+                continue  # raced with an exiting group member
+        return (cpu, rss) if found else None
+
     def _exec_target(self, container_id: str):
         """(env, cwd) for a live container, or an error string — shared
         preamble of both exec paths; callers never hold the lock across

@@ -111,52 +111,27 @@ class ProcStatsProvider(StatsProvider):
 
 
 class ProcessRuntimeStatsProvider(ProcStatsProvider):
-    """Per-container accounting for the real ProcessRuntime: each container
-    IS a process group, so ``/proc/<pid>/stat`` (utime+stime in USER_HZ)
-    and ``/proc/<pid>/status`` (VmRSS) give exactly the cgroup numbers
-    cAdvisor would report for it (ref: pkg/kubelet/cadvisor + dockertools
-    container stats). Node-level numbers come from ProcStatsProvider."""
+    """Per-container accounting for the real ProcessRuntime (ref:
+    pkg/kubelet/cadvisor + dockertools container stats): the runtime's
+    locked ``group_stats`` sums utime+stime and VmRSS over the container's
+    whole process group — forked children included — and reports None for
+    dead groups so /stats 404s instead of serving zeros. Node-level
+    numbers come from ProcStatsProvider."""
 
     def __init__(self, runtime):
         self.runtime = runtime
-        try:
-            self._hz = os.sysconf("SC_CLK_TCK")
-        except (ValueError, OSError):
-            self._hz = 100
-
-    def _pid_for(self, pod_uid: str, container_name: str):
-        for rec in self.runtime.containers_for_pod(pod_uid):
-            if rec.parsed and rec.parsed[0] == container_name:
-                p = self.runtime._procs.get(rec.id)
-                if p is not None and p.popen is not None:
-                    return p.popen.pid
-        return None
 
     def container_stats(self, pod_uid, container_name):
-        pid = self._pid_for(pod_uid, container_name)
-        if pid is None:
-            return None
-        cpu_seconds = 0.0
-        rss = 0
-        try:
-            with open(f"/proc/{pid}/stat") as f:
-                # fields 14/15 (utime/stime) follow the parenthesised comm,
-                # which may itself contain spaces — split after it
-                rest = f.read().rpartition(")")[2].split()
-            cpu_seconds = (int(rest[11]) + int(rest[12])) / float(self._hz)
-        except (OSError, ValueError, IndexError):
-            pass
-        try:
-            with open(f"/proc/{pid}/status") as f:
-                for line in f:
-                    if line.startswith("VmRSS:"):
-                        rss = int(line.split()[1]) * 1024
-                        break
-        except (OSError, ValueError, IndexError):
-            pass
-        return ContainerStats(timestamp=time.time(),
-                              cpu_usage_core_seconds=cpu_seconds,
-                              memory_usage_bytes=rss)
+        for rec in self.runtime.containers_for_pod(pod_uid):
+            if rec.parsed and rec.parsed[0] == container_name:
+                gs = self.runtime.group_stats(rec.id)
+                if gs is None:
+                    return None
+                cpu, rss = gs
+                return ContainerStats(timestamp=time.time(),
+                                      cpu_usage_core_seconds=cpu,
+                                      memory_usage_bytes=rss)
+        return None
 
 
 class FakeStatsProvider(StatsProvider):

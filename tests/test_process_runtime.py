@@ -263,3 +263,22 @@ def test_process_runtime_container_stats(runtime):
     # node-level numbers still come from /proc
     node = provider.node_stats()
     assert node.memory_usage_bytes > 0
+
+
+def test_group_stats_include_forked_children(runtime):
+    """Accounting covers the whole process group, not just the leader."""
+    pod = mk_pod("forky", command=["sh", "-c",
+                                   "sleep 30 & sleep 30 & wait"])
+    pod.metadata.uid = "uid-forky"
+    rt = runtime
+    rt.pull_image("local/script")
+    cid = rt.create_container(pod, pod.spec.containers[0], 0)
+    rt.start_container(cid)
+    time.sleep(0.3)  # children spawn
+    gs = rt.group_stats(cid)
+    assert gs is not None
+    cpu, rss = gs
+    # leader sh + two sleeps: group RSS well above a single sleep's
+    assert rss > 200_000
+    rt.stop_container(cid)
+    assert rt.group_stats(cid) is None  # dead group -> None, not zeros
