@@ -630,8 +630,10 @@ def child(argv) -> int:
         "timing": "encode + host->device + solve(median of 3) + readback",
         "configs": configs,
     }
-    if args.cpu:
+    if args.cpu and not args.smoke:
         record["backend"] = "cpu (full shapes; TPU fallback record)"
+    elif args.cpu:
+        record["backend"] = "cpu (smoke shapes)"
     print(json.dumps(record))
     return 0
 

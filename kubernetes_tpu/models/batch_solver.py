@@ -277,9 +277,13 @@ def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
     # ---- batched Filter pre-pass (MXU) -----------------------------------
     static_mask = jnp.broadcast_to(inp.node_extra_ok[None, :], (P, N))
     if pol.use_selector:
-        # selector violations: required pairs the node lacks, exact f32 matmul
+        # selector violations: required pairs the node lacks. HIGHEST keeps
+        # the f32 accumulation exact on TPU (default MXU precision rounds
+        # inputs to bf16 — harmless for these 0/1 planes, but pinned so the
+        # decision path never depends on backend default precision).
         violations = jnp.dot(inp.pod_sel.astype(jnp.float32),
-                             (~inp.node_sel).astype(jnp.float32).T)  # [P, N]
+                             (~inp.node_sel).astype(jnp.float32).T,
+                             precision=jax.lax.Precision.HIGHEST)  # [P, N]
         static_mask = static_mask & (violations == 0)
     if pol.use_host:
         host_ok = (inp.pod_host_idx[:, None] == -1) | \
@@ -376,8 +380,14 @@ def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
             counts_eff = jnp.where(gid >= 0, counts_row, jnp.int32(0))
             num = jnp.sum(counts_eff)
             c = (counts_eff[:N] * feasible).astype(jnp.float32)
-            zc = inp.zone_onehot[a].T @ c                       # [V]
-            cnt = (inp.zone_onehot[a] @ zc).astype(jnp.int32)   # [N]
+            # Integer zone counts ride f32 matmuls; per-zone sums routinely
+            # exceed 256, so the TPU default (inputs rounded to bf16) would
+            # corrupt counts and flip decisions — HIGHEST is exact for
+            # integer values < 2^24.
+            hp = jax.lax.Precision.HIGHEST
+            zc = jnp.matmul(inp.zone_onehot[a].T, c, precision=hp)   # [V]
+            cnt = jnp.matmul(inp.zone_onehot[a], zc,
+                             precision=hp).astype(jnp.int32)         # [N]
             s = _spread_score(num, cnt)
             s = jnp.where(inp.zone_labeled[a], s, jnp.int32(0))
             score = score + s * w

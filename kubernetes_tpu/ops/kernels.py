@@ -9,7 +9,11 @@ Dtype policy: kernels follow their input dtypes. The solver feeds int32
 whenever the encoded wave fits (TPU v5e has no native int64 — every i64
 lane op is emulated as multiple i32 ops), falling back to int64 for
 clusters whose byte capacities don't reduce. Scores are always small
-(0..10 x weights) and returned in the resource dtype.
+(0..10 x weights) and returned in the resource dtype. One deliberate
+exception: ``spread_score`` always computes in int64 — its shift-and-
+divide emulation of IEEE-f32 rounding needs ~48 bits of headroom, and
+exactness beats the (tiny, per-step [N]-elementwise) emulated-i64 cost.
+It requires x64 mode and asserts so rather than silently truncating.
 """
 
 from __future__ import annotations
@@ -36,12 +40,61 @@ def calculate_score(requested: jnp.ndarray, capacity: jnp.ndarray) -> jnp.ndarra
 
 
 def spread_score(total: jnp.ndarray, counts: jnp.ndarray) -> jnp.ndarray:
-    """ServiceSpreading score: every operation in float32 then truncate —
-    bit-identical to Go's float32 evaluation (ref: spreading.go:76-80;
-    serial twin kubernetes_tpu.scheduler.priorities.spread_score_f32)."""
-    div = (total - counts).astype(jnp.float32) / total.astype(jnp.float32)
-    fscore = jnp.float32(10) * div
-    return jnp.where(total > 0, fscore.astype(jnp.int32), jnp.int32(10))
+    """ServiceSpreading score: ``int(10 * (f32(total-count) / f32(total)))``
+    with IEEE round-to-nearest-even semantics at every float32 step —
+    bit-identical to Go's evaluation (ref: spreading.go:76-80; serial twin
+    kubernetes_tpu.scheduler.priorities.spread_score_f32).
+
+    Implemented in exact int64 arithmetic, NOT ``jnp.float32`` division:
+    XLA lowers f32 division to reciprocal-multiply on both the TPU and CPU
+    backends, which is not correctly rounded (e.g. 154.0/154.0 evaluates to
+    0.99999994, truncating a perfect spread score of 10 down to 9 and
+    flipping scheduling decisions against the oracle). The integer path
+    emulates the two roundings exactly: q = RN24(a/b) via shift-and-divide
+    with round-half-even, then y = RN24(10*q), then truncate. Domain:
+    0 <= count <= total < 2^24 (counts are cluster-sized). Requires x64
+    (the solver's snapshot_to_inputs enables it) — without it the int64
+    upcasts would silently truncate to int32 and overflow the shifts."""
+    if not jax.config.jax_enable_x64:
+        raise RuntimeError(
+            "spread_score requires jax_enable_x64 (its exact-rounding "
+            "emulation shifts through ~48 bits); call "
+            "batch_solver.ensure_x64() first")
+    a = jnp.maximum(total - counts, 0).astype(jnp.int64)
+    b = jnp.broadcast_to(jnp.asarray(total, jnp.int64), a.shape)
+    safe_b = jnp.maximum(b, 1)
+    # exponents of f32(a), f32(b): exact for values < 2^24; frexp is a
+    # bit-level operation, trustworthy on every backend
+    ea = jnp.frexp(a.astype(jnp.float32))[1].astype(jnp.int64)
+    eb = jnp.frexp(safe_b.astype(jnp.float32))[1].astype(jnp.int64)
+    # choose k so m = (a << k) // b lands in [2^23, 2^24): a <= b makes
+    # k >= 23, and a < 2^ea bounds a << k0 below 2^47 — no i64 overflow
+    k0 = 23 + (eb - ea)
+    m0 = (a << k0) // safe_b
+    k = k0 + jnp.where(m0 < 2**23, 1, 0) - jnp.where(m0 >= 2**24, 1, 0)
+    q_num = a << k
+    m1 = q_num // safe_b
+    r = q_num - m1 * safe_b
+    # round to nearest, ties to even mantissa
+    m = m1 + (((2 * r > safe_b) | ((2 * r == safe_b) & (m1 & 1 == 1)))
+              ).astype(jnp.int64)
+    roll = m == 2**24
+    m = jnp.where(roll, 2**23, m)
+    k = k - roll.astype(jnp.int64)
+    # q = m * 2^-k is exactly RN_f32(a/b); now y = RN_f32(10 * q)
+    z = 10 * m                                   # < 2^28, exact
+    d = 3 + jnp.where(z >= 2**27, 1, 0)          # drop to 24 significant bits
+    half = jnp.int64(1) << (d - 1)
+    rem = z & ((jnp.int64(1) << d) - 1)
+    zm = (z >> d)
+    zm = zm + (((rem > half) | ((rem == half) & (zm & 1 == 1)))
+               ).astype(jnp.int64)
+    zroll = zm == 2**24
+    zm = jnp.where(zroll, 2**23, zm)
+    d = d + zroll.astype(jnp.int64)
+    # y = zm * 2^(d-k) with k-d >= 0: truncation is a right shift
+    score = (zm >> (k - d)).astype(jnp.int32)
+    return jnp.where(b > 0, score, jnp.int32(10))
 
 
 def u64_mod_small(hi: jnp.ndarray, lo: jnp.ndarray, m: jnp.ndarray) -> jnp.ndarray:

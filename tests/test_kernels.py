@@ -1,0 +1,73 @@
+"""Kernel-level regression tests for ops/kernels.py.
+
+The spread-score kernel must reproduce the serial oracle's float32
+semantics (priorities.spread_score_f32 — IEEE round-to-nearest at each
+step) EXACTLY on every backend. XLA lowers f32 division to
+reciprocal-multiply, which is not correctly rounded: 154.0/154.0
+evaluates to 0.99999994 and silently turns a perfect score of 10 into 9
+(the round-3 affinity-bench divergence). The kernel therefore computes
+the score in exact integer arithmetic; these tests pin that contract.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp
+
+from kubernetes_tpu.ops.kernels import calculate_score, spread_score
+from kubernetes_tpu.scheduler.priorities import spread_score_f32
+
+
+def batch_spread(totals, counts):
+    f = jax.jit(jax.vmap(lambda t, c: spread_score(t, jnp.array([c]))[0]))
+    return np.asarray(f(jnp.asarray(totals), jnp.asarray(counts)))
+
+
+def test_spread_score_reciprocal_misround_regression():
+    # 154/154 is the observed reciprocal-multiply misround: f32 recip gives
+    # 0.99999994 -> trunc 9; correct IEEE division gives exactly 1.0 -> 10.
+    totals = np.array([154, 154, 10, 10, 1, 7, 3], np.int64)
+    counts = np.array([0, 1, 0, 1, 0, 0, 1], np.int64)
+    got = batch_spread(totals, counts)
+    want = [spread_score_f32(int(t), int(c)) for t, c in zip(totals, counts)]
+    assert got.tolist() == want
+    assert got[0] == 10  # the regression case
+
+
+def test_spread_score_matches_f32_reference_randomized():
+    rng = np.random.RandomState(42)
+    totals = np.concatenate([
+        np.arange(1, 1024),                       # every small total
+        rng.randint(1, 2**24, 20000),             # cluster-scale totals
+    ])
+    counts = (totals * rng.uniform(0, 1, totals.shape)).astype(np.int64)
+    counts = np.minimum(counts, totals)
+    # boundary structure: count == 0 and count == total
+    totals = np.concatenate([totals, totals[:2000], totals[:2000]])
+    counts = np.concatenate([counts, np.zeros(2000, np.int64),
+                             totals[-2000:]])
+    want = np.array([spread_score_f32(int(t), int(c))
+                     for t, c in zip(totals, counts)], np.int32)
+    got = batch_spread(totals, counts)
+    bad = np.nonzero(got != want)[0]
+    assert len(bad) == 0, (
+        f"{len(bad)} mismatches, first: total={totals[bad[0]]} "
+        f"count={counts[bad[0]]} got={got[bad[0]]} want={want[bad[0]]}")
+
+
+def test_spread_score_zero_total_is_ten():
+    got = np.asarray(spread_score(jnp.int64(0), jnp.arange(4, dtype=jnp.int64)))
+    assert got.tolist() == [10, 10, 10, 10]
+
+
+@pytest.mark.parametrize("cap,req,want", [
+    (10, 0, 10), (10, 10, 0), (10, 5, 5), (3, 1, 6),
+    (0, 0, 0), (0, 5, 0), (10, 11, 0),
+])
+def test_calculate_score_go_integer_semantics(cap, req, want):
+    got = int(calculate_score(jnp.asarray([req], jnp.int64),
+                              jnp.asarray([cap], jnp.int64))[0])
+    assert got == want
