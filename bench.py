@@ -18,9 +18,12 @@ config, each with its own equivalence gate:
                   apiserver + reflectors (incremental encoder path)
 
 Honest timing: a wave costs encode + host->device transfer + solve +
-decision readback; all four are inside the clock (median of 3 solve runs,
-min also reported). Compile time is excluded (paid once per shape; pow-2
-bucketing bounds the shape count) but logged.
+decision readback; every timed run performs all four inside the clock and
+the reported wave is the median run (wave_s_min/wave_s_max bound the
+spread). Two once-per-shape costs are excluded but logged: XLA compilation
+(compile_s) and the transfer path's per-shape setup (shape_setup_s) —
+pow-2 bucketing bounds the shape count, and the churn config proves the
+steady-shape regime end-to-end through the live scheduler stack.
 
 Capture robustness: `python bench.py` runs a small parent harness that
 executes the real benchmark in a child subprocess with a per-attempt
@@ -48,6 +51,13 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+# reference target: 99% of decisions < 1s at 100 nodes / 3000 pods
+# (docs/roadmap.md:61) normalizes to 10k pods/s — see module docstring
+BASELINE_PODS_PER_S = 10_000.0
+TIMING_DESC = ("steady-state wave: encode + host->device + solve + readback "
+               "(median full-pipeline run; see timed_wave)")
+
+
 # --------------------------------------------------------------------------
 # Parent harness: never hang, never stack-trace, always one JSON line.
 # --------------------------------------------------------------------------
@@ -72,14 +82,14 @@ def parent(argv) -> int:
         # show both flag sets without spawning (or retrying) a child
         _child_parser().print_help()
         print("\ncapture-harness flags:\n"
-              "  --max-seconds S      overall watchdog budget (default 480)\n"
-              "  --attempt-seconds S  per-attempt timeout (default 300)\n"
+              "  --max-seconds S      overall watchdog budget (default 1200)\n"
+              "  --attempt-seconds S  per-attempt timeout (default 900)\n"
               "  --retries R          re-attempts after a crash/hang (default 3)")
         return 0
     ap = argparse.ArgumentParser(add_help=False)
-    ap.add_argument("--max-seconds", type=float, default=480.0,
+    ap.add_argument("--max-seconds", type=float, default=1200.0,
                     help="overall watchdog: total wall budget for all attempts")
-    ap.add_argument("--attempt-seconds", type=float, default=300.0,
+    ap.add_argument("--attempt-seconds", type=float, default=900.0,
                     help="timeout for a single child attempt")
     ap.add_argument("--retries", type=int, default=3,
                     help="max re-attempts after a crashed/hung child")
@@ -89,6 +99,7 @@ def parent(argv) -> int:
     cmd = [sys.executable, os.path.abspath(__file__), "--_child"] + child_args
     backoffs = [5.0, 15.0, 30.0, 30.0]
     last_err = "no attempt ran"
+    best_partial = None   # newest cumulative record from a crashed/hung child
 
     for attempt in range(args.retries + 1):
         remaining = deadline - time.monotonic()
@@ -105,13 +116,26 @@ def parent(argv) -> int:
                 return b.decode("utf-8", "replace") if isinstance(b, bytes) \
                     else (b or "")
             # the child may have printed its result and then hung in
-            # backend teardown — salvage the measurement before retrying
+            # backend teardown — a COMPLETE record (no "partial" marker) is
+            # final; a cumulative partial (or a final error record) means
+            # something went wrong mid-matrix, so retry and keep the partial
+            # only as a last-resort fallback
             line = _extract_json_line(_txt(e.stdout))
             if line is not None:
-                log(f"[bench] child hung after printing a result; using it")
-                print(line)
-                return 0
-            last_err = f"attempt {attempt + 1} timed out after {t:.0f}s"
+                obj = json.loads(line)
+                if "partial" not in obj:
+                    # complete success, or a deliberate failure verdict
+                    # ("error", e.g. an equivalence gate): deterministic
+                    # either way — final, retries won't change it
+                    log("[bench] child hung after printing a final "
+                        "result; using it")
+                    print(line)
+                    return 1 if "error" in obj else 0
+                best_partial = line
+                last_err = (f"attempt {attempt + 1} hung mid-matrix "
+                            f"(partial: {obj['partial']})")
+            else:
+                last_err = f"attempt {attempt + 1} timed out after {t:.0f}s"
             log(f"[bench] {last_err}; child stderr tail:\n"
                 f"{_txt(e.stderr)[-2000:]}")
         except OSError as e:
@@ -122,12 +146,21 @@ def parent(argv) -> int:
             sys.stderr.flush()
             line = _extract_json_line(p.stdout)
             if line is not None:
-                # A JSON verdict (even a failed equivalence gate) is final —
-                # deterministic results don't improve with retries.
-                print(line)
-                return p.returncode
-            last_err = (f"child exited rc={p.returncode} with no JSON; "
-                        f"stderr tail: {p.stderr[-500:].strip()!r}")
+                obj = json.loads(line)
+                if "partial" not in obj:
+                    # A complete verdict (success, or a deliberate failure
+                    # record carrying "error") is final — deterministic
+                    # results don't improve with retries.
+                    print(line)
+                    return p.returncode
+                # a crash mid-matrix left only a cumulative partial:
+                # transient faults deserve a retry; keep it as fallback
+                best_partial = line
+                last_err = (f"child crashed rc={p.returncode} mid-matrix "
+                            f"(partial: {obj['partial']})")
+            else:
+                last_err = (f"child exited rc={p.returncode} with no JSON; "
+                            f"stderr tail: {p.stderr[-500:].strip()!r}")
             log(f"[bench] {last_err}")
         if attempt < args.retries:
             pause = backoffs[min(attempt, len(backoffs) - 1)]
@@ -135,6 +168,12 @@ def parent(argv) -> int:
                 log(f"[bench] backing off {pause:.0f}s before retry")
                 time.sleep(pause)
 
+    if best_partial is not None:
+        # all retries spent; a partial measurement beats nothing, and its
+        # "partial" key says exactly which configs are missing
+        log(f"[bench] retries exhausted; emitting the best partial record")
+        print(best_partial)
+        return 1
     print(json.dumps({
         "metric": "pods_scheduled_per_sec",
         "value": 0.0,
@@ -206,10 +245,17 @@ def build_cluster(n_nodes: int, n_pods: int, n_services: int = 8,
 
 
 def timed_wave(nodes, existing, pending, services, batch_policy=None,
-               profile=None, runs: int = 3):
-    """One honest scheduling wave: encode + host->device transfer + solve +
-    decision readback, all inside the clock. Returns a result dict and the
-    decisions from the last run."""
+               profile=None, runs: int = 5):
+    """One honest scheduling wave, measured at steady state: every timed
+    run performs the FULL pipeline — snapshot encode (numpy), host->device
+    transfer, solve, decision readback (+ gang post-pass) — inside the
+    clock; the reported wave is the median run. One untimed warmup pass
+    first pays the per-shape costs a live scheduler pays once and then
+    never again: XLA compilation and the transfer path's per-shape setup
+    (the axon tunnel spends ~1.5s the first time it ships a given shape
+    set and ~10ms thereafter; pow-2 bucketing keeps the shape set finite,
+    which the churn config proves end-to-end). Both one-time costs are
+    logged. Returns a result dict and the decisions from the last run."""
     import jax
     import numpy as np
 
@@ -220,51 +266,61 @@ def timed_wave(nodes, existing, pending, services, batch_policy=None,
     )
     from kubernetes_tpu.models.snapshot import encode_snapshot
 
-    t0 = time.perf_counter()
+    # -- untimed warmup: compile + transfer-shape setup ---------------------
     snap = encode_snapshot(nodes, existing, pending, services,
                            policy=batch_policy)
-    encode_s = time.perf_counter() - t0
-
     gangs = snap.has_gangs
     t0 = time.perf_counter()
-    inp = snapshot_to_inputs(snap)          # jnp.asarray = host->device
+    inp = snapshot_to_inputs(snap)
     jax.block_until_ready(inp)
-    transfer_s = time.perf_counter() - t0
-
+    shape_setup_s = time.perf_counter() - t0
     t0 = time.perf_counter()
     out = solve_jit(inp, pol=snap.policy, gangs=gangs)
     jax.block_until_ready(out)
     compile_s = time.perf_counter() - t0
 
+    # -- timed steady-state runs: the whole pipeline in the clock -----------
     if profile:
         jax.profiler.start_trace(profile)
-    solve_runs = []
+    wave_runs, parts = [], []
     chosen_np = None
     for _ in range(runs):
         t0 = time.perf_counter()
+        snap = encode_snapshot(nodes, existing, pending, services,
+                               policy=batch_policy)
+        t1 = time.perf_counter()
+        inp = snapshot_to_inputs(snap)      # jnp.asarray = host->device
+        jax.block_until_ready(inp)
+        t2 = time.perf_counter()
         chosen, scores = solve_jit(inp, pol=snap.policy, gangs=gangs)
         chosen_np = np.asarray(chosen)      # device->host readback
         if gangs:
             chosen_np = gang_mod.apply_all_or_nothing(snap.pod_rid, chosen_np)
-        solve_runs.append(time.perf_counter() - t0)
+        t3 = time.perf_counter()
+        wave_runs.append(t3 - t0)
+        parts.append((t1 - t0, t2 - t1, t3 - t2))
     if profile:
         jax.profiler.stop_trace()
         log(f"jax.profiler trace written to {profile}")
 
-    solve_med = statistics.median(solve_runs)
-    wave_s = encode_s + transfer_s + solve_med
+    # the median RUN (upper middle for even counts): wave_s and its
+    # component breakdown come from the same run, so the parts sum to it
+    wave_med = sorted(wave_runs)[len(wave_runs) // 2]
+    encode_s, transfer_s, solve_s = parts[wave_runs.index(wave_med)]
     n = len(pending)
     res = {
         "pods": n,
         "nodes": len(nodes),
-        "value": round(n / wave_s, 1),
+        "value": round(n / wave_med, 1),
         "unit": "pods/s",
-        "wave_s": round(wave_s, 4),
+        "wave_s": round(wave_med, 4),
+        "wave_s_min": round(min(wave_runs), 4),
+        "wave_s_max": round(max(wave_runs), 4),
         "encode_s": round(encode_s, 4),
         "transfer_s": round(transfer_s, 4),
-        "solve_s_median": round(solve_med, 4),
-        "solve_s_min": round(min(solve_runs), 4),
+        "solve_readback_s": round(solve_s, 4),
         "compile_s": round(compile_s, 3),
+        "shape_setup_s": round(shape_setup_s, 3),
         "scheduled": int((chosen_np[:n] >= 0).sum()),
     }
     return res, snap, chosen_np
@@ -378,9 +434,11 @@ def run_solver_config(tag, n_nodes, n_pods, gate_nodes=0, gate_pods=0,
         log(f"[{tag}] all-or-nothing invariant OK: "
             f"{placed}/{gang_groups} groups fully placed")
 
-    log(f"[{tag}] wave {res['wave_s']:.3f}s = encode {res['encode_s']:.3f} "
-        f"+ transfer {res['transfer_s']:.3f} + solve {res['solve_s_median']:.4f} "
-        f"(min {res['solve_s_min']:.4f}); {res['value']:.0f} pods/s; "
+    log(f"[{tag}] wave {res['wave_s']:.3f}s (min {res['wave_s_min']:.3f} "
+        f"max {res['wave_s_max']:.3f}) = encode {res['encode_s']:.3f} "
+        f"+ transfer {res['transfer_s']:.3f} "
+        f"+ solve+readback {res['solve_readback_s']:.4f}; "
+        f"{res['value']:.0f} pods/s; "
         f"scheduled {res['scheduled']}/{res['pods']}")
     return res
 
@@ -576,6 +634,29 @@ def child(argv) -> int:
                     PolicyPriority(name="zoneSpread", weight=2,
                                    service_anti_affinity_label="zone")])
 
+    def build_record():
+        """One shape for every emission: success, cumulative partial
+        (missing configs listed under "partial"), and failure ("error")."""
+        primary = configs.get("north_star") or next(iter(configs.values()),
+                                                    None)
+        rec = {
+            "metric": "pods_scheduled_per_sec" if primary is None else
+                      f"pods_scheduled_per_sec_{primary['pods']}pods_"
+                      f"{primary['nodes']}nodes",
+            "value": 0.0 if primary is None else primary["value"],
+            "unit": "pods/s",
+            "vs_baseline": 0.0 if primary is None else
+                           round(primary["value"] / BASELINE_PODS_PER_S, 3),
+            "timing": TIMING_DESC,
+            "configs": configs,
+        }
+        if failed:
+            rec["value"], rec["vs_baseline"] = 0.0, 0.0
+            rec["error"] = f"failed configs: {failed}"
+        elif want - set(configs):
+            rec["partial"] = sorted(want - set(configs))
+        return rec
+
     def run(tag, fn, *a, **kw):
         if tag not in want:
             return
@@ -584,6 +665,12 @@ def child(argv) -> int:
             failed.append(tag)
         else:
             configs[tag] = r
+        # Emit the cumulative record after EVERY config — success or
+        # failure — so if the child later crashes or hangs, the parent's
+        # salvage finds the newest truth (a failure record supersedes the
+        # pre-failure partials on stdout).
+        if configs or failed:
+            print(json.dumps(build_record()), flush=True)
 
     # north star: budget-sized oracle gate over the FULL node axis (a
     # complete 10k x 5k serial oracle is ~20min; FULLGATE_r03.json records
@@ -610,32 +697,15 @@ def child(argv) -> int:
         20 if s else 500, 300 if s else 4_000,
         rate_pods_per_s=300 if s else 1_000)
 
-    primary = configs.get("north_star") or next(iter(configs.values()), None)
-    if primary is None or failed:
-        print(json.dumps({
-            "metric": "pods_scheduled_per_sec",
-            "value": 0.0, "unit": "pods/s", "vs_baseline": 0.0,
-            "error": f"failed configs: {failed or ['all']}",
-            "configs": configs,
-        }))
-        return 1
-
-    pods_per_sec = primary["value"]
-    record = {
-        "metric": f"pods_scheduled_per_sec_{primary['pods']}pods_"
-                  f"{primary['nodes']}nodes",
-        "value": pods_per_sec,
-        "unit": "pods/s",
-        "vs_baseline": round(pods_per_sec / 10_000.0, 3),
-        "timing": "encode + host->device + solve(median of 3) + readback",
-        "configs": configs,
-    }
+    record = build_record()
+    if not configs and not failed:
+        record["error"] = "no configs ran"
     if args.cpu and not args.smoke:
         record["backend"] = "cpu (full shapes; TPU fallback record)"
     elif args.cpu:
         record["backend"] = "cpu (smoke shapes)"
     print(json.dumps(record))
-    return 0
+    return 1 if (failed or not configs) else 0
 
 
 if __name__ == "__main__":
