@@ -41,7 +41,6 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import statistics
 import subprocess
 import sys
 import time
@@ -61,6 +60,17 @@ TIMING_DESC = ("steady-state wave: encode + host->device + solve + readback "
 # --------------------------------------------------------------------------
 # Parent harness: never hang, never stack-trace, always one JSON line.
 # --------------------------------------------------------------------------
+
+def _better_partial(current, candidate):
+    """Keep the partial record covering the most configs — a retry that
+    crashes earlier than a prior attempt must not discard measurements
+    the prior attempt already made."""
+    if current is None:
+        return candidate
+    missing_cur = len(json.loads(current).get("partial", []))
+    missing_new = len(json.loads(candidate).get("partial", []))
+    return candidate if missing_new < missing_cur else current
+
 
 def _extract_json_line(text: str):
     """Last line of `text` that parses as a JSON object, or None."""
@@ -131,7 +141,7 @@ def parent(argv) -> int:
                         "result; using it")
                     print(line)
                     return 1 if "error" in obj else 0
-                best_partial = line
+                best_partial = _better_partial(best_partial, line)
                 last_err = (f"attempt {attempt + 1} hung mid-matrix "
                             f"(partial: {obj['partial']})")
             else:
@@ -155,7 +165,7 @@ def parent(argv) -> int:
                     return p.returncode
                 # a crash mid-matrix left only a cumulative partial:
                 # transient faults deserve a retry; keep it as fallback
-                best_partial = line
+                best_partial = _better_partial(best_partial, line)
                 last_err = (f"child crashed rc={p.returncode} mid-matrix "
                             f"(partial: {obj['partial']})")
             else:
@@ -262,7 +272,7 @@ def timed_wave(nodes, existing, pending, services, batch_policy=None,
     from kubernetes_tpu.models import gang as gang_mod
     from kubernetes_tpu.models.batch_solver import (
         snapshot_to_inputs,
-        solve_jit,
+        solve_device,
     )
     from kubernetes_tpu.models.snapshot import encode_snapshot
 
@@ -270,12 +280,13 @@ def timed_wave(nodes, existing, pending, services, batch_policy=None,
     snap = encode_snapshot(nodes, existing, pending, services,
                            policy=batch_policy)
     gangs = snap.has_gangs
+    max_count0 = int(snap.group_counts.max(initial=0))
     t0 = time.perf_counter()
     inp = snapshot_to_inputs(snap)
     jax.block_until_ready(inp)
     shape_setup_s = time.perf_counter() - t0
     t0 = time.perf_counter()
-    out = solve_jit(inp, pol=snap.policy, gangs=gangs)
+    out = solve_device(inp, snap.policy, gangs, max_count0)
     jax.block_until_ready(out)
     compile_s = time.perf_counter() - t0
 
@@ -292,7 +303,7 @@ def timed_wave(nodes, existing, pending, services, batch_policy=None,
         inp = snapshot_to_inputs(snap)      # jnp.asarray = host->device
         jax.block_until_ready(inp)
         t2 = time.perf_counter()
-        chosen, scores = solve_jit(inp, pol=snap.policy, gangs=gangs)
+        chosen, scores = solve_device(inp, snap.policy, gangs, max_count0)
         chosen_np = np.asarray(chosen)      # device->host readback
         if gangs:
             chosen_np = gang_mod.apply_all_or_nothing(snap.pod_rid, chosen_np)
@@ -653,8 +664,14 @@ def child(argv) -> int:
         if failed:
             rec["value"], rec["vs_baseline"] = 0.0, 0.0
             rec["error"] = f"failed configs: {failed}"
-        elif want - set(configs):
-            rec["partial"] = sorted(want - set(configs))
+        # independent of "error": never-run configs stay visible even on a
+        # failure record (the parent also keys retry-vs-final off this)
+        if want - set(configs) - set(failed):
+            rec["partial"] = sorted(want - set(configs) - set(failed))
+        if args.cpu and not args.smoke:
+            rec["backend"] = "cpu (full shapes; TPU fallback record)"
+        elif args.cpu:
+            rec["backend"] = "cpu (smoke shapes)"
         return rec
 
     def run(tag, fn, *a, **kw):
@@ -700,10 +717,6 @@ def child(argv) -> int:
     record = build_record()
     if not configs and not failed:
         record["error"] = "no configs ran"
-    if args.cpu and not args.smoke:
-        record["backend"] = "cpu (full shapes; TPU fallback record)"
-    elif args.cpu:
-        record["backend"] = "cpu (smoke shapes)"
     print(json.dumps(record))
     return 1 if (failed or not configs) else 0
 

@@ -127,6 +127,17 @@ class Quantity:
     def __bool__(self):
         return self.value != 0
 
+    # Value-immutable: arithmetic returns new instances and the caches are
+    # pure memos, so isolation copies (the in-process transport and the
+    # store make one per request) can share the instance. This prunes the
+    # deepest, most object-heavy leaves out of every Pod deepcopy — the
+    # dominant cost of the create path at churn rates.
+    def __copy__(self):
+        return self
+
+    def __deepcopy__(self, memo):
+        return self
+
     # -- accessors ----------------------------------------------------------
     # memoized: the snapshot encoder calls these once per pod-resource per
     # wave and Fraction arithmetic dominates the host encode profile

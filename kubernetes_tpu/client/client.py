@@ -17,7 +17,6 @@ package's ListWatch sources, and the Fake client used by controller tests
 
 from __future__ import annotations
 
-import copy
 import threading
 from typing import Any, Callable, Dict, List, Optional
 
@@ -25,6 +24,7 @@ from kubernetes_tpu import watch as watchpkg
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.api.latest import scheme as default_scheme
 from kubernetes_tpu.client.cache import ListWatch
+from kubernetes_tpu.runtime.clone import deep_clone
 
 __all__ = ["Client", "InProcessTransport", "FakeClient", "FakeAction"]
 
@@ -39,11 +39,11 @@ class InProcessTransport:
     def _copy(self, obj):
         if obj is None:
             return None
-        # isolation copy, not a codec exercise: copy.deepcopy is ~2.4x
-        # faster than the wire round-trip and this is the hot path for
-        # every in-process request (the HTTP transport still round-trips
-        # through the real codec)
-        return copy.deepcopy(obj)
+        # isolation copy, not a codec exercise: deep_clone (runtime/clone)
+        # is ~4x faster than copy.deepcopy on API trees and this is the
+        # hot path for every in-process request (the HTTP transport still
+        # round-trips through the real codec)
+        return deep_clone(obj)
 
     def request(self, verb: str, resource: str, **kw) -> Any:
         body = kw.pop("body", None)

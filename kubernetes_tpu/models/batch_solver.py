@@ -80,7 +80,8 @@ from kubernetes_tpu.ops.kernels import (
     u64_mod_small as _u64_mod,
 )
 
-__all__ = ["solve", "solve_jit", "SolverInputs", "decisions_to_names"]
+__all__ = ["solve", "solve_jit", "solve_device", "SolverInputs",
+           "decisions_to_names"]
 
 NEG = -1  # masked score sentinel (scores are always >= 0)
 
@@ -458,12 +459,38 @@ def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
     return chosen, scores
 
 
+def solve_device(inp: SolverInputs, pol: Optional[BatchPolicy],
+                 gangs: bool, max_count0: int
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Compiled-solve dispatcher. Default-policy int32 waves on a real TPU
+    run the Pallas sequential-commit kernel (ops/pallas_solver — state
+    resident in VMEM, ~4.5x faster than the lax.scan at 10k x 5k and
+    bit-identical by construction); everything else takes the XLA scan.
+    ``KTPU_PALLAS``: auto (default, TPU only) | off | interpret (run the
+    kernel through the Pallas interpreter — any backend, tests)."""
+    import os
+
+    from kubernetes_tpu.ops import pallas_solver
+
+    mode = os.environ.get("KTPU_PALLAS", "auto")
+    use = (mode in ("auto", "interpret")
+           and pallas_solver.eligible(inp, pol or BatchPolicy(), gangs,
+                                      max_count0)
+           and (mode == "interpret" or jax.default_backend() == "tpu"))
+    if use:
+        return pallas_solver.solve_pallas(inp, pol=pol or BatchPolicy(),
+                                          interpret=(mode == "interpret"))
+    return solve_jit(inp, pol=pol, gangs=gangs)
+
+
 def solve(snap: ClusterSnapshot) -> Tuple[np.ndarray, np.ndarray]:
     """Host entry: encode -> device -> solve -> host decisions (including
     the all-or-nothing gang post-pass when the wave has PodGroups)."""
     inp = snapshot_to_inputs(snap)
     has_gangs = snap.has_gangs
-    chosen, scores = solve_jit(inp, pol=snap.policy, gangs=has_gangs)
+    chosen, scores = solve_device(
+        inp, snap.policy, has_gangs,
+        int(snap.group_counts.max(initial=0)))
     chosen = np.asarray(chosen)
     scores = np.asarray(scores)
     if has_gangs:

@@ -1,0 +1,485 @@
+"""Pallas TPU kernel for the default-policy sequential-commit solve.
+
+The XLA `lax.scan` in models/batch_solver.py dispatches ~45us of work per
+pod step; at 10k pending pods the north-star wave spends ~0.45s in the
+scan even though each step touches only ~200k vector elements. This
+module lowers the same sequential-commit loop to a single Pallas kernel:
+the mutable cluster state (per-dimension usage planes, port/PD bitmask
+words, per-service peer counts) lives in VMEM scratch that persists
+across grid steps, per-pod rows stream from HBM, and each step runs a
+handful of fused VPU ops plus two small MXU matmuls — no per-step HBM
+round-trips, no XLA loop overhead.
+
+Decisions are bit-identical to ``solve_jit`` (and therefore to the serial
+oracle) by construction: every score is computed in exact integer
+arithmetic, including the IEEE-float32 spread-score emulation
+(ops/kernels.spread_score rationale) re-derived here in pure int32 — the
+12-bit-limb long division replaces the int64 shift path because the TPU
+kernel type has no 64-bit lanes. The FNV-1a tie-break is a 16-bit-limb
+Horner modulo. The k-th-best selection uses triangular-matmul prefix
+ranks (exact: counts < 2^24 in f32 with HIGHEST precision).
+
+Scope (``eligible`` says so): the default-provider policy vocabulary —
+PodFitsResources/PodFitsPorts/NoDiskConflict/MatchNodeSelector/HostName
+filters (the selector/host/static masks ride the XLA MXU pre-pass, as in
+solve_jit) and LeastRequested/ServiceSpreading/Equal priorities, int32
+resource waves, no gangs. Affinity/anti-affinity/label-preference
+policies and gang waves fall back to the XLA scan; so do waves whose
+counts could reach 2^15 (the limb domains) or >32640 nodes.
+
+ref: pkg/scheduler/generic_scheduler.go:54-128 (the serial loop being
+batched), plugin/pkg/scheduler/scheduler.go:90-119 (commit-per-decision).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from kubernetes_tpu.models.policy import BatchPolicy
+
+__all__ = ["eligible", "solve_pallas"]
+
+LANES = 128
+NEG = -1
+
+# podrow lane layout (one packed [128] i32 row per pod)
+_REQ0 = 0          # R request values
+_PORTS0 = 8        # Wp port bitmask words (bitcast u32->i32)
+_PDS0 = 16         # Wd pd bitmask words
+_TIE0 = 24         # 4 big-endian 16-bit limbs of the FNV-1a u64
+_GID = 28
+_MEMBER = 29       # member bitmask over groups (G <= 31)
+_ZREQ = 30         # 1 when the pod requests zero of everything
+
+_MAX_R = 8
+_MAX_W = 8
+_MAX_G = 31        # member bitmask must fit a non-negative i32
+_MAX_N = 32640     # tie-break/limb domains need counts < 2^15
+_MAX_COUNT = 1 << 15
+
+
+def eligible(inp, pol: Optional[BatchPolicy], gangs: bool,
+             max_count0: int) -> bool:
+    """True when the wave is in the kernel's proven domain.
+
+    ``max_count0`` is the largest initial per-group peer count — the
+    caller reads it from the host-side snapshot (a device reduction here
+    would force a sync per wave)."""
+    if gangs or pol is None:
+        return False
+    if pol.has_affinity or pol.anti_affinity or pol.label_prefs:
+        return False
+    if pol.all_infeasible:
+        return False
+    if inp.cap.dtype != jnp.int32:
+        return False
+    N, R = inp.cap.shape
+    G = inp.group_counts.shape[0]
+    if not (R <= _MAX_R and inp.node_ports.shape[1] <= _MAX_W
+            and inp.node_pds.shape[1] <= _MAX_W and G <= _MAX_G
+            and N <= _MAX_N):
+        return False
+    # spread totals stay below 2^15: initial peers plus every wave commit
+    if max_count0 + inp.req.shape[0] >= _MAX_COUNT:
+        return False
+    return True
+
+
+def _exponent(x_f32: jnp.ndarray) -> jnp.ndarray:
+    """frexp-style exponent e with x = m * 2^e, m in [0.5, 1) — exact bit
+    extraction, valid for positive finite x. lax.bitcast_convert_type
+    lowers both in Mosaic and in the interpreter."""
+    bits = jax.lax.bitcast_convert_type(x_f32, jnp.int32)
+    return ((bits >> 23) & 0xFF) - 126
+
+
+def _spread_score_i32(total, counts):
+    """Exact int32 emulation of int(10 * (f32(total-count) / f32(total))):
+    the same two IEEE round-to-nearest-even steps as ops/kernels.
+    spread_score, but via 12-bit-limb long division (no 64-bit lanes on
+    the TPU kernel type). Domain: 0 <= count <= total < 2^15.
+
+    ``total`` is a 0-d scalar (the axon Mosaic compiler rejects [1,1]->
+    [NR,128] broadcasts; 0-d broadcasts lower fine), counts any 2D
+    block."""
+    a = jnp.maximum(total - counts, 0)
+    b = jnp.maximum(total, 1)
+    # exponents (a=0 guarded at the end; f32 conversion exact below 2^24).
+    # ea rides the vector bitcast; b is a 0-d scalar and tpu.bitcast only
+    # takes vectors, so its bit-length comes from 15 scalar compares.
+    ea = _exponent(jnp.maximum(a, 1).astype(jnp.float32))
+    eb = jnp.int32(0)
+    for j in range(15):
+        eb = eb + (b >= (1 << j)).astype(jnp.int32)
+    # significand m = RNE_24bit(a * 2^k / b), m in [2^23, 2^24)
+    k = 23 + eb - ea                       # a <= b so k >= 23; k <= 38
+    t = k % 12
+    s = k // 12                            # 1..3
+    v0 = a << t                            # < 2^27
+    q = v0 // b
+    r = v0 - q * b
+    for i in (1, 2, 3):                    # remaining 12-bit zero limbs
+        act = i <= s
+        x = r << 12
+        d = x // b
+        q = jnp.where(act, (q << 12) + d, q)
+        r = jnp.where(act, x - d * b, r)
+    # normalize into [2^23, 2^24): exact floor/remainder shift identities
+    lo = q < (1 << 23)
+    hi = q >= (1 << 24)
+    bit_up = ((r << 1) >= b) & lo
+    q2 = jnp.where(lo, (q << 1) + bit_up.astype(jnp.int32), q)
+    r2 = jnp.where(lo, (r << 1) - bit_up.astype(jnp.int32) * b, r)
+    q3 = jnp.where(hi, q2 >> 1, q2)
+    r3 = jnp.where(hi, (q2 & 1) * b + r2, r2)
+    k = k + lo.astype(jnp.int32) - hi.astype(jnp.int32)
+    # round to nearest, ties to even mantissa
+    m = q3 + (((r3 << 1) > b) | (((r3 << 1) == b) & (q3 & 1 == 1))
+              ).astype(jnp.int32)
+    roll = m == (1 << 24)
+    m = jnp.where(roll, 1 << 23, m)
+    k = k - roll.astype(jnp.int32)
+    # y = RN_f32(10 * q): 10*m < 2^28, drop to 24 significant bits
+    z = 10 * m
+    d2 = 3 + (z >= (1 << 27)).astype(jnp.int32)
+    half = 1 << (d2 - 1)
+    rem = z & ((1 << d2) - 1)
+    zm = z >> d2
+    zm = zm + ((rem > half) | ((rem == half) & (zm & 1 == 1))
+               ).astype(jnp.int32)
+    zroll = zm == (1 << 24)
+    zm = jnp.where(zroll, 1 << 23, zm)
+    d2 = d2 + zroll.astype(jnp.int32)
+    # trunc(y) with y = zm * 2^(d2-k). k-d2 ranges over [17, 35]; an i32
+    # shift by >= 32 is undefined (hardware masks mod 32), and zm < 2^24
+    # means any shift >= 24 is exactly 0 — clamp to keep it defined.
+    score = jnp.where(k - d2 >= 24, 0, zm >> jnp.minimum(k - d2, 23))
+    score = jnp.where(a == 0, 0, score)
+    return jnp.where(total > 0, score, 10)
+
+
+def _make_kernel(P, NR, PR, R, Wp, Wd, G, pol: BatchPolicy):
+    """Build the kernel body for static shapes/policy. Argument order:
+    inputs (smask, podrow, cap, fit0, score0, fitexc, ports0, pds0,
+    counts0, offl, advx), outputs (chosen, win), scratches (fit, score,
+    ports, pds, counts)."""
+    w_lr, w_spread, w_equal = pol.w_lr, pol.w_spread, pol.w_equal
+
+    def kernel(smask_ref, podrow_ref, cap_ref, fit0_ref, score0_ref,
+               fitexc_ref, ports0_ref, pds0_ref, counts0_ref, offl_ref,
+               advx_ref, chosen_ref, win_ref,
+               fit_ref, score_ref, ports_ref, pds_ref, counts_ref):
+        p = pl.program_id(0)
+
+        @pl.when(p == 0)
+        def _init():
+            fit_ref[:] = fit0_ref[:]
+            score_ref[:] = score0_ref[:]
+            ports_ref[:] = ports0_ref[:]
+            pds_ref[:] = pds0_ref[:]
+            counts_ref[:] = counts0_ref[:]
+            chosen_ref[:] = jnp.full_like(chosen_ref, NEG)
+            win_ref[:] = jnp.full_like(win_ref, NEG)
+
+        # NOTE: every per-pod quantity is extracted as a 0-d scalar
+        # (row[0, i]); the axon Mosaic compiler rejects [1,1]->[NR,128]
+        # broadcasts but lowers 0-d broadcasts fine.
+        row = podrow_ref[0]                          # [1, 128] i32
+        static_row = smask_ref[0]                    # [NR, 128] i8
+
+        # ---- Filter ------------------------------------------------------
+        feasible = static_row != 0
+        if pol.use_resources:
+            res_ok = jnp.ones((NR, LANES), jnp.bool_)
+            for r in range(R):
+                cap_r = cap_ref[r]
+                fit_r = fit_ref[r]
+                req_r = row[0, _REQ0 + r]                       # 0-d
+                ok_r = cap_r - fit_r >= req_r
+                if r < 2:
+                    # cpu/memory are unconstrained at zero capacity
+                    ok_r = ok_r | (cap_r == 0)
+                res_ok = res_ok & ok_r
+            zreq = row[0, _ZREQ] != 0                           # 0-d
+            feasible = feasible & (zreq | ((fitexc_ref[:] == 0) & res_ok))
+        if pol.use_ports:
+            conflict = jnp.zeros((NR, LANES), jnp.bool_)
+            for w in range(Wp):
+                pw = row[0, _PORTS0 + w]
+                conflict = conflict | ((ports_ref[w] & pw) != 0)
+            feasible = feasible & ~conflict
+        if pol.use_disk:
+            conflict = jnp.zeros((NR, LANES), jnp.bool_)
+            for w in range(Wd):
+                pw = row[0, _PDS0 + w]
+                conflict = conflict | ((pds_ref[w] & pw) != 0)
+            feasible = feasible & ~conflict
+
+        # ---- Score -------------------------------------------------------
+        score = jnp.zeros((NR, LANES), jnp.int32)
+        if w_lr:
+            total_sc = jnp.zeros((NR, LANES), jnp.int32)
+            n_dyn = jnp.int32(2)
+            for r in range(R):
+                cap_r = cap_ref[r]
+                req_r = row[0, _REQ0 + r]
+                tot_r = score_ref[r] + req_r
+                sc_r = ((cap_r - tot_r) * 10) // jnp.maximum(cap_r, 1)
+                sc_r = jnp.where((cap_r == 0) | (tot_r > cap_r), 0, sc_r)
+                total_sc = total_sc + sc_r
+                if r >= 2:
+                    # the serial divisor counts extra dims advertised by
+                    # some FEASIBLE node (generic_scheduler.go:70-75)
+                    adv = jnp.any((advx_ref[r] != 0) & feasible)
+                    n_dyn = n_dyn + adv.astype(jnp.int32)
+            score = score + (total_sc // n_dyn) * w_lr
+        gid = row[0, _GID]                                      # 0-d
+        if w_spread:
+            # counts row of the pod's first service via masked reduction
+            # (no dynamic VMEM indexing needed); gid < 0 matches no group
+            # so max_count = 0 and the score is the no-service 10.
+            counts_row = jnp.zeros((NR, LANES), jnp.int32)
+            off = jnp.int32(0)
+            for g in range(G):
+                gm = (gid == g).astype(jnp.int32)               # 0-d
+                counts_row = counts_row + counts_ref[g] * gm
+                off = off + offl_ref[g, 0] * gm
+            max_count = jnp.maximum(jnp.max(counts_row), off)   # 0-d
+            spread = _spread_score_i32(max_count, counts_row)
+            score = score + spread * w_spread
+        if w_equal:
+            score = score + w_equal
+        masked = jnp.where(feasible, score, NEG)
+
+        # ---- select host (deterministic tie-break) -----------------------
+        top = jnp.max(masked)
+        best = (masked == top) & feasible
+        cntb = jnp.maximum(jnp.sum(best.astype(jnp.int32)), 1)
+        # FNV-1a u64 mod cntb: 16-bit-limb Horner, every partial < 2^31
+        k_tie = jnp.int32(0)
+        for i in range(4):
+            limb = row[0, _TIE0 + i]                            # 0-d
+            k_tie = ((k_tie << 16) + limb) % cntb
+        # global inclusive rank of each best node, in node-index order:
+        # in-row prefix via upper-triangular MXU matmul, plus the exclusive
+        # prefix of full-row sums (exact: counts < 2^24 in f32/HIGHEST)
+        bf = best.astype(jnp.float32)
+        tri = (jax.lax.broadcasted_iota(jnp.int32, (LANES, LANES), 0) <=
+               jax.lax.broadcasted_iota(jnp.int32, (LANES, LANES), 1)
+               ).astype(jnp.float32)
+        within = jax.lax.dot(bf, tri,
+                             precision=jax.lax.Precision.HIGHEST)
+        # row totals replicated across lanes (bf @ ones), then the strict
+        # row-prefix — both as matmuls so no [NR,1]->[NR,128] broadcast
+        # (the axon Mosaic compiler rejects those)
+        ones = jnp.ones((LANES, LANES), jnp.float32)
+        row_tot = jax.lax.dot(bf, ones,
+                              precision=jax.lax.Precision.HIGHEST)
+        tri_r = (jax.lax.broadcasted_iota(jnp.int32, (NR, NR), 0) >
+                 jax.lax.broadcasted_iota(jnp.int32, (NR, NR), 1)
+                 ).astype(jnp.float32)
+        excl = jax.lax.dot(tri_r, row_tot,
+                           precision=jax.lax.Precision.HIGHEST)  # [NR, 128]
+        rank = (within + excl).astype(jnp.int32)
+        sel = best & (rank == k_tie + 1)                # one node or none
+        flat = (jax.lax.broadcasted_iota(jnp.int32, (NR, LANES), 0) * LANES
+                + jax.lax.broadcasted_iota(jnp.int32, (NR, LANES), 1))
+        any_f = top > NEG
+        chosen = jnp.where(any_f, jnp.sum(jnp.where(sel, flat, 0)),
+                           jnp.int32(NEG))
+
+        # ---- commit ------------------------------------------------------
+        onehot = sel                                     # all-False if none
+        for r in range(R):
+            req_r = row[0, _REQ0 + r]                    # 0-d
+            upd = jnp.where(onehot, req_r, 0)
+            fit_ref[r] = fit_ref[r] + upd
+            score_ref[r] = score_ref[r] + upd
+        for w in range(Wp):
+            pw = row[0, _PORTS0 + w]
+            ports_ref[w] = jnp.where(onehot, ports_ref[w] | pw,
+                                     ports_ref[w])
+        for w in range(Wd):
+            pw = row[0, _PDS0 + w]
+            pds_ref[w] = jnp.where(onehot, pds_ref[w] | pw, pds_ref[w])
+        member = row[0, _MEMBER]                         # 0-d
+        for g in range(G):
+            in_g = (member >> g) & 1                     # 0-d
+            counts_ref[g] = counts_ref[g] + \
+                jnp.where(onehot, in_g, 0)
+
+        # ---- write decision ----------------------------------------------
+        oh_p = ((jax.lax.broadcasted_iota(jnp.int32, (PR, LANES), 0)
+                 == p // LANES) &
+                (jax.lax.broadcasted_iota(jnp.int32, (PR, LANES), 1)
+                 == p % LANES))
+        chosen_ref[:] = jnp.where(oh_p, chosen, chosen_ref[:])
+        win_ref[:] = jnp.where(oh_p, jnp.where(any_f, top, NEG),
+                               win_ref[:])
+
+    return kernel
+
+
+def _pad_nodes(x, Npad, fill=0):
+    return jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, Npad - x.shape[-1])],
+                   constant_values=fill)
+
+
+@jax.jit
+def _tie_limbs(tie_hi, tie_lo):
+    """Split the FNV-1a u64 halves into 4 big-endian 16-bit limbs [P, 4]
+    i32. Runs under the ambient (x64) semantics — the only place the
+    pallas path touches a 64-bit array."""
+    hi = tie_hi.astype(jnp.uint64)
+    lo = tie_lo.astype(jnp.uint64)
+    return jnp.stack([((hi >> 16) & 0xFFFF).astype(jnp.int32),
+                      (hi & 0xFFFF).astype(jnp.int32),
+                      ((lo >> 16) & 0xFFFF).astype(jnp.int32),
+                      (lo & 0xFFFF).astype(jnp.int32)], axis=1)
+
+
+def solve_pallas(inp, pol: Optional[BatchPolicy] = None,
+                 interpret: bool = False
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Drop-in twin of ``solve_jit(inp, pol=pol, gangs=False)`` for
+    eligible waves. The XLA prolog (selector matmul, plane transposition,
+    pod-row packing) and the Pallas kernel compile into one program; use
+    ``interpret=True`` to run the kernel on CPU for tests.
+
+    The core jit runs (traces, lowers, compiles) under
+    ``jax.enable_x64(False)``: with x64 on, weak python-int literals in
+    the kernel body and in the BlockSpec index maps materialize as int64,
+    and the Mosaic TPU backend either rejects them or — for i64->i32
+    conversions routed through its ``_convert_helper`` fallback — recurses
+    forever. The only genuinely 64-bit inputs (the tie-break hashes) are
+    split into 16-bit limbs outside, under the ambient semantics."""
+    if pol is None:
+        pol = BatchPolicy()
+    limbs = _tie_limbs(inp.tie_hi, inp.tie_lo)
+    with jax.enable_x64(False):
+        return _solve_pallas_x32(
+            inp.cap, inp.advertises, inp.fit_used, inp.fit_exceeded,
+            inp.score_used, inp.node_ports, inp.node_sel, inp.node_pds,
+            inp.node_extra_ok, inp.req, inp.pod_ports, inp.pod_sel,
+            inp.pod_pds, inp.pod_host_idx, limbs, inp.pod_gid,
+            inp.pod_group_member, inp.group_counts,
+            pol=pol, interpret=interpret)
+
+
+@functools.partial(jax.jit, static_argnames=("pol", "interpret"))
+def _solve_pallas_x32(cap_in, advertises, fit_used, fit_exceeded,
+                      score_used, node_ports, node_sel, node_pds,
+                      node_extra_ok, req_in, pod_ports, pod_sel, pod_pds,
+                      pod_host_idx, tie_limbs, pod_gid, pod_group_member,
+                      group_counts, *, pol: BatchPolicy, interpret: bool
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    N, R = cap_in.shape
+    P = req_in.shape[0]
+    Wp = node_ports.shape[1]
+    Wd = node_pds.shape[1]
+    G = max(group_counts.shape[0], 1)
+    NR = max(1, -(-N // LANES))
+    Npad = NR * LANES
+    PR = max(1, -(-P // LANES))
+
+    arange_n = jnp.arange(N, dtype=jnp.int32)
+    # ---- static mask (the MXU pre-pass, identical to solve_jit) ----------
+    static_mask = jnp.broadcast_to(node_extra_ok[None, :], (P, N))
+    if pol.use_selector:
+        violations = jnp.dot(pod_sel.astype(jnp.float32),
+                             (~node_sel).astype(jnp.float32).T,
+                             precision=jax.lax.Precision.HIGHEST)
+        static_mask = static_mask & (violations == 0)
+    if pol.use_host:
+        host_ok = (pod_host_idx[:, None] == -1) | \
+                  (pod_host_idx[:, None] == arange_n[None, :])
+        static_mask = static_mask & host_ok
+    # int32, not int8: the axon Mosaic compiler 500s on int8 blocks in
+    # non-trivial kernels (empirically bisected); the extra HBM footprint
+    # (4 bytes/node/pod, ~200MB at 10k x 5k) streams at 20KB/step
+    smask = _pad_nodes(static_mask.astype(jnp.int32), Npad, 0)
+    smask = smask.reshape(P, NR, LANES)
+
+    # ---- node planes: [axis, NR, 128], padding infeasible ----------------
+    def plane(x, fill=0):
+        return _pad_nodes(x.T.astype(jnp.int32), Npad,
+                          fill).reshape(-1, NR, LANES)
+
+    cap = plane(cap_in)
+    fit0 = plane(fit_used)
+    score0 = plane(score_used)
+    fitexc = _pad_nodes(fit_exceeded.astype(jnp.int32)[None, :], Npad,
+                        1).reshape(NR, LANES)
+    ports0 = plane(jax.lax.bitcast_convert_type(node_ports, jnp.int32))
+    pds0 = plane(jax.lax.bitcast_convert_type(node_pds, jnp.int32))
+    gc = group_counts if group_counts.shape[0] else \
+        jnp.zeros((1, N + 1), jnp.int32)
+    counts0 = _pad_nodes(gc[:, :N].astype(jnp.int32), Npad, 0)
+    counts0 = counts0.reshape(G, NR, LANES)
+    offl = jnp.broadcast_to(gc[:, N:N + 1].astype(jnp.int32), (G, LANES))
+    advx = plane(advertises)
+
+    # ---- pod rows --------------------------------------------------------
+    podrow = jnp.zeros((P, LANES), jnp.int32)
+    podrow = podrow.at[:, _REQ0:_REQ0 + R].set(req_in.astype(jnp.int32))
+    podrow = podrow.at[:, _PORTS0:_PORTS0 + Wp].set(
+        jax.lax.bitcast_convert_type(pod_ports, jnp.int32))
+    podrow = podrow.at[:, _PDS0:_PDS0 + Wd].set(
+        jax.lax.bitcast_convert_type(pod_pds, jnp.int32))
+    podrow = podrow.at[:, _TIE0:_TIE0 + 4].set(tie_limbs)
+    podrow = podrow.at[:, _GID].set(pod_gid.astype(jnp.int32))
+    member_bits = jnp.sum(
+        pod_group_member.astype(jnp.int32)
+        * (jnp.int32(1) << jnp.arange(pod_group_member.shape[1],
+                                      dtype=jnp.int32)
+           )[None, :], axis=1) if pod_group_member.shape[1] else \
+        jnp.zeros(P, jnp.int32)
+    podrow = podrow.at[:, _MEMBER].set(member_bits)
+    podrow = podrow.at[:, _ZREQ].set(
+        jnp.all(req_in == 0, axis=1).astype(jnp.int32))
+
+    kernel = _make_kernel(P, NR, PR, R, Wp, Wd, G, pol)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=0,
+        grid=(P,),
+        in_specs=[
+            pl.BlockSpec((1, NR, LANES), lambda p: (p, 0, 0)),   # smask
+            pl.BlockSpec((1, 1, LANES), lambda p: (p, 0, 0)),    # podrow
+            pl.BlockSpec(cap.shape, lambda p: (0, 0, 0)),        # cap
+            pl.BlockSpec(fit0.shape, lambda p: (0, 0, 0)),
+            pl.BlockSpec(score0.shape, lambda p: (0, 0, 0)),
+            pl.BlockSpec(fitexc.shape, lambda p: (0, 0)),
+            pl.BlockSpec(ports0.shape, lambda p: (0, 0, 0)),
+            pl.BlockSpec(pds0.shape, lambda p: (0, 0, 0)),
+            pl.BlockSpec((G, NR, LANES), lambda p: (0, 0, 0)),   # counts0
+            pl.BlockSpec((G, LANES), lambda p: (0, 0)),          # offl
+            pl.BlockSpec(advx.shape, lambda p: (0, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((PR, LANES), lambda p: (0, 0)),
+            pl.BlockSpec((PR, LANES), lambda p: (0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((R, NR, LANES), jnp.int32),   # fit
+            pltpu.VMEM((R, NR, LANES), jnp.int32),   # score_used
+            pltpu.VMEM((Wp, NR, LANES), jnp.int32),  # ports
+            pltpu.VMEM((Wd, NR, LANES), jnp.int32),  # pds
+            pltpu.VMEM((G, NR, LANES), jnp.int32),   # counts
+        ],
+    )
+    chosen2d, win2d = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((PR, LANES), jnp.int32),
+                   jax.ShapeDtypeStruct((PR, LANES), jnp.int32)],
+        interpret=interpret,
+    )(smask, podrow.reshape(P, 1, LANES), cap, fit0, score0, fitexc,
+      ports0, pds0, counts0, offl, advx)
+    return chosen2d.reshape(-1)[:P], win2d.reshape(-1)[:P]

@@ -1,0 +1,66 @@
+"""Fast isolation copies of API object trees.
+
+The in-process transport and the store each take one isolation copy per
+request (simulating the HTTP boundary's value semantics — ref: the real
+boundary at pkg/client/request.go, where every object crosses as bytes).
+``copy.deepcopy`` pays memo bookkeeping and reduce-protocol dispatch on
+every leaf (~340 dispatches per Pod), which caps the in-process create
+path around 700 pods/s — below the churn benchmark's 1k pods/s offered
+load. ``deep_clone`` exploits what the codec guarantees about API
+objects: they are trees (no cycles, no aliasing that must be preserved)
+built from dataclasses, dicts, lists, tuples, and atomic leaves.
+
+Falls back to copy.deepcopy for anything unrecognized, so correctness
+never depends on the fast path's coverage.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import datetime
+from enum import Enum
+
+from kubernetes_tpu.api.quantity import Quantity
+
+__all__ = ["deep_clone"]
+
+_ATOMIC = frozenset({
+    str, int, float, bool, bytes, type(None),
+    datetime.datetime, datetime.date, datetime.timedelta,
+    Quantity,          # value-immutable (api/quantity.py __deepcopy__)
+})
+
+# class -> tuple of field names, resolved once per dataclass type
+_FIELDS: dict = {}
+
+
+def _fields_of(cls):
+    f = _FIELDS.get(cls)
+    if f is None:
+        f = tuple(fld.name for fld in dataclasses.fields(cls))
+        _FIELDS[cls] = f
+    return f
+
+
+def deep_clone(obj):
+    """Value-semantics copy of an API object tree."""
+    cls = obj.__class__
+    if cls in _ATOMIC:
+        return obj
+    if cls is dict:
+        return {k: deep_clone(v) for k, v in obj.items()}
+    if cls is list:
+        return [deep_clone(v) for v in obj]
+    if cls is tuple:
+        return tuple(deep_clone(v) for v in obj)
+    if isinstance(obj, Enum):
+        return obj
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        new = object.__new__(cls)
+        d = obj.__dict__
+        nd = new.__dict__
+        for name in _fields_of(cls):
+            nd[name] = deep_clone(d[name])
+        return new
+    return copy.deepcopy(obj)

@@ -9,7 +9,7 @@ controller relies on for optimistic concurrency.
 
 from __future__ import annotations
 
-import copy
+from kubernetes_tpu.runtime.clone import deep_clone
 import threading
 from typing import Any, Callable, Optional, Type
 
@@ -66,7 +66,7 @@ class StoreHelper:
             kv = self.store.create(key, self._encode(obj), ttl=ttl)
         except ErrKeyExists:
             raise errors.new_already_exists(accessor.kind(obj), accessor.name(obj))
-        out = copy.deepcopy(obj)  # isolation copy; codec runs in _encode
+        out = deep_clone(obj)  # isolation copy; codec runs in _encode
         accessor.set_resource_version(out, str(kv.modified_index))
         return out
 
@@ -83,7 +83,7 @@ class StoreHelper:
             raise errors.new_conflict(accessor.kind(obj), accessor.name(obj))
         except ErrKeyNotFound:
             raise errors.new_not_found(accessor.kind(obj), accessor.name(obj))
-        out = copy.deepcopy(obj)  # isolation copy; codec runs in _encode
+        out = deep_clone(obj)  # isolation copy; codec runs in _encode
         accessor.set_resource_version(out, str(kv.modified_index))
         return out
 
@@ -142,7 +142,7 @@ class StoreHelper:
                     kv = self.store.compare_and_swap(key, encoded, prev_index, ttl=ttl)
             except (ErrCASConflict, ErrKeyExists, ErrKeyNotFound):
                 continue  # re-read and retry
-            out = copy.deepcopy(desired)  # isolation copy; codec runs in _encode
+            out = deep_clone(desired)  # isolation copy; codec runs in _encode
             accessor.set_resource_version(out, str(kv.modified_index))
             return out
         raise errors.new_conflict(obj_type.__name__, key, "too many CAS retries")
@@ -190,7 +190,7 @@ class StoreHelper:
                 elif isinstance(oc, Exception):
                     results[i] = errors.new_internal_error(str(oc))
                 else:
-                    out = copy.deepcopy(desired)
+                    out = deep_clone(desired)
                     accessor.set_resource_version(out, str(oc.modified_index))
                     results[i] = out
         for i in live:

@@ -1,0 +1,176 @@
+"""Pallas sequential-commit kernel vs the XLA scan (and the oracle).
+
+The kernel must be bit-identical to solve_jit for every eligible wave —
+same chosen hosts AND same winning scores. On CPU the kernel runs through
+the Pallas interpreter (interpret=True), which executes the same jaxpr
+the Mosaic path compiles, so the integer-exactness arguments carry over;
+the real-TPU equivalence is additionally pinned by bench.py's oracle
+gates on every benchmark run.
+"""
+
+import random
+
+import jax
+import numpy as np
+import pytest
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.quantity import Quantity
+from kubernetes_tpu.models.batch_solver import (
+    snapshot_to_inputs,
+    solve_device,
+    solve_jit,
+)
+from kubernetes_tpu.models.policy import BatchPolicy
+from kubernetes_tpu.models.snapshot import encode_snapshot
+from kubernetes_tpu.ops import pallas_solver
+from kubernetes_tpu.scheduler.priorities import spread_score_f32
+
+
+def mk_node(name, cpu_m=4000, mem=8 << 30, labels=None):
+    return api.Node(
+        metadata=api.ObjectMeta(name=name, labels=labels or {}),
+        spec=api.NodeSpec(capacity={
+            "cpu": Quantity(f"{cpu_m}m"), "memory": Quantity(str(mem))}))
+
+
+def mk_pod(name, cpu_m=0, mem=0, host="", labels=None, ports=(),
+           selector=None):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace="default",
+                                uid=f"uid-{name}", labels=labels or {}),
+        spec=api.PodSpec(
+            host=host, node_selector=selector or {},
+            containers=[api.Container(
+                name="c", image="img",
+                ports=[api.ContainerPort(container_port=p, host_port=p)
+                       for p in ports],
+                resources=api.ResourceRequirements(limits={
+                    "cpu": Quantity(f"{cpu_m}m"),
+                    "memory": Quantity(str(mem))}))]),
+        status=api.PodStatus(host=host))
+
+
+def fuzz_wave(seed, n_nodes=11, n_pods=17, n_services=3):
+    rng = random.Random(seed)
+    nodes = [mk_node(f"n-{i:03d}", cpu_m=rng.choice([2000, 4000, 8000]),
+                     labels={"zone": f"z{i % 3}"})
+             for i in range(n_nodes)]
+    existing = []
+    for i in range(n_pods // 2):
+        existing.append(mk_pod(
+            f"old-{i}", cpu_m=rng.randrange(0, 1000, 100),
+            mem=rng.randrange(0, 1 << 30, 1 << 28),
+            host=rng.choice(nodes).metadata.name,
+            labels={"app": f"a{rng.randrange(n_services)}"}))
+    pending = []
+    for i in range(n_pods):
+        pending.append(mk_pod(
+            f"new-{i}", cpu_m=rng.randrange(0, 3000, 100),
+            mem=rng.randrange(0, 2 << 30, 1 << 28),
+            labels={"app": f"a{rng.randrange(n_services)}"},
+            ports=[7000 + rng.randrange(4)] if rng.random() < 0.3 else ()))
+    services = [api.Service(
+        metadata=api.ObjectMeta(name=f"s{s}", namespace="default"),
+        spec=api.ServiceSpec(port=80, selector={"app": f"a{s}"}))
+        for s in range(n_services)]
+    return nodes, existing, pending, services
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_interpret_matches_solve_jit(seed):
+    nodes, existing, pending, services = fuzz_wave(seed)
+    snap = encode_snapshot(nodes, existing, pending, services)
+    inp = snapshot_to_inputs(snap)
+    assert pallas_solver.eligible(inp, snap.policy or BatchPolicy(), False,
+                                  int(snap.group_counts.max(initial=0)))
+    c1, s1 = solve_jit(inp, pol=snap.policy, gangs=False)
+    c2, s2 = pallas_solver.solve_pallas(inp, pol=snap.policy,
+                                        interpret=True)
+    assert np.array_equal(np.asarray(c1), np.asarray(c2))
+    assert np.array_equal(np.asarray(s1), np.asarray(s2))
+
+
+def test_interpret_matches_with_custom_weights():
+    nodes, existing, pending, services = fuzz_wave(99)
+    pol = BatchPolicy(w_lr=2, w_spread=3, w_equal=1)
+    snap = encode_snapshot(nodes, existing, pending, services, policy=pol)
+    inp = snapshot_to_inputs(snap)
+    c1, s1 = solve_jit(inp, pol=pol, gangs=False)
+    c2, s2 = pallas_solver.solve_pallas(inp, pol=pol, interpret=True)
+    assert np.array_equal(np.asarray(c1), np.asarray(c2))
+    assert np.array_equal(np.asarray(s1), np.asarray(s2))
+
+
+def test_unschedulable_pods_get_minus_one():
+    nodes = [mk_node("n-0", cpu_m=1000)]
+    pending = [mk_pod(f"p-{i}", cpu_m=800) for i in range(3)]
+    snap = encode_snapshot(nodes, [], pending, [])
+    inp = snapshot_to_inputs(snap)
+    c, s = pallas_solver.solve_pallas(inp, pol=snap.policy, interpret=True)
+    c = np.asarray(c)
+    assert c[0] == 0 and c[1] == -1 and c[2] == -1
+    c1, _ = solve_jit(inp, pol=snap.policy, gangs=False)
+    assert np.array_equal(c, np.asarray(c1))
+
+
+def test_eligibility_gates():
+    nodes, existing, pending, services = fuzz_wave(1)
+    snap = encode_snapshot(nodes, existing, pending, services)
+    inp = snapshot_to_inputs(snap)
+    pol = snap.policy or BatchPolicy()
+    assert pallas_solver.eligible(inp, pol, False, 10)
+    # gangs, affinity-bearing policies, i64 waves, count overflow: all fall
+    # back to the XLA scan
+    assert not pallas_solver.eligible(inp, pol, True, 10)
+    aff = BatchPolicy(anti_affinity=(("zone", 1),))
+    assert not pallas_solver.eligible(inp, aff, False, 10)
+    labeled = BatchPolicy(affinity_labels=("region",))
+    assert not pallas_solver.eligible(inp, labeled, False, 10)
+    assert not pallas_solver.eligible(inp, pol, False, 1 << 15)
+    i64 = inp._replace(cap=inp.cap.astype(jnp.int64))
+    assert not pallas_solver.eligible(i64, pol, False, 10)
+
+
+def test_solve_device_honors_mode_env(monkeypatch):
+    nodes, existing, pending, services = fuzz_wave(2)
+    snap = encode_snapshot(nodes, existing, pending, services)
+    inp = snapshot_to_inputs(snap)
+    mc = int(snap.group_counts.max(initial=0))
+    monkeypatch.setenv("KTPU_PALLAS", "off")
+    c_off, s_off = solve_device(inp, snap.policy, False, mc)
+    monkeypatch.setenv("KTPU_PALLAS", "interpret")
+    c_int, s_int = solve_device(inp, snap.policy, False, mc)
+    assert np.array_equal(np.asarray(c_off), np.asarray(c_int))
+    assert np.array_equal(np.asarray(s_off), np.asarray(s_int))
+
+
+def test_spread_score_i32_matches_f32_reference():
+    rng = np.random.RandomState(7)
+    totals = np.concatenate([np.arange(1, 600),
+                             rng.randint(1, 1 << 15, 4000),
+                             # max-shift regression: a=1 with a power-of-two
+                             # total drives the final truncation shift to
+                             # k-d2=35, where an unclamped i32 shift is UB
+                             # (mod-32 on TPU would return garbage)
+                             [4096, 8192, 16384, 32767]])
+    counts = (totals[:4599] * rng.uniform(0, 1, 4599)).astype(np.int64)
+    counts = np.minimum(counts, totals[:4599])
+    counts = np.concatenate([counts, [4095, 8191, 16383, 32766]])
+    totals = np.concatenate([totals, totals[:500], totals[:500], [0]])
+    counts = np.concatenate([counts, np.zeros(500, np.int64),
+                             totals[-501:-1], [0]])
+    f = jax.jit(jax.vmap(lambda t, c: pallas_solver._spread_score_i32(
+        t, jnp.reshape(c, (1, 1)))[0, 0]))
+    got = np.asarray(f(jnp.asarray(totals, jnp.int32),
+                       jnp.asarray(counts, jnp.int32)))
+    want = np.array([spread_score_f32(int(t), int(c)) if t > 0 else 10
+                     for t, c in zip(totals, counts)], np.int32)
+    bad = np.nonzero(got != want)[0]
+    assert len(bad) == 0, (
+        f"{len(bad)} mismatches, first: total={totals[bad[0]]} "
+        f"count={counts[bad[0]]} got={got[bad[0]]} want={want[bad[0]]}")
