@@ -53,8 +53,8 @@ def log(*a):
 # reference target: 99% of decisions < 1s at 100 nodes / 3000 pods
 # (docs/roadmap.md:61) normalizes to 10k pods/s — see module docstring
 BASELINE_PODS_PER_S = 10_000.0
-TIMING_DESC = ("steady-state wave: encode + host->device + solve + readback "
-               "(median full-pipeline run; see timed_wave)")
+TIMING_DESC = ("steady-state wave: encode + pipelined host->device + solve "
+               "+ readback (median full-pipeline run; see timed_wave)")
 
 
 # --------------------------------------------------------------------------
@@ -62,14 +62,15 @@ TIMING_DESC = ("steady-state wave: encode + host->device + solve + readback "
 # --------------------------------------------------------------------------
 
 def _better_partial(current, candidate):
-    """Keep the partial record covering the most configs — a retry that
-    crashes earlier than a prior attempt must not discard measurements
-    the prior attempt already made."""
+    """Keep the partial record carrying the most MEASURED configs — a
+    retry that crashes earlier (or whose configs failed on a degraded
+    backend, which removes them from "partial" without measuring them)
+    must not displace real measurements a prior attempt already made."""
     if current is None:
         return candidate
-    missing_cur = len(json.loads(current).get("partial", []))
-    missing_new = len(json.loads(candidate).get("partial", []))
-    return candidate if missing_new < missing_cur else current
+    measured_cur = len(json.loads(current).get("configs", {}))
+    measured_new = len(json.loads(candidate).get("configs", {}))
+    return candidate if measured_new > measured_cur else current
 
 
 def _extract_json_line(text: str):
@@ -271,6 +272,7 @@ def timed_wave(nodes, existing, pending, services, batch_policy=None,
 
     from kubernetes_tpu.models import gang as gang_mod
     from kubernetes_tpu.models.batch_solver import (
+        peer_bound_of,
         snapshot_to_inputs,
         solve_device,
     )
@@ -280,13 +282,13 @@ def timed_wave(nodes, existing, pending, services, batch_policy=None,
     snap = encode_snapshot(nodes, existing, pending, services,
                            policy=batch_policy)
     gangs = snap.has_gangs
-    max_count0 = int(snap.group_counts.max(initial=0))
+    peer_bound = peer_bound_of(snap)
     t0 = time.perf_counter()
     inp = snapshot_to_inputs(snap)
     jax.block_until_ready(inp)
     shape_setup_s = time.perf_counter() - t0
     t0 = time.perf_counter()
-    out = solve_device(inp, snap.policy, gangs, max_count0)
+    out = solve_device(inp, snap.policy, gangs, peer_bound)
     jax.block_until_ready(out)
     compile_s = time.perf_counter() - t0
 
@@ -300,16 +302,18 @@ def timed_wave(nodes, existing, pending, services, batch_policy=None,
         snap = encode_snapshot(nodes, existing, pending, services,
                                policy=batch_policy)
         t1 = time.perf_counter()
+        # no sync between transfer and solve: dispatch pipelines the
+        # uploads into the device call (one tunnel round-trip per wave
+        # instead of two — exactly what a live scheduler does); the
+        # decision readback is the sync
         inp = snapshot_to_inputs(snap)      # jnp.asarray = host->device
-        jax.block_until_ready(inp)
-        t2 = time.perf_counter()
-        chosen, scores = solve_device(inp, snap.policy, gangs, max_count0)
-        chosen_np = np.asarray(chosen)      # device->host readback
+        chosen, scores = solve_device(inp, snap.policy, gangs, peer_bound)
+        chosen_np = np.asarray(chosen)      # device->host readback (sync)
         if gangs:
             chosen_np = gang_mod.apply_all_or_nothing(snap.pod_rid, chosen_np)
-        t3 = time.perf_counter()
-        wave_runs.append(t3 - t0)
-        parts.append((t1 - t0, t2 - t1, t3 - t2))
+        t2 = time.perf_counter()
+        wave_runs.append(t2 - t0)
+        parts.append((t1 - t0, t2 - t1))
     if profile:
         jax.profiler.stop_trace()
         log(f"jax.profiler trace written to {profile}")
@@ -317,7 +321,7 @@ def timed_wave(nodes, existing, pending, services, batch_policy=None,
     # the median RUN (upper middle for even counts): wave_s and its
     # component breakdown come from the same run, so the parts sum to it
     wave_med = sorted(wave_runs)[len(wave_runs) // 2]
-    encode_s, transfer_s, solve_s = parts[wave_runs.index(wave_med)]
+    encode_s, device_s = parts[wave_runs.index(wave_med)]
     n = len(pending)
     res = {
         "pods": n,
@@ -328,8 +332,7 @@ def timed_wave(nodes, existing, pending, services, batch_policy=None,
         "wave_s_min": round(min(wave_runs), 4),
         "wave_s_max": round(max(wave_runs), 4),
         "encode_s": round(encode_s, 4),
-        "transfer_s": round(transfer_s, 4),
-        "solve_readback_s": round(solve_s, 4),
+        "device_s": round(device_s, 4),
         "compile_s": round(compile_s, 3),
         "shape_setup_s": round(shape_setup_s, 3),
         "scheduled": int((chosen_np[:n] >= 0).sum()),
@@ -447,8 +450,7 @@ def run_solver_config(tag, n_nodes, n_pods, gate_nodes=0, gate_pods=0,
 
     log(f"[{tag}] wave {res['wave_s']:.3f}s (min {res['wave_s_min']:.3f} "
         f"max {res['wave_s_max']:.3f}) = encode {res['encode_s']:.3f} "
-        f"+ transfer {res['transfer_s']:.3f} "
-        f"+ solve+readback {res['solve_readback_s']:.4f}; "
+        f"+ device(transfer+solve+readback) {res['device_s']:.4f}; "
         f"{res['value']:.0f} pods/s; "
         f"scheduled {res['scheduled']}/{res['pods']}")
     return res

@@ -460,14 +460,15 @@ def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
 
 
 def solve_device(inp: SolverInputs, pol: Optional[BatchPolicy],
-                 gangs: bool, max_count0: int
+                 gangs: bool, peer_bound: int
                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Compiled-solve dispatcher. Default-policy int32 waves on a real TPU
-    run the Pallas sequential-commit kernel (ops/pallas_solver — state
-    resident in VMEM, ~4.5x faster than the lax.scan at 10k x 5k and
-    bit-identical by construction); everything else takes the XLA scan.
-    ``KTPU_PALLAS``: auto (default, TPU only) | off | interpret (run the
-    kernel through the Pallas interpreter — any backend, tests)."""
+    """Compiled-solve dispatcher. Default-policy int32 waves (gang or
+    not) on a real TPU run the Pallas sequential-commit kernel
+    (ops/pallas_solver — state resident in VMEM, ~4.5x faster than the
+    lax.scan at 10k x 5k and bit-identical by construction); everything
+    else takes the XLA scan. ``KTPU_PALLAS``: auto (default, TPU only) |
+    off | interpret (run the kernel through the Pallas interpreter — any
+    backend, tests)."""
     import os
 
     from kubernetes_tpu.ops import pallas_solver
@@ -475,12 +476,20 @@ def solve_device(inp: SolverInputs, pol: Optional[BatchPolicy],
     mode = os.environ.get("KTPU_PALLAS", "auto")
     use = (mode in ("auto", "interpret")
            and pallas_solver.eligible(inp, pol or BatchPolicy(), gangs,
-                                      max_count0)
+                                      peer_bound)
            and (mode == "interpret" or jax.default_backend() == "tpu"))
     if use:
         return pallas_solver.solve_pallas(inp, pol=pol or BatchPolicy(),
-                                          interpret=(mode == "interpret"))
+                                          interpret=(mode == "interpret"),
+                                          gangs=gangs)
     return solve_jit(inp, pol=pol, gangs=gangs)
+
+
+def peer_bound_of(snap: ClusterSnapshot) -> int:
+    """Largest initial per-group peer total (numpy, host-side) — the
+    pallas-eligibility bound on spread/anti-affinity arithmetic."""
+    gc = snap.group_counts
+    return int(gc.sum(axis=1).max()) if gc.size else 0
 
 
 def solve(snap: ClusterSnapshot) -> Tuple[np.ndarray, np.ndarray]:
@@ -489,8 +498,7 @@ def solve(snap: ClusterSnapshot) -> Tuple[np.ndarray, np.ndarray]:
     inp = snapshot_to_inputs(snap)
     has_gangs = snap.has_gangs
     chosen, scores = solve_device(
-        inp, snap.policy, has_gangs,
-        int(snap.group_counts.max(initial=0)))
+        inp, snap.policy, has_gangs, peer_bound_of(snap))
     chosen = np.asarray(chosen)
     scores = np.asarray(scores)
     if has_gangs:

@@ -23,8 +23,11 @@ Scope (``eligible`` says so): the default-provider policy vocabulary —
 PodFitsResources/PodFitsPorts/NoDiskConflict/MatchNodeSelector/HostName
 filters (the selector/host/static masks ride the XLA MXU pre-pass, as in
 solve_jit) and LeastRequested/ServiceSpreading/Equal priorities, int32
-resource waves, no gangs. Affinity/anti-affinity/label-preference
-policies and gang waves fall back to the XLA scan; so do waves whose
+resource waves. Gang (PodGroup all-or-nothing) waves are in-domain: the
+kernel checkpoints the committed state at each scheduling-unit start and
+a failing member rolls the whole run back — solve_jit's gang_step, with
+the checkpoint in a second set of VMEM planes. Affinity/anti-affinity/
+label-preference policies fall back to the XLA scan; so do waves whose
 counts could reach 2^15 (the limb domains) or >32640 nodes.
 
 ref: pkg/scheduler/generic_scheduler.go:54-128 (the serial loop being
@@ -57,24 +60,33 @@ _TIE0 = 24         # 4 big-endian 16-bit limbs of the FNV-1a u64
 _GID = 28
 _MEMBER = 29       # member bitmask over groups (G <= 31)
 _ZREQ = 30         # 1 when the pod requests zero of everything
+_START = 31        # 1 when this pod begins a new scheduling unit (gangs)
 
 _MAX_R = 8
 _MAX_W = 8
 _MAX_G = 31        # member bitmask must fit a non-negative i32
 _MAX_N = 32640     # tie-break/limb domains need counts < 2^15
 _MAX_COUNT = 1 << 15
+_MAX_A = 4         # anti-affinity labels carried as V-deep zone planes
+_MAX_V = 64
+_VMEM_BUDGET = 12 << 20   # leave headroom under the ~16MB per-core VMEM
 
 
 def eligible(inp, pol: Optional[BatchPolicy], gangs: bool,
-             max_count0: int) -> bool:
+             peer_bound: int) -> bool:
     """True when the wave is in the kernel's proven domain.
 
-    ``max_count0`` is the largest initial per-group peer count — the
-    caller reads it from the host-side snapshot (a device reduction here
-    would force a sync per wave)."""
-    if gangs or pol is None:
+    ``peer_bound`` is the largest initial per-group peer TOTAL (sum of a
+    group's counts row) — the caller reads it from the host-side snapshot
+    (a device reduction here would force a sync per wave); it bounds both
+    the ServiceSpreading max-count and the anti-affinity num-peers, which
+    must stay below 2^15 for the limb arithmetic. Gang waves are
+    in-domain: the kernel carries a checkpoint copy of the committed
+    state and rolls a failed run back, mirroring solve_jit's gang_step.
+    Zone anti-affinity is in-domain via per-zone reduction planes."""
+    if pol is None:
         return False
-    if pol.has_affinity or pol.anti_affinity or pol.label_prefs:
+    if pol.has_affinity or pol.label_prefs:
         return False
     if pol.all_infeasible:
         return False
@@ -86,8 +98,28 @@ def eligible(inp, pol: Optional[BatchPolicy], gangs: bool,
             and inp.node_pds.shape[1] <= _MAX_W and G <= _MAX_G
             and N <= _MAX_N):
         return False
-    # spread totals stay below 2^15: initial peers plus every wave commit
-    if max_count0 + inp.req.shape[0] >= _MAX_COUNT:
+    A = V = 0
+    if pol.anti_affinity:
+        A = inp.zone_onehot.shape[0]
+        V = inp.zone_onehot.shape[2]
+        if not (0 < A <= _MAX_A and V <= _MAX_V
+                and A == len(pol.anti_affinity)):
+            return False
+    # spread/anti-affinity totals stay below 2^15: initial peers plus
+    # every wave commit
+    if peer_bound + inp.req.shape[0] >= _MAX_COUNT:
+        return False
+    # VMEM budget: every node plane (inputs, scratch state, gang
+    # checkpoints, zone one-hots) is VMEM-resident; a wave that would
+    # exceed the ~16MB per-core VMEM must take the XLA scan instead of
+    # dying in a Mosaic RESOURCE_EXHAUSTED compile error
+    NR = max(1, -(-N // LANES))
+    Wp, Wd = inp.node_ports.shape[1], inp.node_pds.shape[1]
+    state = 2 * R + Wp + Wd + G
+    planes = (state + R + 1) + state + A * V + A     # inputs+scratch+zones
+    if gangs:
+        planes += state + 1                          # checkpoint copy
+    if planes * NR * LANES * 4 > _VMEM_BUDGET:
         return False
     return True
 
@@ -165,18 +197,34 @@ def _spread_score_i32(total, counts):
     return jnp.where(total > 0, score, 10)
 
 
-def _make_kernel(P, NR, PR, R, Wp, Wd, G, pol: BatchPolicy):
+def _make_kernel(P, NR, PR, R, Wp, Wd, G, pol: BatchPolicy,
+                 gangs: bool = False, V: int = 0):
     """Build the kernel body for static shapes/policy. Argument order:
     inputs (smask, podrow, cap, fit0, score0, fitexc, ports0, pds0,
-    counts0, offl, advx), outputs (chosen, win), scratches (fit, score,
-    ports, pds, counts)."""
+    counts0, offl, advx[, zones, zlab when anti-affinity]), outputs
+    (chosen, win), scratches (fit, score, ports, pds, counts[, ckpt_fit,
+    ckpt_score, ckpt_ports, ckpt_pds, ckpt_counts, flags when gangs])."""
     w_lr, w_spread, w_equal = pol.w_lr, pol.w_spread, pol.w_equal
+    A = len(pol.anti_affinity)
 
     def kernel(smask_ref, podrow_ref, cap_ref, fit0_ref, score0_ref,
                fitexc_ref, ports0_ref, pds0_ref, counts0_ref, offl_ref,
-               advx_ref, chosen_ref, win_ref,
-               fit_ref, score_ref, ports_ref, pds_ref, counts_ref):
+               advx_ref, *rest):
+        i = 0
+        if A:
+            zones_ref, zlab_ref = rest[0], rest[1]
+            i = 2
+        chosen_ref, win_ref = rest[i], rest[i + 1]
+        fit_ref, score_ref, ports_ref, pds_ref, counts_ref = \
+            rest[i + 2:i + 7]
+        gang_refs = rest[i + 7:]
         p = pl.program_id(0)
+        state_refs = (fit_ref, score_ref, ports_ref, pds_ref, counts_ref)
+        if gangs:
+            (cfit_ref, cscore_ref, cports_ref, cpds_ref, ccounts_ref,
+             flags_ref) = gang_refs
+            ckpt_refs = (cfit_ref, cscore_ref, cports_ref, cpds_ref,
+                         ccounts_ref)
 
         @pl.when(p == 0)
         def _init():
@@ -187,15 +235,32 @@ def _make_kernel(P, NR, PR, R, Wp, Wd, G, pol: BatchPolicy):
             counts_ref[:] = counts0_ref[:]
             chosen_ref[:] = jnp.full_like(chosen_ref, NEG)
             win_ref[:] = jnp.full_like(win_ref, NEG)
+            if gangs:
+                flags_ref[:] = jnp.zeros_like(flags_ref)
 
         # NOTE: every per-pod quantity is extracted as a 0-d scalar
         # (row[0, i]); the axon Mosaic compiler rejects [1,1]->[NR,128]
         # broadcasts but lowers 0-d broadcasts fine.
         row = podrow_ref[0]                          # [1, 128] i32
-        static_row = smask_ref[0]                    # [NR, 128] i8
+        static_row = smask_ref[0]                    # [NR, 128] i32
+
+        # ---- gang bookkeeping (solve_jit gang_step twin) -----------------
+        # A new scheduling unit checkpoints the committed state; a failing
+        # member pins the state at the checkpoint (undoing the run's
+        # earlier commits) and blocks the run's remaining members.
+        if gangs:
+            start = row[0, _START] != 0              # 0-d bool
+            @pl.when(start)
+            def _checkpoint():
+                for c_ref, s_ref in zip(ckpt_refs, state_refs):
+                    c_ref[:] = s_ref[:]
+            failed = (flags_ref[0, 0] != 0) & ~start  # 0-d bool
 
         # ---- Filter ------------------------------------------------------
         feasible = static_row != 0
+        if gangs:
+            # remaining members of an already-failed gang place nowhere
+            feasible = feasible & ~failed
         if pol.use_resources:
             res_ok = jnp.ones((NR, LANES), jnp.bool_)
             for r in range(R):
@@ -241,19 +306,36 @@ def _make_kernel(P, NR, PR, R, Wp, Wd, G, pol: BatchPolicy):
                     n_dyn = n_dyn + adv.astype(jnp.int32)
             score = score + (total_sc // n_dyn) * w_lr
         gid = row[0, _GID]                                      # 0-d
-        if w_spread:
+        if w_spread or A:
             # counts row of the pod's first service via masked reduction
             # (no dynamic VMEM indexing needed); gid < 0 matches no group
-            # so max_count = 0 and the score is the no-service 10.
+            # so the totals are 0 and the scores the no-service defaults.
             counts_row = jnp.zeros((NR, LANES), jnp.int32)
             off = jnp.int32(0)
             for g in range(G):
                 gm = (gid == g).astype(jnp.int32)               # 0-d
                 counts_row = counts_row + counts_ref[g] * gm
                 off = off + offl_ref[g, 0] * gm
+        if w_spread:
             max_count = jnp.maximum(jnp.max(counts_row), off)   # 0-d
             spread = _spread_score_i32(max_count, counts_row)
             score = score + spread * w_spread
+        for a, (_label, w) in enumerate(pol.anti_affinity):
+            # ServiceAntiAffinity (spreading.go:104-168): per-zone peer
+            # counts restricted to feasible nodes (the serial path scores
+            # over the filtered list); num counts ALL peers, off-list
+            # included. V-deep reduction planes replace solve_jit's
+            # one-hot matmuls — exact int32 throughout.
+            num = jnp.sum(counts_row) + off                     # 0-d
+            c = counts_row * feasible.astype(jnp.int32)
+            cnt = jnp.zeros((NR, LANES), jnp.int32)
+            for v in range(V):
+                zv = zones_ref[a * V + v]                       # [NR,128]
+                zc_v = jnp.sum(zv * c)                          # 0-d
+                cnt = cnt + zv * zc_v
+            s = _spread_score_i32(num, cnt)
+            s = s * (zlab_ref[a] != 0)
+            score = score + s * w
         if w_equal:
             score = score + w_equal
         masked = jnp.where(feasible, score, NEG)
@@ -315,6 +397,19 @@ def _make_kernel(P, NR, PR, R, Wp, Wd, G, pol: BatchPolicy):
             counts_ref[g] = counts_ref[g] + \
                 jnp.where(onehot, in_g, 0)
 
+        # ---- gang rollback ------------------------------------------------
+        if gangs:
+            failed = failed | ~any_f
+            @pl.when(failed)
+            def _rollback():
+                # pin the state at the run's checkpoint: undoes every
+                # commit since the unit started (this step committed
+                # nothing — a failed member chose no node)
+                for c_ref, s_ref in zip(ckpt_refs, state_refs):
+                    s_ref[:] = c_ref[:]
+            flags_ref[:] = jnp.zeros_like(flags_ref) + failed.astype(
+                jnp.int32)
+
         # ---- write decision ----------------------------------------------
         oh_p = ((jax.lax.broadcasted_iota(jnp.int32, (PR, LANES), 0)
                  == p // LANES) &
@@ -346,9 +441,9 @@ def _tie_limbs(tie_hi, tie_lo):
 
 
 def solve_pallas(inp, pol: Optional[BatchPolicy] = None,
-                 interpret: bool = False
+                 interpret: bool = False, gangs: bool = False
                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Drop-in twin of ``solve_jit(inp, pol=pol, gangs=False)`` for
+    """Drop-in twin of ``solve_jit(inp, pol=pol, gangs=gangs)`` for
     eligible waves. The XLA prolog (selector matmul, plane transposition,
     pod-row packing) and the Pallas kernel compile into one program; use
     ``interpret=True`` to run the kernel on CPU for tests.
@@ -369,16 +464,18 @@ def solve_pallas(inp, pol: Optional[BatchPolicy] = None,
             inp.score_used, inp.node_ports, inp.node_sel, inp.node_pds,
             inp.node_extra_ok, inp.req, inp.pod_ports, inp.pod_sel,
             inp.pod_pds, inp.pod_host_idx, limbs, inp.pod_gid,
-            inp.pod_group_member, inp.group_counts,
-            pol=pol, interpret=interpret)
+            inp.pod_group_member, inp.group_counts, inp.gang_start,
+            inp.zone_onehot, inp.zone_labeled,
+            pol=pol, interpret=interpret, gangs=gangs)
 
 
-@functools.partial(jax.jit, static_argnames=("pol", "interpret"))
+@functools.partial(jax.jit, static_argnames=("pol", "interpret", "gangs"))
 def _solve_pallas_x32(cap_in, advertises, fit_used, fit_exceeded,
                       score_used, node_ports, node_sel, node_pds,
                       node_extra_ok, req_in, pod_ports, pod_sel, pod_pds,
                       pod_host_idx, tie_limbs, pod_gid, pod_group_member,
-                      group_counts, *, pol: BatchPolicy, interpret: bool
+                      group_counts, gang_start, zone_onehot, zone_labeled,
+                      *, pol: BatchPolicy, interpret: bool, gangs: bool
                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     N, R = cap_in.shape
     P = req_in.shape[0]
@@ -444,8 +541,25 @@ def _solve_pallas_x32(cap_in, advertises, fit_used, fit_exceeded,
     podrow = podrow.at[:, _MEMBER].set(member_bits)
     podrow = podrow.at[:, _ZREQ].set(
         jnp.all(req_in == 0, axis=1).astype(jnp.int32))
+    if gangs:
+        podrow = podrow.at[:, _START].set(gang_start.astype(jnp.int32))
 
-    kernel = _make_kernel(P, NR, PR, R, Wp, Wd, G, pol)
+    # ---- zone planes for anti-affinity ([A*V, NR, 128] i32 one-hots) -----
+    A = len(pol.anti_affinity)
+    V = zone_onehot.shape[2] if A else 0
+    zone_args, zone_specs = [], []
+    if A:
+        zones = zone_onehot.astype(jnp.int32)          # [A, N, V]
+        zones = jnp.transpose(zones, (0, 2, 1)).reshape(A * V, N)
+        zones = _pad_nodes(zones, Npad, 0).reshape(A * V, NR, LANES)
+        zlab = _pad_nodes(zone_labeled.astype(jnp.int32), Npad, 0)
+        zlab = zlab.reshape(A, NR, LANES)
+        zone_args = [zones, zlab]
+        zone_specs = [pl.BlockSpec((A * V, NR, LANES),
+                                   lambda p: (0, 0, 0)),
+                      pl.BlockSpec((A, NR, LANES), lambda p: (0, 0, 0))]
+
+    kernel = _make_kernel(P, NR, PR, R, Wp, Wd, G, pol, gangs, V)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=0,
         grid=(P,),
@@ -461,7 +575,7 @@ def _solve_pallas_x32(cap_in, advertises, fit_used, fit_exceeded,
             pl.BlockSpec((G, NR, LANES), lambda p: (0, 0, 0)),   # counts0
             pl.BlockSpec((G, LANES), lambda p: (0, 0)),          # offl
             pl.BlockSpec(advx.shape, lambda p: (0, 0, 0)),
-        ],
+        ] + zone_specs,
         out_specs=[
             pl.BlockSpec((PR, LANES), lambda p: (0, 0)),
             pl.BlockSpec((PR, LANES), lambda p: (0, 0)),
@@ -472,7 +586,14 @@ def _solve_pallas_x32(cap_in, advertises, fit_used, fit_exceeded,
             pltpu.VMEM((Wp, NR, LANES), jnp.int32),  # ports
             pltpu.VMEM((Wd, NR, LANES), jnp.int32),  # pds
             pltpu.VMEM((G, NR, LANES), jnp.int32),   # counts
-        ],
+        ] + ([
+            pltpu.VMEM((R, NR, LANES), jnp.int32),   # ckpt fit
+            pltpu.VMEM((R, NR, LANES), jnp.int32),   # ckpt score_used
+            pltpu.VMEM((Wp, NR, LANES), jnp.int32),  # ckpt ports
+            pltpu.VMEM((Wd, NR, LANES), jnp.int32),  # ckpt pds
+            pltpu.VMEM((G, NR, LANES), jnp.int32),   # ckpt counts
+            pltpu.VMEM((8, LANES), jnp.int32),       # failed flag
+        ] if gangs else []),
     )
     chosen2d, win2d = pl.pallas_call(
         kernel,
@@ -481,5 +602,5 @@ def _solve_pallas_x32(cap_in, advertises, fit_used, fit_exceeded,
                    jax.ShapeDtypeStruct((PR, LANES), jnp.int32)],
         interpret=interpret,
     )(smask, podrow.reshape(P, 1, LANES), cap, fit0, score0, fitexc,
-      ports0, pds0, counts0, offl, advx)
+      ports0, pds0, counts0, offl, advx, *zone_args)
     return chosen2d.reshape(-1)[:P], win2d.reshape(-1)[:P]

@@ -118,15 +118,110 @@ def test_unschedulable_pods_get_minus_one():
     assert np.array_equal(c, np.asarray(c1))
 
 
+@pytest.mark.parametrize("seed", range(4))
+def test_anti_affinity_interpret_matches_solve_jit(seed):
+    nodes, existing, pending, services = fuzz_wave(500 + seed)
+    pol = BatchPolicy(w_lr=1, w_spread=0,
+                      anti_affinity=(("zone", 2),))
+    snap = encode_snapshot(nodes, existing, pending, services, policy=pol)
+    inp = snapshot_to_inputs(snap)
+    assert pallas_solver.eligible(
+        inp, pol, False, int(snap.group_counts.sum(axis=1).max(initial=0)))
+    c1, s1 = solve_jit(inp, pol=pol, gangs=False)
+    c2, s2 = pallas_solver.solve_pallas(inp, pol=pol, interpret=True)
+    assert np.array_equal(np.asarray(c1), np.asarray(c2))
+    assert np.array_equal(np.asarray(s1), np.asarray(s2))
+
+
+def test_anti_affinity_unlabeled_nodes_score_zero():
+    # half the nodes lack the zone label: serial gives them score 0 from
+    # the anti-affinity term (spreading.go:211-212); labeled empty zones
+    # score 10 — both must survive the kernel path
+    nodes = [mk_node(f"n-{i}", labels={"zone": f"z{i % 2}"} if i < 4 else {})
+             for i in range(8)]
+    services = [api.Service(
+        metadata=api.ObjectMeta(name="s0", namespace="default"),
+        spec=api.ServiceSpec(port=80, selector={"app": "a0"}))]
+    existing = [mk_pod("old-0", cpu_m=100, host="n-0",
+                       labels={"app": "a0"})]
+    pending = [mk_pod(f"new-{i}", cpu_m=100, labels={"app": "a0"})
+               for i in range(6)]
+    pol = BatchPolicy(w_lr=1, anti_affinity=(("zone", 2),))
+    snap = encode_snapshot(nodes, existing, pending, services, policy=pol)
+    inp = snapshot_to_inputs(snap)
+    c1, s1 = solve_jit(inp, pol=pol, gangs=False)
+    c2, s2 = pallas_solver.solve_pallas(inp, pol=pol, interpret=True)
+    assert np.array_equal(np.asarray(c1), np.asarray(c2))
+    assert np.array_equal(np.asarray(s1), np.asarray(s2))
+
+
+def mk_gang_pod(name, group, size, cpu_m=800, mem=1 << 28, app="g"):
+    from kubernetes_tpu.models import gang as gang_mod
+    p = mk_pod(name, cpu_m=cpu_m, mem=mem, labels={"app": app})
+    p.metadata.annotations = {
+        gang_mod.GANG_NAME_ANNOTATION: group,
+        gang_mod.GANG_MIN_MEMBERS_ANNOTATION: str(size)}
+    return p
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_gang_interpret_matches_solve_jit(seed):
+    rng = random.Random(1000 + seed)
+    nodes = [mk_node(f"n-{i:03d}", cpu_m=rng.choice([2000, 4000]))
+             for i in range(9)]
+    services = [api.Service(
+        metadata=api.ObjectMeta(name="sg", namespace="default"),
+        spec=api.ServiceSpec(port=80, selector={"app": "g"}))]
+    pending = []
+    for g in range(5):
+        size = rng.choice([2, 3, 4])
+        # some groups oversubscribe on purpose so rollback paths fire
+        cpu = rng.choice([700, 1500, 3800])
+        for m in range(size):
+            pending.append(mk_gang_pod(f"g{g}-m{m}", f"grp-{g}", size,
+                                       cpu_m=cpu))
+        if rng.random() < 0.5:
+            pending.append(mk_pod(f"solo-{g}",
+                                  cpu_m=rng.randrange(0, 2000, 100),
+                                  labels={"app": "g"}))
+    snap = encode_snapshot(nodes, [], pending, services)
+    assert snap.has_gangs
+    inp = snapshot_to_inputs(snap)
+    c1, s1 = solve_jit(inp, pol=snap.policy, gangs=True)
+    c2, s2 = pallas_solver.solve_pallas(inp, pol=snap.policy,
+                                        interpret=True, gangs=True)
+    assert np.array_equal(np.asarray(c1), np.asarray(c2))
+    assert np.array_equal(np.asarray(s1), np.asarray(s2))
+
+
+def test_gang_rollback_undoes_commits_interpret():
+    # one node fits 2 large pods; a 3-member gang must fully fail and its
+    # first two tentative placements must not consume capacity for the
+    # singleton that follows
+    nodes = [mk_node("n-0", cpu_m=2000)]
+    pending = [mk_gang_pod(f"g-m{m}", "grp", 3, cpu_m=900)
+               for m in range(3)] + [mk_pod("solo", cpu_m=1800)]
+    snap = encode_snapshot(nodes, [], pending, [])
+    inp = snapshot_to_inputs(snap)
+    c2, _ = pallas_solver.solve_pallas(inp, pol=snap.policy,
+                                       interpret=True, gangs=True)
+    c2 = np.asarray(c2)
+    # members 0,1 tentatively chose n-0 (rolled back on host by
+    # apply_all_or_nothing); member 2 found nothing; solo got the full node
+    assert c2[2] == -1 and c2[3] == 0
+    c1, _ = solve_jit(inp, pol=snap.policy, gangs=True)
+    assert np.array_equal(c2, np.asarray(c1))
+
+
 def test_eligibility_gates():
     nodes, existing, pending, services = fuzz_wave(1)
     snap = encode_snapshot(nodes, existing, pending, services)
     inp = snapshot_to_inputs(snap)
     pol = snap.policy or BatchPolicy()
     assert pallas_solver.eligible(inp, pol, False, 10)
-    # gangs, affinity-bearing policies, i64 waves, count overflow: all fall
+    assert pallas_solver.eligible(inp, pol, True, 10)   # gangs in-domain
+    # affinity-bearing policies, i64 waves, count overflow: all fall
     # back to the XLA scan
-    assert not pallas_solver.eligible(inp, pol, True, 10)
     aff = BatchPolicy(anti_affinity=(("zone", 1),))
     assert not pallas_solver.eligible(inp, aff, False, 10)
     labeled = BatchPolicy(affinity_labels=("region",))
