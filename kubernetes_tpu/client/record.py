@@ -10,11 +10,13 @@ from __future__ import annotations
 
 import datetime
 import threading
+import time
+from collections import deque
 from typing import Any, Dict, Optional, Tuple
 
 from kubernetes_tpu.api import types as api
 
-__all__ = ["EventRecorder"]
+__all__ = ["EventRecorder", "AsyncEventRecorder"]
 
 
 def _now() -> datetime.datetime:
@@ -73,3 +75,65 @@ class EventRecorder:
             return out
         except Exception:
             return None  # event recording must never break the caller
+
+
+class AsyncEventRecorder:
+    """Background-posting wrapper around EventRecorder.
+
+    ref: pkg/client/record/event.go:53 — the reference's Eventf pushes
+    into a Broadcaster and StartRecording posts from a goroutine, so
+    recording never stalls a control loop on an apiserver round-trip.
+    ``eventf`` enqueues and returns immediately; a worker thread drains
+    through the wrapped recorder (keeping its dedup/compression cache).
+    The queue is bounded and drop-oldest: under an event storm the
+    control loop keeps running and old events are shed, never the loop
+    blocked (events are best-effort diagnostics, not state)."""
+
+    def __init__(self, recorder: EventRecorder, max_queue: int = 4096):
+        self.recorder = recorder
+        self._q: "deque" = deque(maxlen=max_queue)
+        self._cond = threading.Condition()
+        self._stopped = False
+        self._in_flight = 0   # popped but not yet posted
+        self._worker = threading.Thread(target=self._run, daemon=True,
+                                        name="event-recorder")
+        self._worker.start()
+
+    def eventf(self, obj: Any, reason: str, message_fmt: str, *args) -> None:
+        with self._cond:
+            if self._stopped:
+                return
+            self._q.append((obj, reason, message_fmt, args))
+            self._cond.notify()
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._q and not self._stopped:
+                    self._cond.wait()
+                if self._stopped and not self._q:
+                    return
+                obj, reason, fmt, args = self._q.popleft()
+                self._in_flight = 1
+            try:
+                self.recorder.eventf(obj, reason, fmt, *args)
+            finally:
+                with self._cond:
+                    self._in_flight = 0
+
+    def flush(self, timeout: float = 5.0) -> bool:
+        """Wait until everything enqueued so far has POSTED — queue empty
+        alone is not enough, the worker may hold a popped item mid-post."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._cond:
+                if not self._q and not self._in_flight:
+                    return True
+            time.sleep(0.005)
+        return False
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stopped = True
+            self._cond.notify()
+        self._worker.join(timeout=2.0)

@@ -4,12 +4,15 @@
 ``instances()``, ``zones()``, ``clusters()`` — each returning the capability
 object or None, exactly like the reference's (T, bool) pairs. Providers:
 
-- ``FakeCloud``   (ref: pkg/cloudprovider/fake/) — scriptable double
-- ``LocalCloud``  — a real provider for single-machine deployments: the
+- ``FakeCloud``      (ref: pkg/cloudprovider/fake/) — scriptable double
+- ``LocalCloud``     — a real provider for single-machine deployments: the
   instance list is localhost, load balancers are kube-proxy portals
+- ``InventoryCloud`` — JSON-inventory-file provider (the vagrant/ovirt
+  config-driven pattern); registered as "inventory"
 
 The registry (``register_provider``/``get_provider``) mirrors
-pkg/cloudprovider/plugins.go.
+pkg/cloudprovider/plugins.go; importing this package registers the
+bundled providers, like the reference's provider init() side effects.
 """
 
 from kubernetes_tpu.cloudprovider.cloud import (Clusters, FakeCloud,  # noqa: F401
@@ -17,3 +20,4 @@ from kubernetes_tpu.cloudprovider.cloud import (Clusters, FakeCloud,  # noqa: F4
                                                 LocalCloud, TCPLoadBalancer,
                                                 Zone, Zones, get_provider,
                                                 register_provider)
+from kubernetes_tpu.cloudprovider.inventory import InventoryCloud  # noqa: F401,E402

@@ -1,0 +1,128 @@
+"""Inventory-file cloud provider.
+
+The reference's simplest real providers are config-driven instance
+inventories: vagrant polls salt's REST endpoint for minion machines
+(ref: pkg/cloudprovider/vagrant/vagrant.go:60-120), ovirt reads a config
+file pointing at a VM-list API and filters it (ref:
+pkg/cloudprovider/ovirt/ovirt.go:84-180). This provider is that pattern
+without the long-dead backends: a JSON inventory file declares the
+instances (name, addresses, optional per-node resources) and the zone;
+the file is re-read when its mtime changes, so an external process (or a
+human) updating the inventory is the "cloud API". The node controller's
+cloud-sync loop (controllers/node.py) then registers/deregisters nodes
+exactly as it would against a live cloud.
+
+Inventory format:
+
+    {
+      "zone": {"failure_domain": "a", "region": "local"},
+      "instances": [
+        {"name": "worker-1", "addresses": ["10.0.0.11"],
+         "cpu": "8", "memory": "16Gi"},
+        {"name": "worker-2", "addresses": ["10.0.0.12"]}
+      ]
+    }
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import List, Optional
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.quantity import Quantity
+from kubernetes_tpu.cloudprovider.cloud import (
+    Instances,
+    Interface,
+    Zone,
+    Zones,
+    register_provider,
+)
+
+__all__ = ["InventoryCloud"]
+
+
+class InventoryCloud(Interface, Instances, Zones):
+    """Instances + Zones backed by a JSON inventory file."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._mtime = -1.0
+        self._zone = Zone()
+        self._instances: dict = {}
+        self._load()
+
+    # -- file handling ------------------------------------------------------
+    def _load(self) -> None:
+        try:
+            mtime = os.stat(self.path).st_mtime
+        except OSError:
+            # transient blip (non-atomic replace, NFS hiccup): KEEP the
+            # previous inventory — an empty list here would make the node
+            # controller deregister every node and evict all their pods.
+            # Reset the mtime so the reappeared file reloads even if its
+            # mtime matches the old one.
+            self._mtime = -1.0
+            return
+        if mtime == self._mtime:
+            return
+        with open(self.path) as f:
+            data = json.load(f)
+        zone = data.get("zone") or {}
+        self._zone = Zone(failure_domain=zone.get("failure_domain", ""),
+                          region=zone.get("region", ""))
+        self._instances = {inst["name"]: inst
+                           for inst in data.get("instances", [])}
+        self._mtime = mtime
+
+    # -- Interface ----------------------------------------------------------
+    def instances(self) -> Optional[Instances]:
+        return self
+
+    def zones(self) -> Optional[Zones]:
+        return self
+
+    # -- Instances ----------------------------------------------------------
+    def list_instances(self, name_filter: str = ".*") -> List[str]:
+        self._load()
+        rx = re.compile(name_filter)
+        return sorted(n for n in self._instances if rx.match(n))
+
+    def node_addresses(self, name: str) -> List[str]:
+        self._load()
+        inst = self._instances.get(name)
+        if inst is None:
+            raise KeyError(f"instance {name!r} not in inventory")
+        return list(inst.get("addresses", []))
+
+    def external_id(self, name: str) -> str:
+        self._load()
+        inst = self._instances.get(name)
+        if inst is None:
+            raise KeyError(f"instance {name!r} not in inventory")
+        return inst.get("external_id", name)
+
+    def get_node_resources(self, name: str) -> Optional[api.NodeSpec]:
+        self._load()
+        inst = self._instances.get(name)
+        if inst is None or ("cpu" not in inst and "memory" not in inst):
+            return None
+        capacity = {}
+        if "cpu" in inst:
+            capacity["cpu"] = Quantity(inst["cpu"])
+        if "memory" in inst:
+            capacity["memory"] = Quantity(inst["memory"])
+        return api.NodeSpec(capacity=capacity)
+
+    # -- Zones --------------------------------------------------------------
+    def get_zone(self) -> Zone:
+        self._load()
+        return self._zone
+
+
+register_provider(
+    "inventory",
+    lambda: InventoryCloud(os.environ.get("KTPU_CLOUD_INVENTORY",
+                                          "cloud-inventory.json")))

@@ -33,13 +33,15 @@ def build_scheduler(opts):
     from kubernetes_tpu.api import types as api
     from kubernetes_tpu.client.client import Client
     from kubernetes_tpu.client.http import HTTPTransport
-    from kubernetes_tpu.client.record import EventRecorder
+    from kubernetes_tpu.client.record import AsyncEventRecorder, EventRecorder
     from kubernetes_tpu.scheduler import plugins as schedplugins
     from kubernetes_tpu.scheduler.driver import ConfigFactory, Scheduler
 
     client = Client(HTTPTransport(opts.master))
-    recorder = EventRecorder(client, api.EventSource(
-        component=api.DefaultSchedulerName))
+    # async like the reference's StartRecording goroutine (event.go:53):
+    # recording must never stall scheduleOne/wave loops on an API write
+    recorder = AsyncEventRecorder(EventRecorder(client, api.EventSource(
+        component=api.DefaultSchedulerName)))
     factory = ConfigFactory(client)
 
     policy = None

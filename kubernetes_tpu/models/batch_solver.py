@@ -499,8 +499,11 @@ def solve(snap: ClusterSnapshot) -> Tuple[np.ndarray, np.ndarray]:
     has_gangs = snap.has_gangs
     chosen, scores = solve_device(
         inp, snap.policy, has_gangs, peer_bound_of(snap))
-    chosen = np.asarray(chosen)
-    scores = np.asarray(scores)
+    # ONE device->host readback, not two: the transfer holds the GIL for
+    # the tunnel round-trip, and at churn rates a second sync per wave
+    # visibly starves the feeder and watch pumps
+    both = np.asarray(jnp.stack([chosen, scores]))
+    chosen, scores = both[0], both[1]
     if has_gangs:
         chosen = gang.apply_all_or_nothing(snap.pod_rid, chosen)
         # keep the chosen/score pairing: rolled-back members' tentative

@@ -1,0 +1,78 @@
+"""Inventory-file cloud provider + CLI doc generators.
+
+ref parity: pkg/cloudprovider/{vagrant,ovirt} (config-driven instance
+inventory) and cmd/{gendocs,genman} (docs from the live command tree).
+"""
+
+import json
+import os
+import time
+
+from kubernetes_tpu.api.quantity import Quantity
+from kubernetes_tpu.cloudprovider.cloud import get_provider
+from kubernetes_tpu.cloudprovider.inventory import InventoryCloud
+from kubernetes_tpu.cmd import gendocs, genman
+
+
+def write_inventory(path, instances, zone=None):
+    path.write_text(json.dumps({
+        "zone": zone or {"failure_domain": "a", "region": "local"},
+        "instances": instances,
+    }))
+
+
+def test_inventory_instances_and_zones(tmp_path):
+    inv = tmp_path / "inv.json"
+    write_inventory(inv, [
+        {"name": "worker-1", "addresses": ["10.0.0.11"],
+         "cpu": "8", "memory": "16Gi"},
+        {"name": "worker-2", "addresses": ["10.0.0.12"]},
+        {"name": "cmaster", "addresses": ["10.0.0.1"]},
+    ])
+    cloud = InventoryCloud(str(inv))
+    inst = cloud.instances()
+    assert inst.list_instances() == ["cmaster", "worker-1", "worker-2"]
+    assert inst.list_instances("worker-.*") == ["worker-1", "worker-2"]
+    assert inst.node_addresses("worker-1") == ["10.0.0.11"]
+    assert inst.external_id("worker-2") == "worker-2"
+    spec = inst.get_node_resources("worker-1")
+    assert spec.capacity["cpu"] == Quantity("8")
+    assert spec.capacity["memory"] == Quantity("16Gi")
+    assert inst.get_node_resources("worker-2") is None
+    z = cloud.zones().get_zone()
+    assert (z.failure_domain, z.region) == ("a", "local")
+    assert cloud.tcp_load_balancer() is None
+
+
+def test_inventory_reloads_on_mtime_change(tmp_path):
+    inv = tmp_path / "inv.json"
+    write_inventory(inv, [{"name": "n1", "addresses": ["10.0.0.1"]}])
+    cloud = InventoryCloud(str(inv))
+    assert cloud.instances().list_instances() == ["n1"]
+    write_inventory(inv, [{"name": "n1", "addresses": ["10.0.0.1"]},
+                          {"name": "n2", "addresses": ["10.0.0.2"]}])
+    os.utime(inv, (time.time() + 5, time.time() + 5))
+    assert cloud.instances().list_instances() == ["n1", "n2"]
+
+
+def test_inventory_registered_as_provider(tmp_path, monkeypatch):
+    inv = tmp_path / "inv.json"
+    write_inventory(inv, [{"name": "n1", "addresses": ["10.0.0.1"]}])
+    monkeypatch.setenv("KTPU_CLOUD_INVENTORY", str(inv))
+    cloud = get_provider("inventory")
+    assert cloud is not None
+    assert cloud.instances().list_instances() == ["n1"]
+
+
+def test_gendocs_and_genman_cover_every_command(tmp_path):
+    assert gendocs.main([str(tmp_path / "cli")]) == 0
+    assert genman.main([str(tmp_path / "man")]) == 0
+    _, subs = gendocs.command_tree()
+    for name in subs:
+        md = (tmp_path / "cli" / f"kubectl_{name}.md").read_text()
+        assert md.startswith(f"## kubectl {name}")
+        man = (tmp_path / "man" / f"kubectl-{name}.1").read_text()
+        assert man.startswith('.TH "KUBECTL')
+    index = (tmp_path / "cli" / "kubectl.md").read_text()
+    assert "kubectl_get.md" in index
+    assert (tmp_path / "man" / "kubectl.1").exists()

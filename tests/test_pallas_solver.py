@@ -213,6 +213,39 @@ def test_gang_rollback_undoes_commits_interpret():
     assert np.array_equal(c2, np.asarray(c1))
 
 
+@pytest.mark.parametrize("seed", range(3))
+def test_gang_with_anti_affinity_interpret_matches_solve_jit(seed):
+    # the one in-domain cross-feature combination: gang rollback must
+    # restore the counts planes the zone anti-affinity scoring reads
+    rng = random.Random(2000 + seed)
+    nodes = [mk_node(f"n-{i:03d}", cpu_m=rng.choice([2000, 4000]),
+                     labels={"zone": f"z{i % 3}"})
+             for i in range(9)]
+    services = [api.Service(
+        metadata=api.ObjectMeta(name="sg", namespace="default"),
+        spec=api.ServiceSpec(port=80, selector={"app": "g"}))]
+    pending = []
+    for g in range(5):
+        size = rng.choice([2, 3])
+        cpu = rng.choice([700, 1500, 3800])
+        for m in range(size):
+            pending.append(mk_gang_pod(f"g{g}-m{m}", f"grp-{g}", size,
+                                       cpu_m=cpu))
+        pending.append(mk_pod(f"solo-{g}", cpu_m=rng.randrange(0, 1500, 100),
+                              labels={"app": "g"}))
+    pol = BatchPolicy(w_lr=1, anti_affinity=(("zone", 2),))
+    snap = encode_snapshot(nodes, [], pending, services, policy=pol)
+    assert snap.has_gangs
+    inp = snapshot_to_inputs(snap)
+    assert pallas_solver.eligible(
+        inp, pol, True, int(snap.group_counts.sum(axis=1).max(initial=0)))
+    c1, s1 = solve_jit(inp, pol=pol, gangs=True)
+    c2, s2 = pallas_solver.solve_pallas(inp, pol=pol, interpret=True,
+                                        gangs=True)
+    assert np.array_equal(np.asarray(c1), np.asarray(c2))
+    assert np.array_equal(np.asarray(s1), np.asarray(s2))
+
+
 def test_eligibility_gates():
     nodes, existing, pending, services = fuzz_wave(1)
     snap = encode_snapshot(nodes, existing, pending, services)
