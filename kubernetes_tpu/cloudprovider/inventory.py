@@ -12,6 +12,13 @@ human) updating the inventory is the "cloud API". The node controller's
 cloud-sync loop (controllers/node.py) then registers/deregisters nodes
 exactly as it would against a live cloud.
 
+Failure discipline: one sync tick must see ONE consistent snapshot
+(``instances()`` binds a view to the snapshot current at call time), a
+torn or momentarily missing file must never look like an empty cloud
+(that would mass-deregister nodes and evict their pods — the previous
+snapshot is kept), and a provider that has NEVER successfully loaded
+raises instead of answering empty for the same reason.
+
 Inventory format:
 
     {
@@ -29,7 +36,7 @@ from __future__ import annotations
 import json
 import os
 import re
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.api.quantity import Quantity
@@ -41,71 +48,39 @@ from kubernetes_tpu.cloudprovider.cloud import (
     register_provider,
 )
 
-__all__ = ["InventoryCloud"]
+__all__ = ["InventoryCloud", "InventoryError"]
 
 
-class InventoryCloud(Interface, Instances, Zones):
-    """Instances + Zones backed by a JSON inventory file."""
+class InventoryError(RuntimeError):
+    """The inventory has never been readable — callers must not treat
+    this as an empty cloud."""
 
-    def __init__(self, path: str):
-        self.path = path
-        self._mtime = -1.0
-        self._zone = Zone()
-        self._instances: dict = {}
-        self._load()
 
-    # -- file handling ------------------------------------------------------
-    def _load(self) -> None:
-        try:
-            mtime = os.stat(self.path).st_mtime
-        except OSError:
-            # transient blip (non-atomic replace, NFS hiccup): KEEP the
-            # previous inventory — an empty list here would make the node
-            # controller deregister every node and evict all their pods.
-            # Reset the mtime so the reappeared file reloads even if its
-            # mtime matches the old one.
-            self._mtime = -1.0
-            return
-        if mtime == self._mtime:
-            return
-        with open(self.path) as f:
-            data = json.load(f)
-        zone = data.get("zone") or {}
-        self._zone = Zone(failure_domain=zone.get("failure_domain", ""),
-                          region=zone.get("region", ""))
-        self._instances = {inst["name"]: inst
-                           for inst in data.get("instances", [])}
-        self._mtime = mtime
+class _Snapshot(Instances, Zones):
+    """One consistent view of the inventory; every accessor a sync tick
+    performs after ``instances()`` reads this same snapshot."""
 
-    # -- Interface ----------------------------------------------------------
-    def instances(self) -> Optional[Instances]:
-        return self
+    def __init__(self, zone: Zone, instances: Dict[str, dict]):
+        self.zone = zone
+        self._instances = instances
 
-    def zones(self) -> Optional[Zones]:
-        return self
-
-    # -- Instances ----------------------------------------------------------
     def list_instances(self, name_filter: str = ".*") -> List[str]:
-        self._load()
         rx = re.compile(name_filter)
         return sorted(n for n in self._instances if rx.match(n))
 
     def node_addresses(self, name: str) -> List[str]:
-        self._load()
         inst = self._instances.get(name)
         if inst is None:
             raise KeyError(f"instance {name!r} not in inventory")
         return list(inst.get("addresses", []))
 
     def external_id(self, name: str) -> str:
-        self._load()
         inst = self._instances.get(name)
         if inst is None:
             raise KeyError(f"instance {name!r} not in inventory")
         return inst.get("external_id", name)
 
     def get_node_resources(self, name: str) -> Optional[api.NodeSpec]:
-        self._load()
         inst = self._instances.get(name)
         if inst is None or ("cpu" not in inst and "memory" not in inst):
             return None
@@ -116,10 +91,54 @@ class InventoryCloud(Interface, Instances, Zones):
             capacity["memory"] = Quantity(inst["memory"])
         return api.NodeSpec(capacity=capacity)
 
-    # -- Zones --------------------------------------------------------------
     def get_zone(self) -> Zone:
+        return self.zone
+
+
+class InventoryCloud(Interface):
+    """Instances + Zones backed by a JSON inventory file."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._mtime = -1.0
+        self._snapshot: Optional[_Snapshot] = None
         self._load()
-        return self._zone
+
+    # -- file handling ------------------------------------------------------
+    def _load(self) -> None:
+        """Refresh the snapshot if the file changed. On ANY failure —
+        missing file (non-atomic replace window), torn write, malformed
+        JSON — keep the previous snapshot and reset the mtime so the
+        repaired file reloads even with an unchanged timestamp."""
+        try:
+            mtime = os.stat(self.path).st_mtime
+            if mtime == self._mtime:
+                return
+            with open(self.path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            self._mtime = -1.0
+            return
+        zone = data.get("zone") or {}
+        self._snapshot = _Snapshot(
+            Zone(failure_domain=zone.get("failure_domain", ""),
+                 region=zone.get("region", "")),
+            {inst["name"]: inst for inst in data.get("instances", [])})
+        self._mtime = mtime
+
+    def _current(self) -> _Snapshot:
+        self._load()
+        if self._snapshot is None:
+            raise InventoryError(
+                f"inventory {self.path!r} has never been readable")
+        return self._snapshot
+
+    # -- Interface ----------------------------------------------------------
+    def instances(self) -> Optional[Instances]:
+        return self._current()
+
+    def zones(self) -> Optional[Zones]:
+        return self._current()
 
 
 register_provider(

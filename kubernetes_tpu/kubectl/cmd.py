@@ -299,7 +299,8 @@ def _cmd_config(f: Factory, opts) -> int:
 
 
 def _print_infos(f: Factory, infos, ns: str, output: str, template: str,
-                 no_headers: bool, version: str) -> None:
+                 no_headers: bool, version: str,
+                 empty_resource: str = "pods") -> None:
     printer = printer_for(output, f.scheme, template=template,
                           no_headers=no_headers, version=version)
     if output in ("", "wide"):
@@ -315,6 +316,14 @@ def _print_infos(f: Factory, infos, ns: str, output: str, template: str,
             lt = f.mapper.list_type_for(resource)
             lst = lt(items=[i.obj for i in group])
             printer.print_obj(lst, f.out)
+    elif not infos and output in ("json", "yaml"):
+        # zero matches still produce a well-formed document (the reference
+        # prints an empty versioned List, not nothing)
+        try:
+            lt = f.mapper.list_type_for(empty_resource) or api.PodList
+        except KeyError:
+            lt = api.PodList
+        printer.print_obj(lt(items=[]), f.out)
     else:
         for info in infos:
             printer.print_obj(info.obj, f.out)
@@ -325,8 +334,12 @@ def _cmd_get(f: Factory, ns: str, opts) -> int:
         .all_namespaces(opts.all_namespaces) \
         .resource_type_or_name(*opts.args)
     infos = b.infos(f.client)
+    from kubernetes_tpu.kubectl.resource import resolve_resource
+    empty_resource = resolve_resource(
+        opts.args[0].split("/", 1)[0]) if opts.args else "pods"
     _print_infos(f, infos, ns, opts.output, opts.template,
-                 opts.no_headers, opts.api_version)
+                 opts.no_headers, opts.api_version,
+                 empty_resource=empty_resource)
     if opts.watch:
         if len({i.resource for i in infos}) != 1:
             raise KubectlError("watch requires a single resource type")

@@ -55,6 +55,48 @@ def test_inventory_reloads_on_mtime_change(tmp_path):
     assert cloud.instances().list_instances() == ["n1", "n2"]
 
 
+def test_inventory_never_loaded_raises_not_empty(tmp_path):
+    # answering "no instances" for an unreadable inventory would make the
+    # node controller deregister every node and evict their pods
+    import pytest as _pytest
+
+    from kubernetes_tpu.cloudprovider.inventory import InventoryError
+    cloud = InventoryCloud(str(tmp_path / "missing.json"))
+    with _pytest.raises(InventoryError):
+        cloud.instances()
+
+
+def test_inventory_keeps_previous_snapshot_on_torn_file(tmp_path):
+    inv = tmp_path / "inv.json"
+    write_inventory(inv, [{"name": "n1", "addresses": ["10.0.0.1"]}])
+    cloud = InventoryCloud(str(inv))
+    assert cloud.instances().list_instances() == ["n1"]
+    # torn write: stat succeeds, JSON is garbage -> previous snapshot holds
+    inv.write_text("{ not json")
+    os.utime(inv, (time.time() + 5, time.time() + 5))
+    assert cloud.instances().list_instances() == ["n1"]
+    # file disappears entirely -> previous snapshot still holds
+    inv.unlink()
+    assert cloud.instances().list_instances() == ["n1"]
+    # repaired file reloads even if mtime matches an earlier observation
+    write_inventory(inv, [{"name": "n2", "addresses": ["10.0.0.2"]}])
+    assert cloud.instances().list_instances() == ["n2"]
+
+
+def test_inventory_snapshot_is_consistent_across_rewrite(tmp_path):
+    inv = tmp_path / "inv.json"
+    write_inventory(inv, [{"name": "n1", "addresses": ["10.0.0.1"]}])
+    cloud = InventoryCloud(str(inv))
+    view = cloud.instances()            # one sync tick's view
+    write_inventory(inv, [{"name": "n2", "addresses": ["10.0.0.2"]}])
+    os.utime(inv, (time.time() + 5, time.time() + 5))
+    # the bound view still answers for n1 (no KeyError mid-sync) ...
+    assert view.list_instances() == ["n1"]
+    assert view.node_addresses("n1") == ["10.0.0.1"]
+    # ... while a fresh view sees the rewrite
+    assert cloud.instances().list_instances() == ["n2"]
+
+
 def test_inventory_registered_as_provider(tmp_path, monkeypatch):
     inv = tmp_path / "inv.json"
     write_inventory(inv, [{"name": "n1", "addresses": ["10.0.0.1"]}])
