@@ -93,12 +93,12 @@ def parent(argv) -> int:
         # show both flag sets without spawning (or retrying) a child
         _child_parser().print_help()
         print("\ncapture-harness flags:\n"
-              "  --max-seconds S      overall watchdog budget (default 1200)\n"
+              "  --max-seconds S      overall watchdog budget (default 2100)\n"
               "  --attempt-seconds S  per-attempt timeout (default 900)\n"
               "  --retries R          re-attempts after a crash/hang (default 3)")
         return 0
     ap = argparse.ArgumentParser(add_help=False)
-    ap.add_argument("--max-seconds", type=float, default=1200.0,
+    ap.add_argument("--max-seconds", type=float, default=2100.0,
                     help="overall watchdog: total wall budget for all attempts")
     ap.add_argument("--attempt-seconds", type=float, default=900.0,
                     help="timeout for a single child attempt")

@@ -211,12 +211,28 @@ def encode_snapshot(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
     scored = _preds.resource_universe(nodes)
     seen = set(scored)
     request_only: List[str] = []
-    for p in list(pending_pods) + list(existing_pods):
-        for c in p.spec.containers:
-            for name in c.resources.limits:
-                if name not in seen:
-                    seen.add(name)
-                    request_only.append(name)
+    # one traversal extracts each pod's (resource, value) rows AND the
+    # request-only dims; the main passes below then never re-walk the
+    # container/limits object graph (the graph walk, not the arithmetic,
+    # dominates host encode time at 10k-pod waves)
+    CPU = api.ResourceCPU
+
+    def limit_rows(pods):
+        rows = []
+        for p in pods:
+            lr = []
+            for c in p.spec.containers:
+                for name, q in c.resources.limits.items():
+                    if name not in seen:
+                        seen.add(name)
+                        request_only.append(name)
+                    lr.append((name, q.milli_value() if name == CPU
+                               else q.int_value()))
+            rows.append(lr)
+        return rows
+
+    pend_limits = limit_rows(pending_pods)
+    exist_limits = limit_rows(existing_pods)
     resource_names = scored + sorted(request_only)
     R = len(resource_names)
     rindex = {name: r for r, name in enumerate(resource_names)}
@@ -258,34 +274,40 @@ def encode_snapshot(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
     pg_ij: List[Tuple[int, int]] = []   # (pod, pd-vocab)
     pf_ij: List[Tuple[int, int]] = []   # (pod, service-selector-vocab)
     pod_ns = np.zeros(P, np.int32)
+    svc_get = svc_vocab.get
+    rindex_get = rindex.get
+    node_index_get = node_index.get
+    pf_append = pf_ij.append
+    pp_append = pp_ij.append
     for j, p in enumerate(pending_pods):
         meta = p.metadata
+        spec = p.spec
         pod_names.append(f"{meta.namespace}/{meta.name}")
         pod_ns[j] = intern(ns_codes, meta.namespace)
-        lbls = meta.labels or {}
-        for kv in lbls.items():
-            t = svc_vocab.get(kv)
-            if t is not None:
-                pf_ij.append((j, t))
-        # inlined get_resource_request (predicates.go:93-101) — per-pod
-        # function + dataclass overhead shows up at 10k-pod waves
-        for c in p.spec.containers:
-            for name, q in c.resources.limits.items():
-                r = rindex.get(name)
-                if r is not None:
-                    req[j, r] += (q.milli_value() if name == api.ResourceCPU
-                                  else q.int_value())
+        lbls = meta.labels
+        if lbls:
+            for kv in lbls.items():
+                t = svc_get(kv)
+                if t is not None:
+                    pf_append((j, t))
+        # limit rows pre-extracted (predicates.go:93-101 semantics)
+        for name, val in pend_limits[j]:
+            r = rindex_get(name)
+            if r is not None:
+                req[j, r] += val
+        for c in spec.containers:
             for cp in c.ports:
                 if cp.host_port:
-                    pp_ij.append((j, intern(port_vocab, cp.host_port)))
-        for kv in (p.spec.node_selector or {}).items():
-            ps_ij.append((j, intern(sel_vocab, kv)))
-        for v in p.spec.volumes:
+                    pp_append((j, intern(port_vocab, cp.host_port)))
+        if spec.node_selector:
+            for kv in spec.node_selector.items():
+                ps_ij.append((j, intern(sel_vocab, kv)))
+        for v in spec.volumes:
             if v.source.gce_persistent_disk is not None:
                 pg_ij.append((j, intern(pd_vocab,
                                         v.source.gce_persistent_disk.pd_name)))
-        if p.spec.host:
-            pod_host_idx[j] = node_index.get(p.spec.host, -2)
+        if spec.host:
+            pod_host_idx[j] = node_index_get(spec.host, -2)
     pod_rid, pod_run_start = gang.pod_run_ids(pending_pods)
     tie = _fnv1a64_batch([pod_tie_break_key(p) for p in pending_pods])
     tie_hi = (tie >> np.uint64(32)).astype(np.int64)
@@ -323,29 +345,32 @@ def encode_snapshot(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
     nd_ij: List[Tuple[int, int]] = []     # (node, pd-vocab)
     ef_ij: List[Tuple[int, int]] = []     # (pod, service-selector-vocab)
     e_ns = np.full(E, -9, np.int32)       # unseen namespaces can't match
+    ns_get = ns_codes.get
+    port_get = port_vocab.get
+    ef_append = ef_ij.append
     for e, p in enumerate(existing_pods):
         meta = p.metadata
-        code = ns_codes.get(meta.namespace)
+        code = ns_get(meta.namespace)
         if code is not None:
             e_ns[e] = code
-        for kv in (meta.labels or {}).items():
-            t = svc_vocab.get(kv)
-            if t is not None:
-                ef_ij.append((e, t))
-        i = node_index.get(p.status.host, -1)
-        for c in p.spec.containers:
-            for name, q in c.resources.limits.items():
-                r = rindex.get(name)
-                if r is not None:
-                    e_req[e, r] += (q.milli_value() if name == api.ResourceCPU
-                                    else q.int_value())
-            if i >= 0:
-                for cp in c.ports:
-                    k = port_vocab.get(cp.host_port)
-                    if k is not None and cp.host_port:
-                        np_ij.append((i, k))
+        lbls = meta.labels
+        if lbls:
+            for kv in lbls.items():
+                t = svc_get(kv)
+                if t is not None:
+                    ef_append((e, t))
+        i = node_index_get(p.status.host, -1)
+        for name, val in exist_limits[e]:
+            r = rindex_get(name)
+            if r is not None:
+                e_req[e, r] += val
         if i < 0:
             continue
+        for c in p.spec.containers:
+            for cp in c.ports:
+                k = port_get(cp.host_port)
+                if k is not None and cp.host_port:
+                    np_ij.append((i, k))
         e_host[e] = i
         for v in p.spec.volumes:
             if v.source.gce_persistent_disk is not None:
