@@ -55,6 +55,10 @@ def log(*a):
 BASELINE_PODS_PER_S = 10_000.0
 TIMING_DESC = ("steady-state wave: encode + pipelined host->device + solve "
                "+ readback (median full-pipeline run; see timed_wave)")
+# watchdog defaults, shared by argparse and --help text
+DEFAULT_MAX_SECONDS = 2100.0
+DEFAULT_ATTEMPT_SECONDS = 900.0
+DEFAULT_RETRIES = 3
 
 
 # --------------------------------------------------------------------------
@@ -93,16 +97,20 @@ def parent(argv) -> int:
         # show both flag sets without spawning (or retrying) a child
         _child_parser().print_help()
         print("\ncapture-harness flags:\n"
-              "  --max-seconds S      overall watchdog budget (default 2100)\n"
-              "  --attempt-seconds S  per-attempt timeout (default 900)\n"
-              "  --retries R          re-attempts after a crash/hang (default 3)")
+              f"  --max-seconds S      overall watchdog budget "
+              f"(default {DEFAULT_MAX_SECONDS:.0f})\n"
+              f"  --attempt-seconds S  per-attempt timeout "
+              f"(default {DEFAULT_ATTEMPT_SECONDS:.0f})\n"
+              f"  --retries R          re-attempts after a crash/hang "
+              f"(default {DEFAULT_RETRIES})")
         return 0
     ap = argparse.ArgumentParser(add_help=False)
-    ap.add_argument("--max-seconds", type=float, default=2100.0,
+    ap.add_argument("--max-seconds", type=float, default=DEFAULT_MAX_SECONDS,
                     help="overall watchdog: total wall budget for all attempts")
-    ap.add_argument("--attempt-seconds", type=float, default=900.0,
+    ap.add_argument("--attempt-seconds", type=float,
+                    default=DEFAULT_ATTEMPT_SECONDS,
                     help="timeout for a single child attempt")
-    ap.add_argument("--retries", type=int, default=3,
+    ap.add_argument("--retries", type=int, default=DEFAULT_RETRIES,
                     help="max re-attempts after a crashed/hung child")
     args, child_args = ap.parse_known_args(argv)
 

@@ -211,16 +211,16 @@ def encode_snapshot(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
     scored = _preds.resource_universe(nodes)
     seen = set(scored)
     request_only: List[str] = []
-    # one traversal extracts each pod's (resource, value) rows AND the
-    # request-only dims; the main passes below then never re-walk the
-    # container/limits object graph (the graph walk, not the arithmetic,
-    # dominates host encode time at 10k-pod waves)
+    # one traversal extracts each pod's (resource, value) rows, its host
+    # ports, AND the request-only dims; the main passes below then never
+    # re-walk the container object graph (the graph walk, not the
+    # arithmetic, dominates host encode time at 10k-pod waves)
     CPU = api.ResourceCPU
 
-    def limit_rows(pods):
-        rows = []
+    def container_rows(pods):
+        limits, ports = [], []
         for p in pods:
-            lr = []
+            lr, pr = [], []
             for c in p.spec.containers:
                 for name, q in c.resources.limits.items():
                     if name not in seen:
@@ -228,11 +228,15 @@ def encode_snapshot(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
                         request_only.append(name)
                     lr.append((name, q.milli_value() if name == CPU
                                else q.int_value()))
-            rows.append(lr)
-        return rows
+                for cp in c.ports:
+                    if cp.host_port:
+                        pr.append(cp.host_port)
+            limits.append(lr)
+            ports.append(pr)
+        return limits, ports
 
-    pend_limits = limit_rows(pending_pods)
-    exist_limits = limit_rows(existing_pods)
+    pend_limits, pend_ports = container_rows(pending_pods)
+    exist_limits, exist_ports = container_rows(existing_pods)
     resource_names = scored + sorted(request_only)
     R = len(resource_names)
     rindex = {name: r for r, name in enumerate(resource_names)}
@@ -290,15 +294,13 @@ def encode_snapshot(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
                 t = svc_get(kv)
                 if t is not None:
                     pf_append((j, t))
-        # limit rows pre-extracted (predicates.go:93-101 semantics)
+        # limit/port rows pre-extracted (predicates.go:93-101 semantics)
         for name, val in pend_limits[j]:
             r = rindex_get(name)
             if r is not None:
                 req[j, r] += val
-        for c in spec.containers:
-            for cp in c.ports:
-                if cp.host_port:
-                    pp_append((j, intern(port_vocab, cp.host_port)))
+        for hp in pend_ports[j]:
+            pp_append((j, intern(port_vocab, hp)))
         if spec.node_selector:
             for kv in spec.node_selector.items():
                 ps_ij.append((j, intern(sel_vocab, kv)))
@@ -366,11 +368,10 @@ def encode_snapshot(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
                 e_req[e, r] += val
         if i < 0:
             continue
-        for c in p.spec.containers:
-            for cp in c.ports:
-                k = port_get(cp.host_port)
-                if k is not None and cp.host_port:
-                    np_ij.append((i, k))
+        for hp in exist_ports[e]:
+            k = port_get(hp)
+            if k is not None:
+                np_ij.append((i, k))
         e_host[e] = i
         for v in p.spec.volumes:
             if v.source.gce_persistent_disk is not None:
