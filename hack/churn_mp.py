@@ -1,0 +1,195 @@
+"""Churn at contract rate through the MULTI-PROCESS topology.
+
+The bench's in-process churn config puts the feeder, the apiserver, the
+watch pumps, and the scheduler wave loop in one Python process — every
+thread shares one GIL, which caps the offered rate well below what the
+components can individually sustain. The reference never runs that way:
+each component is its own process talking HTTP (DESIGN.md:40). This
+harness reproduces that deployment: an apiserver process, a kube-scheduler
+process (--algorithm tpu-batch), and N feeder processes offering pods at
+a paced aggregate rate over real HTTP. The result is recorded for the
+round (CHURN_MP_r{N}.json).
+
+Usage:
+  python hack/churn_mp.py [--pods 6000] [--rate 1000] [--nodes 500]
+                          [--feeders 4] [--out FILE]
+  (internal) python hack/churn_mp.py --_feed PREFIX COUNT RATE MASTER
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PY = sys.executable
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# APPEND to the ambient PYTHONPATH: it may carry backend plugins
+# (e.g. the axon TPU tunnel lives in an out-of-tree site dir)
+ENV = dict(os.environ, PYTHONPATH=_REPO + (
+    os.pathsep + os.environ["PYTHONPATH"]
+    if os.environ.get("PYTHONPATH") else ""))
+
+
+def feed(prefix: str, count: int, rate: float, master: str) -> int:
+    """Paced feeder (one process). Prints one JSON line when done."""
+    from kubernetes_tpu.api import types as api
+    from kubernetes_tpu.api.quantity import Quantity
+    from kubernetes_tpu.client.client import Client
+    from kubernetes_tpu.client.http import HTTPTransport
+
+    client = Client(HTTPTransport(master))
+    interval = 1.0 / rate
+    t0 = time.perf_counter()
+    next_t = t0
+    behind_max = 0.0
+    for i in range(count):
+        client.pods().create(api.Pod(
+            metadata=api.ObjectMeta(name=f"{prefix}-{i:06d}",
+                                    namespace="default"),
+            spec=api.PodSpec(containers=[api.Container(
+                name="c", image="img",
+                resources=api.ResourceRequirements(limits={
+                    "cpu": Quantity("100m"),
+                    "memory": Quantity("128Mi")}))])))
+        next_t += interval
+        now = time.perf_counter()
+        behind_max = max(behind_max, now - next_t)
+        if next_t > now:
+            time.sleep(next_t - now)
+    dt = time.perf_counter() - t0
+    print(json.dumps({"created": count, "seconds": round(dt, 3),
+                      "rate": round(count / dt, 1),
+                      "behind_max_s": round(behind_max, 3)}), flush=True)
+    return 0
+
+
+def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "--_feed":
+        return feed(argv[1], int(argv[2]), float(argv[3]), argv[4])
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pods", type=int, default=6000)
+    ap.add_argument("--rate", type=float, default=1000.0)
+    ap.add_argument("--nodes", type=int, default=500)
+    ap.add_argument("--feeders", type=int, default=4)
+    ap.add_argument("--port", type=int, default=18410)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+    master = f"http://127.0.0.1:{args.port}"
+
+    procs = []
+
+    logdir = "/tmp/churn_mp_logs"
+    os.makedirs(logdir, exist_ok=True)
+
+    def spawn(name, *cmd):
+        log = open(os.path.join(logdir, f"{name}.log"), "w")
+        p = subprocess.Popen(cmd, env=ENV, stdout=log, stderr=log)
+        procs.append(p)
+        return p
+
+    try:
+        spawn("apiserver", PY, "-m", "kubernetes_tpu.cmd.apiserver",
+              "--port", str(args.port))
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                urllib.request.urlopen(f"{master}/healthz", timeout=1)
+                break
+            except Exception:
+                time.sleep(0.3)
+        else:
+            raise RuntimeError("apiserver never became healthy")
+
+        from kubernetes_tpu.api import types as api
+        from kubernetes_tpu.api.quantity import Quantity
+        from kubernetes_tpu.client.client import Client
+        from kubernetes_tpu.client.http import HTTPTransport
+        client = Client(HTTPTransport(master))
+        for i in range(args.nodes):
+            client.nodes().create(api.Node(
+                metadata=api.ObjectMeta(name=f"node-{i:05d}"),
+                spec=api.NodeSpec(capacity={"cpu": Quantity("64"),
+                                            "memory": Quantity("256Gi")})))
+
+        spawn("scheduler", PY, "-m", "kubernetes_tpu.cmd.scheduler",
+              "--master", master, "--algorithm", "tpu-batch",
+              "--wave-period", "0.1")
+
+        def unbound():
+            lst = client.pods().list(field_selector="spec.host=")
+            return len(lst.items)
+
+        def wait_all_bound(total_created, timeout=180.0):
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                if unbound() == 0:
+                    return True
+                time.sleep(0.5)
+            return False
+
+        # warmup: every pow-2 wave bucket compiles before the clock starts
+        print("[churn-mp] warmup (compiling wave buckets)...",
+              file=sys.stderr, flush=True)
+        warm_total = 0
+        size = 1024
+        while size >= 1:
+            feed(f"warm{size}", size, 100000.0, master)
+            warm_total += size
+            if not wait_all_bound(warm_total):
+                raise RuntimeError(f"warmup bucket {size} did not bind")
+            size //= 2
+
+        print(f"[churn-mp] offering {args.pods} pods at {args.rate:.0f}/s "
+              f"via {args.feeders} feeder processes", file=sys.stderr,
+              flush=True)
+        per = args.pods // args.feeders
+        counts = [per + (1 if f < args.pods % args.feeders else 0)
+                  for f in range(args.feeders)]
+        t0 = time.perf_counter()
+        feeders = [subprocess.Popen(
+            [PY, os.path.abspath(__file__), "--_feed", f"churn{f}",
+             str(counts[f]), str(args.rate / args.feeders), master],
+            env=ENV, stdout=subprocess.PIPE, text=True)
+            for f in range(args.feeders)]
+        stats = [json.loads(p.communicate(timeout=600)[0].strip().splitlines()[-1])
+                 for p in feeders]
+        feed_s = time.perf_counter() - t0
+        ok = wait_all_bound(args.pods)
+        total_s = time.perf_counter() - t0
+        offered = sum(s["created"] for s in stats) / feed_s
+        sustained = args.pods / total_s if ok else 0.0
+        record = {
+            "config": f"churn multi-process: {args.pods} pods at "
+                      f"{args.rate:.0f}/s onto {args.nodes} nodes",
+            "topology": "apiserver + tpu-batch scheduler + "
+                        f"{args.feeders} feeders, separate processes, HTTP",
+            "offered_pods_per_s": round(offered, 1),
+            "sustained_pods_per_s": round(sustained, 1),
+            "all_bound": ok,
+            "feed_s": round(feed_s, 2),
+            "total_s": round(total_s, 2),
+            "feeder_behind_max_s": max(s["behind_max_s"] for s in stats),
+        }
+        out = json.dumps(record, indent=1)
+        print(out)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(out + "\n")
+        return 0 if ok else 1
+    finally:
+        for p in procs:
+            p.terminate()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

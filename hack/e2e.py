@@ -153,9 +153,10 @@ def suite_kubectl(c: Client, master: str):
     # build one with the real `kubectl config` verbs
     import tempfile
     kubeconfig = tempfile.mktemp(suffix=".kubeconfig")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ, KUBECONFIG=kubeconfig,
-               PYTHONPATH=os.path.dirname(os.path.dirname(
-                   os.path.abspath(__file__))))
+               PYTHONPATH=repo + (os.pathsep + os.environ["PYTHONPATH"]
+                                  if os.environ.get("PYTHONPATH") else ""))
 
     def kubectl(*args):
         return subprocess.run(
@@ -218,8 +219,10 @@ def main(argv=None) -> int:
     master = args.master
     if args.up:
         master = f"http://127.0.0.1:{args.port}"
-        env = dict(os.environ, PYTHONPATH=os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__))))
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ,
+                   PYTHONPATH=repo + (os.pathsep + os.environ["PYTHONPATH"]
+                                      if os.environ.get("PYTHONPATH") else ""))
         proc = subprocess.Popen(
             [sys.executable, "-m", "kubernetes_tpu.cmd.standalone",
              "--port", str(args.port)],

@@ -39,6 +39,7 @@ __all__ = ["KubeletServer"]
 
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
+    disable_nagle_algorithm = True
     server_version = "kubelet-tpu"
 
     def log_message(self, fmt, *args):
