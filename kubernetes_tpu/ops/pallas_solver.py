@@ -37,6 +37,7 @@ batched), plugin/pkg/scheduler/scheduler.go:90-119 (commit-per-decision).
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -198,12 +199,17 @@ def _spread_score_i32(total, counts):
 
 
 def _make_kernel(P, NR, PR, R, Wp, Wd, G, pol: BatchPolicy,
-                 gangs: bool = False, V: int = 0):
+                 gangs: bool = False, V: int = 0, B: int = 1):
     """Build the kernel body for static shapes/policy. Argument order:
     inputs (smask, podrow, cap, fit0, score0, fitexc, ports0, pds0,
     counts0, offl, advx[, zones, zlab when anti-affinity]), outputs
     (chosen, win), scratches (fit, score, ports, pds, counts[, ckpt_fit,
-    ckpt_score, ckpt_ports, ckpt_pds, ckpt_counts, flags when gangs])."""
+    ckpt_score, ckpt_ports, ckpt_pds, ckpt_counts, flags when gangs]).
+
+    ``B`` pods are processed per grid step (unrolled, strictly in pod
+    order — the sequential-commit semantics are untouched); the grid
+    bookkeeping and block switching are a large share of the ~10us
+    per-pod cost at B=1."""
     w_lr, w_spread, w_equal = pol.w_lr, pol.w_spread, pol.w_equal
     A = len(pol.anti_affinity)
 
@@ -238,12 +244,42 @@ def _make_kernel(P, NR, PR, R, Wp, Wd, G, pol: BatchPolicy,
             if gangs:
                 flags_ref[:] = jnp.zeros_like(flags_ref)
 
-        # NOTE: every per-pod quantity is extracted as a 0-d scalar
-        # (row[0, i]); the axon Mosaic compiler rejects [1,1]->[NR,128]
-        # broadcasts but lowers 0-d broadcasts fine.
-        row = podrow_ref[0]                          # [1, 128] i32
-        static_row = smask_ref[0]                    # [NR, 128] i32
+        # the gang failed-flag threads through the unrolled pods as a
+        # traced value; the plane is read once per step, written once
+        if gangs:
+            failed = flags_ref[0, 0] != 0            # 0-d bool
+        for b in range(B):
+            failed = _pod_step(
+                p * B + b, b, pol, gangs, A, V, R, Wp, Wd, G, NR, PR,
+                w_lr, w_spread, w_equal,
+                smask_ref, podrow_ref, cap_ref, fitexc_ref, offl_ref,
+                advx_ref,
+                zones_ref if A else None, zlab_ref if A else None,
+                chosen_ref, win_ref, state_refs,
+                ckpt_refs if gangs else None,
+                failed if gangs else None)
+        if gangs:
+            flags_ref[:] = jnp.zeros_like(flags_ref) + failed.astype(
+                jnp.int32)
 
+    return kernel
+
+
+def _pod_step(p_global, b, pol, gangs, A, V, R, Wp, Wd, G, NR, PR,
+              w_lr, w_spread, w_equal,
+              smask_ref, podrow_ref, cap_ref, fitexc_ref, offl_ref,
+              advx_ref, zones_ref, zlab_ref, chosen_ref, win_ref,
+              state_refs, ckpt_refs, failed):
+    """One pod's filter/score/select/commit against the live VMEM state.
+    Returns the threaded gang failed-flag (None when not a gang wave)."""
+    fit_ref, score_ref, ports_ref, pds_ref, counts_ref = state_refs
+    # NOTE: every per-pod quantity is extracted as a 0-d scalar
+    # (row[0, i]); the axon Mosaic compiler rejects [1,1]->[NR,128]
+    # broadcasts but lowers 0-d broadcasts fine.
+    row = podrow_ref[b]                          # [1, 128] i32
+    static_row = smask_ref[b]                    # [NR, 128] i32
+
+    if True:
         # ---- gang bookkeeping (solve_jit gang_step twin) -----------------
         # A new scheduling unit checkpoints the committed state; a failing
         # member pins the state at the checkpoint (undoing the run's
@@ -254,7 +290,7 @@ def _make_kernel(P, NR, PR, R, Wp, Wd, G, pol: BatchPolicy,
             def _checkpoint():
                 for c_ref, s_ref in zip(ckpt_refs, state_refs):
                     c_ref[:] = s_ref[:]
-            failed = (flags_ref[0, 0] != 0) & ~start  # 0-d bool
+            failed = failed & ~start                 # 0-d bool
 
         # ---- Filter ------------------------------------------------------
         feasible = static_row != 0
@@ -407,19 +443,16 @@ def _make_kernel(P, NR, PR, R, Wp, Wd, G, pol: BatchPolicy,
                 # nothing — a failed member chose no node)
                 for c_ref, s_ref in zip(ckpt_refs, state_refs):
                     s_ref[:] = c_ref[:]
-            flags_ref[:] = jnp.zeros_like(flags_ref) + failed.astype(
-                jnp.int32)
 
         # ---- write decision ----------------------------------------------
         oh_p = ((jax.lax.broadcasted_iota(jnp.int32, (PR, LANES), 0)
-                 == p // LANES) &
+                 == p_global // LANES) &
                 (jax.lax.broadcasted_iota(jnp.int32, (PR, LANES), 1)
-                 == p % LANES))
+                 == p_global % LANES))
         chosen_ref[:] = jnp.where(oh_p, chosen, chosen_ref[:])
         win_ref[:] = jnp.where(oh_p, jnp.where(any_f, top, NEG),
                                win_ref[:])
-
-    return kernel
+    return failed
 
 
 def _pad_nodes(x, Npad, fill=0):
@@ -466,17 +499,19 @@ def solve_pallas(inp, pol: Optional[BatchPolicy] = None,
             inp.pod_pds, inp.pod_host_idx, limbs, inp.pod_gid,
             inp.pod_group_member, inp.group_counts, inp.gang_start,
             inp.zone_onehot, inp.zone_labeled,
-            pol=pol, interpret=interpret, gangs=gangs)
+            pol=pol, interpret=interpret, gangs=gangs,
+            B=int(os.environ.get("KTPU_PALLAS_BLOCK", "1")))
 
 
-@functools.partial(jax.jit, static_argnames=("pol", "interpret", "gangs"))
+@functools.partial(jax.jit,
+                   static_argnames=("pol", "interpret", "gangs", "B"))
 def _solve_pallas_x32(cap_in, advertises, fit_used, fit_exceeded,
                       score_used, node_ports, node_sel, node_pds,
                       node_extra_ok, req_in, pod_ports, pod_sel, pod_pds,
                       pod_host_idx, tie_limbs, pod_gid, pod_group_member,
                       group_counts, gang_start, zone_onehot, zone_labeled,
-                      *, pol: BatchPolicy, interpret: bool, gangs: bool
-                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+                      *, pol: BatchPolicy, interpret: bool, gangs: bool,
+                      B: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
     N, R = cap_in.shape
     P = req_in.shape[0]
     Wp = node_ports.shape[1]
@@ -559,13 +594,23 @@ def _solve_pallas_x32(cap_in, advertises, fit_used, fit_exceeded,
                                    lambda p: (0, 0, 0)),
                       pl.BlockSpec((A, NR, LANES), lambda p: (0, 0, 0))]
 
-    kernel = _make_kernel(P, NR, PR, R, Wp, Wd, G, pol, gangs, V)
+    # B pods per grid step (strictly in pod order): padding rows get an
+    # all-zero static mask, so they are infeasible everywhere, commit
+    # nothing, and write NEG decisions that the final [:P] slice drops.
+    B = B if P >= B else 1
+    PB = -(-P // B)
+    Ppad = PB * B
+    if Ppad != P:
+        smask = jnp.pad(smask, ((0, Ppad - P), (0, 0), (0, 0)))
+        podrow = jnp.pad(podrow, ((0, Ppad - P), (0, 0)))
+
+    kernel = _make_kernel(P, NR, PR, R, Wp, Wd, G, pol, gangs, V, B)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=0,
-        grid=(P,),
+        grid=(PB,),
         in_specs=[
-            pl.BlockSpec((1, NR, LANES), lambda p: (p, 0, 0)),   # smask
-            pl.BlockSpec((1, 1, LANES), lambda p: (p, 0, 0)),    # podrow
+            pl.BlockSpec((B, NR, LANES), lambda p: (p, 0, 0)),   # smask
+            pl.BlockSpec((B, 1, LANES), lambda p: (p, 0, 0)),    # podrow
             pl.BlockSpec(cap.shape, lambda p: (0, 0, 0)),        # cap
             pl.BlockSpec(fit0.shape, lambda p: (0, 0, 0)),
             pl.BlockSpec(score0.shape, lambda p: (0, 0, 0)),
@@ -601,6 +646,6 @@ def _solve_pallas_x32(cap_in, advertises, fit_used, fit_exceeded,
         out_shape=[jax.ShapeDtypeStruct((PR, LANES), jnp.int32),
                    jax.ShapeDtypeStruct((PR, LANES), jnp.int32)],
         interpret=interpret,
-    )(smask, podrow.reshape(P, 1, LANES), cap, fit0, score0, fitexc,
+    )(smask, podrow.reshape(-1, 1, LANES), cap, fit0, score0, fitexc,
       ports0, pds0, counts0, offl, advx, *zone_args)
     return chosen2d.reshape(-1)[:P], win2d.reshape(-1)[:P]

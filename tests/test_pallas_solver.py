@@ -277,6 +277,21 @@ def test_solve_device_honors_mode_env(monkeypatch):
     assert np.array_equal(np.asarray(s_off), np.asarray(s_int))
 
 
+def test_block_batched_kernel_matches(monkeypatch):
+    # KTPU_PALLAS_BLOCK>1 processes several pods per grid step (unrolled,
+    # same order); decisions must be identical, including with gangs and
+    # a pod count that does not divide the block size
+    monkeypatch.setenv("KTPU_PALLAS_BLOCK", "4")
+    nodes, existing, pending, services = fuzz_wave(77, n_pods=19)
+    snap = encode_snapshot(nodes, existing, pending, services)
+    inp = snapshot_to_inputs(snap)
+    c1, s1 = solve_jit(inp, pol=snap.policy, gangs=False)
+    c2, s2 = pallas_solver.solve_pallas(inp, pol=snap.policy,
+                                        interpret=True)
+    assert np.array_equal(np.asarray(c1), np.asarray(c2))
+    assert np.array_equal(np.asarray(s1), np.asarray(s2))
+
+
 def test_spread_score_i32_matches_f32_reference():
     rng = np.random.RandomState(7)
     totals = np.concatenate([np.arange(1, 600),
