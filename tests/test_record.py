@@ -1,0 +1,122 @@
+"""Event recorder unit tests (ref: pkg/client/record/event.go +
+events_cache.go): compression bumps count on identical events, and the
+async wrapper posts in the background without stalling the caller."""
+
+import threading
+import time
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.apiserver.master import Master
+from kubernetes_tpu.client.client import Client, InProcessTransport
+from kubernetes_tpu.client.record import AsyncEventRecorder, EventRecorder
+
+
+def mk_pod(name="p1"):
+    return api.Pod(metadata=api.ObjectMeta(
+        name=name, namespace="default", uid=f"uid-{name}"))
+
+
+def setup():
+    m = Master()
+    client = Client(InProcessTransport(m))
+    rec = EventRecorder(client, api.EventSource(component="test"))
+    return client, rec
+
+
+def test_eventf_posts_and_compresses():
+    client, rec = setup()
+    pod = mk_pod()
+    rec.eventf(pod, "Scheduled", "placed on %s", "node-1")
+    rec.eventf(pod, "Scheduled", "placed on %s", "node-1")
+    evs = client.events("default").list().items
+    assert len(evs) == 1
+    assert evs[0].reason == "Scheduled"
+    assert evs[0].count == 2          # compression, not a second object
+    rec.eventf(pod, "Started", "container up")
+    assert len(client.events("default").list().items) == 2
+
+
+def test_async_recorder_posts_in_background():
+    client, rec = setup()
+    arec = AsyncEventRecorder(rec)
+    try:
+        for i in range(5):
+            arec.eventf(mk_pod(f"p{i}"), "Scheduled", "ok")
+        assert arec.flush(timeout=5.0)
+        assert len(client.events("default").list().items) == 5
+    finally:
+        arec.stop()
+
+
+def test_async_recorder_never_blocks_caller_on_slow_posts():
+    client, rec = setup()
+    gate = threading.Event()
+    orig = rec.eventf
+
+    def slow_eventf(*a, **kw):
+        gate.wait(5.0)
+        return orig(*a, **kw)
+    rec.eventf = slow_eventf
+    arec = AsyncEventRecorder(rec)
+    try:
+        t0 = time.perf_counter()
+        for i in range(10):
+            arec.eventf(mk_pod(f"s{i}"), "Scheduled", "ok")
+        assert time.perf_counter() - t0 < 0.5    # enqueue only
+        gate.set()
+        assert arec.flush(timeout=10.0)
+        assert len(client.events("default").list().items) == 10
+    finally:
+        gate.set()
+        arec.stop()
+
+
+def test_async_recorder_flush_covers_in_flight_item():
+    client, rec = setup()
+    release = threading.Event()
+    posted = []
+    orig = rec.eventf
+
+    def gated(*a, **kw):
+        release.wait(5.0)
+        out = orig(*a, **kw)
+        posted.append(out)
+        return out
+    rec.eventf = gated
+    arec = AsyncEventRecorder(rec)
+    try:
+        arec.eventf(mk_pod("only"), "Scheduled", "ok")
+        time.sleep(0.1)   # worker has popped it; queue is empty, post gated
+        assert not arec.flush(timeout=0.3)   # must NOT claim done
+        release.set()
+        assert arec.flush(timeout=5.0)
+        assert len(posted) == 1
+    finally:
+        release.set()
+        arec.stop()
+
+
+def test_async_recorder_drops_oldest_under_storm():
+    client, rec = setup()
+    gate = threading.Event()
+    orig = rec.eventf
+    rec.eventf = lambda *a, **kw: (gate.wait(10.0), orig(*a, **kw))[1]
+    arec = AsyncEventRecorder(rec, max_queue=8)
+    try:
+        for i in range(100):                  # storm >> queue bound
+            arec.eventf(mk_pod(f"x{i}"), "Scheduled", "ok")
+        gate.set()
+        assert arec.flush(timeout=10.0)
+        n = len(client.events("default").list().items)
+        assert n <= 10                        # bounded: old events shed
+    finally:
+        gate.set()
+        arec.stop()
+
+
+def test_async_recorder_stop_is_idempotent_and_rejects_after():
+    client, rec = setup()
+    arec = AsyncEventRecorder(rec)
+    arec.stop()
+    arec.stop()
+    arec.eventf(mk_pod(), "Scheduled", "ok")   # no-op, no crash
