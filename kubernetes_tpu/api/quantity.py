@@ -68,9 +68,12 @@ class Quantity:
     ``Quantity("3e6")``. Arithmetic (+, -, comparison) is exact.
     """
 
-    # _milli_cache/_int_cache memoize the accessor results (arithmetic
-    # always returns new Quantity objects, so .value never mutates in place)
-    __slots__ = ("value", "format", "_milli_cache", "_int_cache")
+    # _milli_cache/_int_cache/_str_cache memoize the accessor results
+    # (arithmetic always returns new Quantity objects, so .value never
+    # mutates in place); the str form is the wire encoding and dominates
+    # per-object serialization cost via Fraction arithmetic otherwise
+    __slots__ = ("value", "format", "_milli_cache", "_int_cache",
+                 "_str_cache")
 
     def __init__(self, value="0", fmt=None):
         if isinstance(value, Quantity):
@@ -170,7 +173,11 @@ class Quantity:
 
     # -- formatting ---------------------------------------------------------
     def __str__(self) -> str:
-        return _format(self.value, self.format)
+        cached = getattr(self, "_str_cache", None)
+        if cached is None:
+            cached = _format(self.value, self.format)
+            object.__setattr__(self, "_str_cache", cached)
+        return cached
 
     def __repr__(self) -> str:
         return f"Quantity({str(self)!r})"
