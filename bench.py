@@ -120,7 +120,8 @@ def parent(argv) -> int:
     last_err = "no attempt ran"
     best_partial = None   # newest cumulative record from a crashed/hung child
 
-    for attempt in range(args.retries + 1):
+    attempt = 0
+    while attempt < args.retries + 1:
         remaining = deadline - time.monotonic()
         if remaining <= 5.0:
             last_err += f" (watchdog: {args.max_seconds:.0f}s budget exhausted)"
@@ -180,9 +181,20 @@ def parent(argv) -> int:
             else:
                 last_err = (f"child exited rc={p.returncode} with no JSON; "
                             f"stderr tail: {p.stderr[-500:].strip()!r}")
+                if p.returncode == 17:
+                    # backend unavailable/wedged: the child failed fast;
+                    # keep probing on the remaining budget without burning
+                    # the bounded retry count — the tunnel may heal
+                    log(f"[bench] {last_err}")
+                    log("[bench] backend unavailable; waiting 60s")
+                    if time.monotonic() + 60.0 < deadline:
+                        time.sleep(60.0)
+                        continue
+                    break
             log(f"[bench] {last_err}")
-        if attempt < args.retries:
-            pause = backoffs[min(attempt, len(backoffs) - 1)]
+        attempt += 1
+        if attempt < args.retries + 1:
+            pause = backoffs[min(attempt - 1, len(backoffs) - 1)]
             if time.monotonic() + pause < deadline:
                 log(f"[bench] backing off {pause:.0f}s before retry")
                 time.sleep(pause)
@@ -640,14 +652,32 @@ def child(argv) -> int:
     if args.smoke or args.cpu:
         jax.config.update("jax_platforms", "cpu")
 
-    # Fail fast if the backend is unreachable: surface the error to stderr
-    # and exit non-zero quickly so the parent can retry with backoff.
-    try:
-        backend = jax.default_backend()
-        devices = jax.devices()
-    except Exception as e:  # noqa: BLE001 — any backend error means retry
-        log(f"[bench-child] backend init failed: {type(e).__name__}: {e}")
+    # Fail fast if the backend is unreachable OR WEDGED: a dead TPU tunnel
+    # makes backend init hang forever (not raise), which would burn the
+    # whole per-attempt budget. Probe in a thread with a hard deadline and
+    # exit quickly so the parent retries with backoff — if the tunnel
+    # heals mid-budget, a later attempt completes normally.
+    import threading
+    probe: dict = {}
+
+    def _probe():
+        try:
+            probe["backend"] = jax.default_backend()
+            probe["devices"] = jax.devices()
+        except Exception as e:  # noqa: BLE001 — any backend error => retry
+            probe["error"] = f"{type(e).__name__}: {e}"
+
+    t = threading.Thread(target=_probe, daemon=True)
+    t.start()
+    t.join(timeout=90.0)
+    if t.is_alive():
+        log("[bench-child] backend init HUNG >90s (tunnel wedged?); "
+            "failing fast for a parent retry")
+        os._exit(17)   # the hung thread would block a clean interpreter exit
+    if "error" in probe:
+        log(f"[bench-child] backend init failed: {probe['error']}")
         return 17
+    backend, devices = probe["backend"], probe["devices"]
     log(f"backend={backend} devices={devices}")
 
     from kubernetes_tpu.scheduler.plugins import (
