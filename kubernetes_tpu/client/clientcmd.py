@@ -188,9 +188,25 @@ def client_from_config(explicit_path: str = "", context: str = "",
         auth = ("bearer", user.token)
     elif user.username:
         auth = ("basic", user.username, user.password)
-    return Client(HTTPTransport(
-        cluster.server, auth=auth,
-        ca_cert=cluster.certificate_authority,
-        client_cert=user.client_certificate,
-        client_key=user.client_key,
-        insecure_skip_tls_verify=cluster.insecure_skip_tls_verify))
+    kw = dict(auth=auth,
+              ca_cert=cluster.certificate_authority,
+              client_cert=user.client_certificate,
+              client_key=user.client_key,
+              insecure_skip_tls_verify=cluster.insecure_skip_tls_verify)
+    if auth is None and not user.client_certificate:
+        # legacy ~/.kubernetes_auth fallback (ref: pkg/clientauth) — the
+        # pre-kubeconfig authorization file cluster bring-up wrote
+        from kubernetes_tpu.client.clientauth import load_from_file
+        environ = env if env is not None else os.environ
+        legacy = environ.get(
+            "KUBERNETES_AUTH_PATH",
+            os.path.join(os.path.expanduser("~"), ".kubernetes_auth"))
+        try:
+            info = load_from_file(legacy)
+            if info.complete():
+                kw.update(info.transport_kwargs())
+        except (OSError, ValueError):
+            # absent, unreadable, or malformed: proceed unauthenticated,
+            # exactly as if the legacy file did not exist
+            pass
+    return Client(HTTPTransport(cluster.server, **kw))

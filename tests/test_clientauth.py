@@ -1,0 +1,80 @@
+"""Legacy .kubernetes_auth file (ref: pkg/clientauth/clientauth.go)."""
+
+import json
+
+import pytest
+
+from kubernetes_tpu.client.clientauth import Info, load_from_file
+
+
+def test_load_merges_into_transport_kwargs(tmp_path):
+    p = tmp_path / ".kubernetes_auth"
+    p.write_text(json.dumps({
+        "User": "admin", "Password": "s3cret", "CAFile": "/ca.crt",
+        "CertFile": "/c.crt", "KeyFile": "/c.key", "Insecure": True}))
+    info = load_from_file(str(p))
+    assert info.complete()
+    kw = info.transport_kwargs()
+    assert kw["auth"] == ("basic", "admin", "s3cret")
+    assert kw["ca_cert"] == "/ca.crt"
+    assert kw["client_cert"] == "/c.crt"
+    assert kw["client_key"] == "/c.key"
+    assert kw["insecure_skip_tls_verify"] is True
+
+
+def test_bearer_token_wins_over_basic(tmp_path):
+    p = tmp_path / "auth"
+    p.write_text(json.dumps({"User": "u", "BearerToken": "tok"}))
+    kw = load_from_file(str(p)).transport_kwargs()
+    assert kw["auth"] == ("bearer", "tok")
+
+
+def test_missing_file_raises_not_exist(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        load_from_file(str(tmp_path / "nope"))
+    assert not Info().complete()
+
+
+def test_wrong_shape_raises_value_error(tmp_path):
+    p = tmp_path / "auth"
+    p.write_text('["User"]')          # valid JSON, wrong shape
+    with pytest.raises(ValueError):
+        load_from_file(str(p))
+
+
+def test_isolated_env_skips_real_environment(tmp_path, monkeypatch):
+    # env={} must be hermetic: a $KUBERNETES_AUTH_PATH in the REAL
+    # environment (pointing at real credentials) must not leak into a
+    # client built with an explicit empty env
+    from kubernetes_tpu.client.clientcmd import client_from_config
+    kubeconfig = tmp_path / "kubeconfig"
+    kubeconfig.write_text(json.dumps({
+        "clusters": [{"name": "c",
+                      "cluster": {"server": "http://127.0.0.1:1"}}],
+        "contexts": [{"name": "x", "context": {"cluster": "c"}}],
+        "current-context": "x"}))
+    real = tmp_path / "real_auth"
+    real.write_text(json.dumps({"User": "leaky", "Password": "oops"}))
+    monkeypatch.setenv("KUBERNETES_AUTH_PATH", str(real))
+    monkeypatch.setattr("os.path.expanduser", lambda p: str(tmp_path / "nohome"))
+    client = client_from_config(str(kubeconfig), env={})
+    assert "Authorization" not in client.transport._headers
+
+
+def test_kubeconfig_falls_back_to_legacy_auth_file(tmp_path, monkeypatch):
+    # a kubeconfig naming only a server picks up credentials from the
+    # legacy authorization file, like the pre-kubeconfig clients did
+    from kubernetes_tpu.client.clientcmd import client_from_config
+    kubeconfig = tmp_path / "kubeconfig"
+    kubeconfig.write_text(json.dumps({
+        "clusters": [{"name": "c",
+                      "cluster": {"server": "http://127.0.0.1:1"}}],
+        "contexts": [{"name": "x", "context": {"cluster": "c"}}],
+        "current-context": "x"}))
+    legacy = tmp_path / ".kubernetes_auth"
+    legacy.write_text(json.dumps({"User": "legacy", "Password": "pw"}))
+    monkeypatch.setenv("KUBERNETES_AUTH_PATH", str(legacy))
+    client = client_from_config(str(kubeconfig))
+    import base64
+    expect = "Basic " + base64.b64encode(b"legacy:pw").decode()
+    assert client.transport._headers["Authorization"] == expect
