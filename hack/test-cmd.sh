@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# kubectl CLI conformance against a live apiserver (ref: hack/test-cmd.sh:
+# the reference boots a local apiserver and walks kubectl through its
+# verbs). Here: the CLI-facing unit suites plus the e2e driver's kubectl
+# suite over real HTTP with a kubeconfig built by the real config verbs.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python -m pytest tests/test_kubectl.py tests/test_clientauth.py \
+    tests/test_inventory_cloud.py -q "$@"
+python hack/e2e.py --up --port 18650 --focus kubectl
