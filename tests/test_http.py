@@ -119,6 +119,22 @@ class TestCRUD:
         r2.read()
         conn.close()
 
+    def test_transport_retries_dead_keptalive_connection(self, client,
+                                                         server):
+        # a server may close an idle kept-alive connection between our
+        # requests; the transport must retry once on a fresh connection
+        # instead of surfacing the transport error
+        client.pods().create(make_pod("ka-retry"))
+        conn = client.transport._conn()
+        conn.sock.close()       # simulate server-side idle close
+        got = client.pods().get("ka-retry")
+        assert got.metadata.name == "ka-retry"
+
+    def test_transport_reuses_one_connection_per_thread(self, client):
+        c1 = client.transport._conn()
+        client.pods().list()
+        assert client.transport._conn() is c1
+
     def test_single_object_watch_scoped_by_name(self, client):
         client.pods().create(make_pod("target"))
         w = client.transport.request("watch", "pods", namespace="default",
