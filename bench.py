@@ -217,7 +217,10 @@ def parent(argv) -> int:
         env = {k: v for k, v in os.environ.items()
                if k not in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS")}
         try:
-            p = subprocess.run(cmd + ["--cpu"], timeout=args.attempt_seconds,
+            # the full matrix on CPU takes ~16min (slow serial-oracle gates
+            # and scan-path solves) — give the one fallback attempt room
+            p = subprocess.run(cmd + ["--cpu"],
+                               timeout=max(args.attempt_seconds, 1500.0),
                                capture_output=True, text=True, env=env)
             sys.stderr.write(p.stderr[-4000:])
             line = _extract_json_line(p.stdout)
