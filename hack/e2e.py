@@ -20,6 +20,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import queue
 import subprocess
 import sys
 import time
@@ -183,12 +184,21 @@ def suite_watch(c: Client, master: str):
     try:
         c.pods(NS).create(mk_pod("e2e-watch"))
         deadline = time.monotonic() + 15
-        for ev in w:
+        while True:
+            # Bounded read: a silent stream must still trip the deadline
+            # (a bare `for ev in w` would block forever on an empty queue).
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise AssertionError("no ADDED event over HTTP watch")
+            try:
+                ev = w.next_event(timeout=min(remaining, 1.0))
+            except queue.Empty:
+                continue
+            if ev is None:
+                raise AssertionError("watch stream ended before ADDED event")
             if (ev.type == "ADDED"
                     and getattr(ev.object.metadata, "name", "") == "e2e-watch"):
                 break
-            if time.monotonic() > deadline:
-                raise AssertionError("no ADDED event over HTTP watch")
     finally:
         w.stop()
         c.pods(NS).delete("e2e-watch")

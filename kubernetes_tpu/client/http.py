@@ -12,6 +12,7 @@ from __future__ import annotations
 import base64
 import http.client
 import json
+import select
 import socket
 import threading
 import urllib.error
@@ -114,6 +115,25 @@ class HTTPTransport:
     def _conn(self):
         tl = self._tl
         conn = getattr(tl, "conn", None)
+        if conn is not None and conn.sock is not None \
+                and not isinstance(conn, http.client.HTTPSConnection):
+            # Go's Transport notices a server-side close through its
+            # background read loop and evicts the idle connection before a
+            # request can land on it; emulate that with a zero-timeout
+            # readability probe. Any pending byte/EOF on an idle plaintext
+            # HTTP/1.1 connection means it is unusable for a new request —
+            # drop it so even a POST goes out on a live socket instead of
+            # dying after the send (where no safe retry exists). Plain
+            # sockets only: under TLS a pending control record (session
+            # ticket, KeyUpdate) also reads as 'readable' and would evict a
+            # healthy connection, so HTTPS relies on the retry rules alone.
+            try:
+                readable, _, _ = select.select([conn.sock], [], [], 0)
+            except (OSError, ValueError):
+                readable = True
+            if readable:
+                self._drop_conn()
+                conn = None
         if conn is None:
             parsed = urllib.parse.urlsplit(self.base_url)
             if parsed.scheme == "https":
@@ -140,18 +160,24 @@ class HTTPTransport:
             except Exception:
                 pass
 
-    def _open(self, url: str, method: str, body: Optional[bytes] = None,
-              timeout: Optional[float] = None):
-        """-> (status, raw bytes); raises StatusError on HTTP errors. The
-        request is retried once on a dead kept-alive connection (the server
-        may close an idle connection between our requests)."""
+    def _open(self, url: str, method: str, body: Optional[bytes] = None):
+        """-> (status, raw bytes); raises StatusError on HTTP errors. A dead
+        kept-alive connection is retried once under Go http.Transport's rules
+        (which the reference relies on, ref: pkg/client/restclient.go): only
+        when the retry cannot double-execute — the method is idempotent, or
+        the request was never fully written to the socket. Server idle-closes
+        are instead caught BEFORE sending by _conn's readability probe, the
+        same way Go's background read loop evicts dead idle connections."""
         parsed = urllib.parse.urlsplit(url)
         path = parsed.path + ("?" + parsed.query if parsed.query else "")
+        idempotent = method in ("GET", "HEAD")
         for attempt in (0, 1):
             conn = self._conn()
+            sent = False
             try:
                 conn.request(method, path, body=body,
                              headers=dict(self._headers))
+                sent = True
                 resp = conn.getresponse()
                 raw = resp.read()
                 status = resp.status
@@ -160,7 +186,13 @@ class HTTPTransport:
                 break
             except (http.client.HTTPException, ConnectionError, OSError):
                 self._drop_conn()
-                if attempt:
+                # Once a non-idempotent request has gone out in full, the
+                # server may have executed it even though the response never
+                # arrived — a blind re-send would duplicate the create/delete
+                # (spurious 409/404). Surface the connection error instead,
+                # exactly as Go refuses to retry non-replayable requests
+                # (net/http transport.go shouldRetryRequest/isReplayable).
+                if attempt or (sent and not idempotent):
                     raise
         if status >= 400:
             self._raise_status_error(raw, status)

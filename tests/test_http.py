@@ -130,6 +130,63 @@ class TestCRUD:
         got = client.pods().get("ka-retry")
         assert got.metadata.name == "ka-retry"
 
+    def test_transport_retries_post_when_send_fails(self, client):
+        # send-phase failure (request never fully written): safe to retry
+        # even for non-idempotent verbs, as Go's http.Transport does. The
+        # socket stays healthy so the _conn probe passes and the failure
+        # genuinely exercises the sent=False branch of the retry loop.
+        client.pods().list()
+        conn = client.transport._conn()
+
+        def die_mid_write(*a, **kw):
+            raise BrokenPipeError("request died mid-write")
+
+        conn.request = die_mid_write
+        created = client.pods().create(make_pod("ka-post"))
+        assert created.metadata.name == "ka-post"
+
+    def test_transport_no_retry_nonidempotent_after_send(self, client):
+        # the connection dies AFTER the POST went out in full (and not with
+        # the idle-close signature): the server may have executed it, so a
+        # blind retry would double-create (spurious 409). The transport must
+        # surface the connection error instead.
+        conn = client.transport._conn()
+        attempts = []
+        orig_getresponse = conn.getresponse
+
+        def boom():
+            attempts.append(1)
+            # drain the real response first so the server has definitely
+            # executed the create; the failure models the RESPONSE being
+            # lost in transit, the truly ambiguous case
+            orig_getresponse().read()
+            raise ConnectionResetError("connection died awaiting response")
+
+        conn.getresponse = boom
+        with pytest.raises(ConnectionResetError):
+            client.pods().create(make_pod("np-1"))
+        assert len(attempts) == 1
+        # the one send really did execute server-side
+        assert client.pods().get("np-1").metadata.name == "np-1"
+
+    def test_conn_probe_evicts_peer_closed_connection(self, client):
+        # a server idle-close must be caught BEFORE the next request is sent
+        # (the readability probe in _conn, emulating Go's background read
+        # loop) — otherwise a POST would die after the send, where no safe
+        # retry exists. Swap the kept-alive socket for one whose peer has
+        # closed and check the transport silently reconnects, even for a
+        # non-idempotent create.
+        import socket as socketlib
+        client.pods().list()                      # establish a kept-alive conn
+        conn = client.transport._conn()
+        ours, theirs = socketlib.socketpair()
+        conn.sock.close()
+        conn.sock = ours
+        theirs.close()                            # peer closed: EOF pending
+        created = client.pods().create(make_pod("idle-evict"))
+        assert created.metadata.name == "idle-evict"
+        assert client.transport._conn() is not conn
+
     def test_transport_reuses_one_connection_per_thread(self, client):
         c1 = client.transport._conn()
         client.pods().list()
