@@ -65,6 +65,97 @@ DEFAULT_RETRIES = 3
 # Parent harness: never hang, never stack-trace, always one JSON line.
 # --------------------------------------------------------------------------
 
+def _find_replay_record(reason: str):
+    """Newest committed CPUBENCH_r*.json as a pre-serialized JSON line, or
+    None. Replaying a committed record costs milliseconds — it is the only
+    fallback that fits inside ANY external budget once the TPU tunnel is
+    known to be wedged (round 3 lost its whole record to a driver timeout
+    that fired while a fresh 1500s CPU fallback was still pending)."""
+    import glob
+    import re
+    repo = os.path.dirname(os.path.abspath(__file__))
+    best = None
+    for f in glob.glob(os.path.join(repo, "CPUBENCH_r*.json")):
+        m = re.search(r"CPUBENCH_r(\d+)\.json$", f)
+        if m and (best is None or int(m.group(1)) > best[0]):
+            best = (int(m.group(1)), f)
+    if best is None:
+        return None
+    try:
+        with open(best[1]) as fh:
+            rec = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(rec, dict) or "metric" not in rec:
+        return None
+    name = os.path.basename(best[1])
+    rec["backend"] = f"cpu (replayed {name}; {reason})"
+    rec["replayed_from"] = name
+    return json.dumps(rec)
+
+
+def _probe_backend(timeout_s: float):
+    """Spawn a tiny child that inits the JAX backend with a hard internal
+    deadline. Returns the backend name ('tpu', 'cpu', ...) or None when the
+    backend is unreachable or wedged (init hangs instead of raising when
+    the axon tunnel is dead)."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--_probe",
+           str(max(10.0, timeout_s - 10.0))]
+    try:
+        p = subprocess.run(cmd, timeout=timeout_s, capture_output=True,
+                           text=True)
+    except (subprocess.TimeoutExpired, OSError) as e:
+        log(f"[bench] backend probe failed: {type(e).__name__}")
+        return None
+    line = _extract_json_line(p.stdout, required_key="backend")
+    if p.returncode == 0 and line is not None:
+        return json.loads(line).get("backend")
+    log(f"[bench] backend probe rc={p.returncode}; "
+        f"stderr tail: {p.stderr[-300:].strip()!r}")
+    return None
+
+
+def _zero_record(reason: str) -> str:
+    """The last-resort emission: a zero-value record carrying the reason."""
+    return json.dumps({
+        "metric": "pods_scheduled_per_sec", "value": 0.0,
+        "unit": "pods/s", "vs_baseline": 0.0, "error": reason[-800:]})
+
+
+def _emit_fallback(cmd, child_args, deadline, reason, last_err) -> int:
+    """Terminal fallback, always prints exactly one JSON line: replay the
+    committed CPU record when the invocation is the driver's default (costs
+    milliseconds), else one fresh labeled CPU run on the remaining budget,
+    else a zero-value error record."""
+    if not child_args:   # replay only answers the default invocation
+        replay = _find_replay_record(reason)
+        if replay is not None:
+            log(f"[bench] {reason}; replaying the committed CPU record")
+            print(replay)
+            return 1
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS")}
+    t = deadline - time.monotonic() - 10.0
+    if t > 30.0:
+        log(f"[bench] {reason}; no replayable record — fresh CPU fallback "
+            f"(timeout {t:.0f}s)")
+        try:
+            p = subprocess.run(cmd + ["--cpu"], timeout=t,
+                               capture_output=True, text=True, env=env)
+            sys.stderr.write(p.stderr[-4000:])
+            line = _extract_json_line(p.stdout)
+            if line is not None:
+                print(line)
+                return p.returncode
+            last_err += "; CPU fallback produced no JSON"
+        except (subprocess.TimeoutExpired, OSError) as e:
+            last_err += f"; CPU fallback failed: {type(e).__name__}"
+    else:
+        last_err += "; no budget left for a CPU fallback"
+    print(_zero_record(f"{reason}; {last_err}"))
+    return 1
+
+
 def _better_partial(current, candidate):
     """Keep the partial record carrying the most MEASURED configs — a
     retry that crashes earlier (or whose configs failed on a degraded
@@ -77,9 +168,10 @@ def _better_partial(current, candidate):
     return candidate if measured_new > measured_cur else current
 
 
-def _extract_json_line(text: str):
-    """Last line of `text` that parses as a JSON object, or None."""
-    for line in reversed(text.strip().splitlines()):
+def _extract_json_line(text: str, required_key: str = "metric"):
+    """Last line of `text` that parses as a JSON object carrying
+    `required_key`, or None."""
+    for line in reversed((text or "").strip().splitlines()):
         line = line.strip()
         if not line.startswith("{"):
             continue
@@ -87,7 +179,7 @@ def _extract_json_line(text: str):
             obj = json.loads(line)
         except ValueError:
             continue
-        if isinstance(obj, dict) and "metric" in obj:
+        if isinstance(obj, dict) and required_key in obj:
             return line
     return None
 
@@ -120,9 +212,35 @@ def parent(argv) -> int:
     last_err = "no attempt ran"
     best_partial = None   # newest cumulative record from a crashed/hung child
 
+    # One cheap probe before committing any real budget: a wedged axon
+    # tunnel makes backend init HANG (not raise), and round 3 proved that
+    # probing with full-sized attempts + inter-attempt sleeps can eat an
+    # unknown external budget before any record is emitted.
+    if "--cpu" not in child_args and "--smoke" not in child_args:
+        probe_t = min(150.0, deadline - time.monotonic() - 15.0)
+        if probe_t < 45.0:
+            # budget too small for a conclusive probe: go straight to the
+            # bounded attempts rather than misdiagnose a healthy backend
+            log("[bench] budget too small for a backend probe; "
+                "attempting directly")
+        else:
+            backend = _probe_backend(probe_t)
+            if backend is None:
+                return _emit_fallback(
+                    cmd, child_args, deadline,
+                    "TPU tunnel unreachable/wedged at capture time", last_err)
+            if backend == "cpu":
+                # plugin absent entirely: full-matrix attempts on CPU blow
+                # the attempt timeouts — take the labeled fallback now
+                return _emit_fallback(
+                    cmd, child_args, deadline,
+                    "no accelerator visible (backend probe found cpu)",
+                    last_err)
+            log(f"[bench] backend probe ok: {backend}")
+
     attempt = 0
     while attempt < args.retries + 1:
-        remaining = deadline - time.monotonic()
+        remaining = deadline - time.monotonic() - 10.0   # reserve for emission
         if remaining <= 5.0:
             last_err += f" (watchdog: {args.max_seconds:.0f}s budget exhausted)"
             break
@@ -182,14 +300,12 @@ def parent(argv) -> int:
                 last_err = (f"child exited rc={p.returncode} with no JSON; "
                             f"stderr tail: {p.stderr[-500:].strip()!r}")
                 if p.returncode == 17:
-                    # backend unavailable/wedged: the child failed fast;
-                    # keep probing on the remaining budget without burning
-                    # the bounded retry count — the tunnel may heal
+                    # the backend wedged AFTER a healthy probe: the tunnel
+                    # died mid-run. Don't sleep-and-hope on an unknown
+                    # external budget (round 3's fatal pattern) — fall
+                    # straight through to the fallback emission.
                     log(f"[bench] {last_err}")
-                    log("[bench] backend unavailable; waiting 60s")
-                    if time.monotonic() + 60.0 < deadline:
-                        time.sleep(60.0)
-                        continue
+                    log("[bench] backend wedged mid-run; abandoning retries")
                     break
             log(f"[bench] {last_err}")
         attempt += 1
@@ -207,38 +323,52 @@ def parent(argv) -> int:
         return 1
 
     if "--cpu" not in child_args and "--smoke" not in child_args:
-        # The accelerator never became reachable inside the budget (the
-        # tunnel can wedge for hours): one final CPU attempt, explicitly
-        # labeled as the fallback record, beats emitting zero. The axon
-        # plugin is dropped from the child's environment — a wedged tunnel
-        # hangs even CPU-backend processes at plugin init otherwise.
-        log("[bench] TPU unavailable for the whole budget; recording a "
-            "labeled CPU fallback")
-        env = {k: v for k, v in os.environ.items()
-               if k not in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS")}
-        try:
-            # the full matrix on CPU takes ~16min (slow serial-oracle gates
-            # and scan-path solves) — give the one fallback attempt room
-            p = subprocess.run(cmd + ["--cpu"],
-                               timeout=max(args.attempt_seconds, 1500.0),
-                               capture_output=True, text=True, env=env)
-            sys.stderr.write(p.stderr[-4000:])
-            line = _extract_json_line(p.stdout)
-            if line is not None:
-                print(line)
-                return p.returncode
-            last_err += "; CPU fallback produced no JSON"
-        except (subprocess.TimeoutExpired, OSError) as e:
-            last_err += f"; CPU fallback failed: {type(e).__name__}"
+        # The matrix never completed on the accelerator even though the
+        # probe was healthy (runs crashed/hung/timed out).
+        return _emit_fallback(cmd, child_args, deadline,
+                              "accelerator attempts exhausted mid-run",
+                              last_err)
 
-    print(json.dumps({
-        "metric": "pods_scheduled_per_sec",
-        "value": 0.0,
-        "unit": "pods/s",
-        "vs_baseline": 0.0,
-        "error": last_err[-800:],
-    }))
+    print(_zero_record(last_err))
     return 1
+
+
+def _init_backend_or_die(deadline_s: float):
+    """Init the JAX backend under a hard deadline: returns
+    (backend_name, devices), or None on an init error — and os._exit(17)s
+    on a HANG (a wedged axon tunnel hangs init instead of raising, and the
+    stuck thread would block a clean interpreter exit)."""
+    import threading
+    probe: dict = {}
+
+    def _p():
+        try:
+            import jax
+            probe["backend"] = jax.default_backend()
+            probe["devices"] = jax.devices()
+        except Exception as e:  # noqa: BLE001 — any backend error => down
+            probe["error"] = f"{type(e).__name__}: {e}"
+
+    t = threading.Thread(target=_p, daemon=True)
+    t.start()
+    t.join(timeout=deadline_s)
+    if t.is_alive():
+        log(f"[bench] backend init HUNG >{deadline_s:.0f}s (tunnel wedged?); "
+            "failing fast")
+        os._exit(17)
+    if "error" in probe:
+        log(f"[bench] backend init failed: {probe['error']}")
+        return None
+    return probe["backend"], probe["devices"]
+
+
+def probe_child(deadline_s: float) -> int:
+    """--_probe mode: report the backend name under a hard init deadline."""
+    res = _init_backend_or_die(deadline_s)
+    if res is None:
+        return 17
+    print(json.dumps({"backend": res[0]}))
+    return 0
 
 
 # --------------------------------------------------------------------------
@@ -680,30 +810,11 @@ def child(argv) -> int:
 
     # Fail fast if the backend is unreachable OR WEDGED: a dead TPU tunnel
     # makes backend init hang forever (not raise), which would burn the
-    # whole per-attempt budget. Probe in a thread with a hard deadline and
-    # exit quickly so the parent retries with backoff — if the tunnel
-    # heals mid-budget, a later attempt completes normally.
-    import threading
-    probe: dict = {}
-
-    def _probe():
-        try:
-            probe["backend"] = jax.default_backend()
-            probe["devices"] = jax.devices()
-        except Exception as e:  # noqa: BLE001 — any backend error => retry
-            probe["error"] = f"{type(e).__name__}: {e}"
-
-    t = threading.Thread(target=_probe, daemon=True)
-    t.start()
-    t.join(timeout=90.0)
-    if t.is_alive():
-        log("[bench-child] backend init HUNG >90s (tunnel wedged?); "
-            "failing fast for a parent retry")
-        os._exit(17)   # the hung thread would block a clean interpreter exit
-    if "error" in probe:
-        log(f"[bench-child] backend init failed: {probe['error']}")
+    # whole per-attempt budget.
+    res = _init_backend_or_die(90.0)
+    if res is None:
         return 17
-    backend, devices = probe["backend"], probe["devices"]
+    backend, devices = res
     log(f"backend={backend} devices={devices}")
 
     from kubernetes_tpu.scheduler.plugins import (
@@ -815,4 +926,7 @@ def child(argv) -> int:
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--_child":
         sys.exit(child(sys.argv[2:]))
+    if len(sys.argv) > 1 and sys.argv[1] == "--_probe":
+        sys.exit(probe_child(float(sys.argv[2]) if len(sys.argv) > 2
+                             else 90.0))
     sys.exit(parent(sys.argv[1:]))

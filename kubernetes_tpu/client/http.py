@@ -116,24 +116,14 @@ class HTTPTransport:
         tl = self._tl
         conn = getattr(tl, "conn", None)
         if conn is not None and conn.sock is not None \
-                and not isinstance(conn, http.client.HTTPSConnection):
+                and self._conn_stale(conn):
             # Go's Transport notices a server-side close through its
             # background read loop and evicts the idle connection before a
-            # request can land on it; emulate that with a zero-timeout
-            # readability probe. Any pending byte/EOF on an idle plaintext
-            # HTTP/1.1 connection means it is unusable for a new request —
-            # drop it so even a POST goes out on a live socket instead of
-            # dying after the send (where no safe retry exists). Plain
-            # sockets only: under TLS a pending control record (session
-            # ticket, KeyUpdate) also reads as 'readable' and would evict a
-            # healthy connection, so HTTPS relies on the retry rules alone.
-            try:
-                readable, _, _ = select.select([conn.sock], [], [], 0)
-            except (OSError, ValueError):
-                readable = True
-            if readable:
-                self._drop_conn()
-                conn = None
+            # request can land on it; _conn_stale emulates that, so even a
+            # POST goes out on a live socket instead of dying after the
+            # send (where no safe retry exists).
+            self._drop_conn()
+            conn = None
         if conn is None:
             parsed = urllib.parse.urlsplit(self.base_url)
             if parsed.scheme == "https":
@@ -150,6 +140,47 @@ class HTTPTransport:
             conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             tl.conn = conn
         return conn
+
+    @staticmethod
+    def _conn_stale(conn) -> bool:
+        """True when an idle kept-alive connection is unusable for a new
+        request. Zero-timeout readability poll (poll(2) — select(2)'s
+        FD_SETSIZE cap would falsely flag healthy sockets on fd>=1024):
+        any pending byte/EOF on an idle plaintext HTTP/1.1 connection means
+        the server closed or desynced. Under TLS a pending record can also
+        be a benign control message (session ticket, KeyUpdate), so peek
+        through the TLS layer: SSLWantReadError = control-only = healthy;
+        EOF or unsolicited app data = stale."""
+        sock = conn.sock
+        try:
+            if hasattr(select, "poll"):
+                p = select.poll()
+                p.register(sock,
+                           select.POLLIN | select.POLLHUP | select.POLLERR)
+                readable = bool(p.poll(0))
+            else:  # platforms without poll(2)
+                readable, _, _ = select.select([sock], [], [], 0)
+        except (OSError, ValueError):
+            return True
+        if not readable:
+            return False
+        if not isinstance(conn, http.client.HTTPSConnection):
+            return True
+        import ssl
+        prev = sock.gettimeout()
+        try:
+            sock.settimeout(0.0)
+            sock.recv(1)        # b'' (EOF) or app data: both unusable
+            return True
+        except ssl.SSLWantReadError:
+            return False        # partial TLS control record; conn healthy
+        except OSError:
+            return True
+        finally:
+            try:
+                sock.settimeout(prev)
+            except OSError:
+                pass
 
     def _drop_conn(self):
         conn = getattr(self._tl, "conn", None)
