@@ -19,16 +19,21 @@ kernel type has no 64-bit lanes. The FNV-1a tie-break is a 16-bit-limb
 Horner modulo. The k-th-best selection uses triangular-matmul prefix
 ranks (exact: counts < 2^24 in f32 with HIGHEST precision).
 
-Scope (``eligible`` says so): the default-provider policy vocabulary —
+Scope (``eligible`` says so): the WHOLE modeled policy vocabulary —
 PodFitsResources/PodFitsPorts/NoDiskConflict/MatchNodeSelector/HostName
 filters (the selector/host/static masks ride the XLA MXU pre-pass, as in
-solve_jit) and LeastRequested/ServiceSpreading/Equal priorities, int32
-resource waves. Gang (PodGroup all-or-nothing) waves are in-domain: the
-kernel checkpoints the committed state at each scheduling-unit start and
-a failing member rolls the whole run back — solve_jit's gang_step, with
-the checkpoint in a second set of VMEM planes. Affinity/anti-affinity/
-label-preference policies fall back to the XLA scan; so do waves whose
-counts could reach 2^15 (the limb domains) or >32640 nodes.
+solve_jit), CheckNodeLabelPresence (static mask), CheckServiceAffinity
+(anchor values in a [G, LANES] VMEM scratch, lanes 0..L-1; the has-anchor
+flag lane-replicated in a sibling scratch so commits need no cross-lane
+broadcast), LeastRequested/ServiceSpreading/Equal priorities,
+NodeLabelPriority (static additive plane), and ServiceAntiAffinity
+(V-deep zone reduction planes) — int32 resource waves. Gang (PodGroup
+all-or-nothing) waves are in-domain: the kernel checkpoints the committed
+state (including anchors) at each scheduling-unit start and a failing
+member rolls the whole run back — solve_jit's gang_step, with the
+checkpoint in a second set of VMEM planes. Fallbacks to the XLA scan:
+waves whose counts could reach 2^15 (the limb domains), >32640 nodes,
+>4 affinity labels, or int64 resource planes.
 
 ref: pkg/scheduler/generic_scheduler.go:54-128 (the serial loop being
 batched), plugin/pkg/scheduler/scheduler.go:90-119 (commit-per-decision).
@@ -62,6 +67,7 @@ _GID = 28
 _MEMBER = 29       # member bitmask over groups (G <= 31)
 _ZREQ = 30         # 1 when the pod requests zero of everything
 _START = 31        # 1 when this pod begins a new scheduling unit (gangs)
+_AFF0 = 32         # L <= 4 ServiceAffinity selector-pinned value codes
 
 _MAX_R = 8
 _MAX_W = 8
@@ -70,6 +76,7 @@ _MAX_N = 32640     # tie-break/limb domains need counts < 2^15
 _MAX_COUNT = 1 << 15
 _MAX_A = 4         # anti-affinity labels carried as V-deep zone planes
 _MAX_V = 64
+_MAX_L = 4         # ServiceAffinity labels riding podrow lanes 32..35
 _VMEM_BUDGET = 12 << 20   # leave headroom under the ~16MB per-core VMEM
 
 
@@ -84,10 +91,10 @@ def eligible(inp, pol: Optional[BatchPolicy], gangs: bool,
     must stay below 2^15 for the limb arithmetic. Gang waves are
     in-domain: the kernel carries a checkpoint copy of the committed
     state and rolls a failed run back, mirroring solve_jit's gang_step.
-    Zone anti-affinity is in-domain via per-zone reduction planes."""
+    Zone anti-affinity is in-domain via per-zone reduction planes;
+    ServiceAffinity anchors live in two tiny [G, LANES] scratches;
+    NodeLabelPriority is one extra static plane."""
     if pol is None:
-        return False
-    if pol.has_affinity or pol.label_prefs:
         return False
     if pol.all_infeasible:
         return False
@@ -106,6 +113,13 @@ def eligible(inp, pol: Optional[BatchPolicy], gangs: bool,
         if not (0 < A <= _MAX_A and V <= _MAX_V
                 and A == len(pol.anti_affinity)):
             return False
+    L = 0
+    if pol.has_affinity:
+        L = inp.node_aff_vals.shape[1]
+        # the snapshot must have been encoded for THIS policy's labels, and
+        # the pinned codes must ride podrow lanes _AFF0..
+        if not (0 < L <= _MAX_L and L == len(pol.affinity_labels)):
+            return False
     # spread/anti-affinity totals stay below 2^15: initial peers plus
     # every wave commit
     if peer_bound + inp.req.shape[0] >= _MAX_COUNT:
@@ -118,9 +132,13 @@ def eligible(inp, pol: Optional[BatchPolicy], gangs: bool,
     Wp, Wd = inp.node_ports.shape[1], inp.node_pds.shape[1]
     state = 2 * R + Wp + Wd + G
     planes = (state + R + 1) + state + A * V + A     # inputs+scratch+zones
+    planes += L                                      # node_aff_vals planes
+    if pol.label_prefs:
+        planes += 1                                  # static score plane
     if gangs:
         planes += state + 1                          # checkpoint copy
-    if planes * NR * LANES * 4 > _VMEM_BUDGET:
+    anchors = 6 if pol.has_affinity else 0   # in+scratch+ckpt aff/has rows
+    if planes * NR * LANES * 4 + anchors * G * LANES * 4 > _VMEM_BUDGET:
         return False
     return True
 
@@ -199,12 +217,14 @@ def _spread_score_i32(total, counts):
 
 
 def _make_kernel(P, NR, PR, R, Wp, Wd, G, pol: BatchPolicy,
-                 gangs: bool = False, V: int = 0, B: int = 1):
+                 gangs: bool = False, V: int = 0, B: int = 1, L: int = 0):
     """Build the kernel body for static shapes/policy. Argument order:
     inputs (smask, podrow, cap, fit0, score0, fitexc, ports0, pds0,
-    counts0, offl, advx[, zones, zlab when anti-affinity]), outputs
-    (chosen, win), scratches (fit, score, ports, pds, counts[, ckpt_fit,
-    ckpt_score, ckpt_ports, ckpt_pds, ckpt_counts, flags when gangs]).
+    counts0, offl, advx[, sstat when label-prefs][, affv, anchor0, has0
+    when service-affinity][, zones, zlab when anti-affinity]), outputs
+    (chosen, win), scratches (fit, score, ports, pds, counts[, aff, has
+    when service-affinity][, the matching ckpt_* copies and flags when
+    gangs]).
 
     ``B`` pods are processed per grid step (unrolled, strictly in pod
     order — the sequential-commit semantics are untouched); the grid
@@ -212,33 +232,46 @@ def _make_kernel(P, NR, PR, R, Wp, Wd, G, pol: BatchPolicy,
     per-pod cost at B=1."""
     w_lr, w_spread, w_equal = pol.w_lr, pol.w_spread, pol.w_equal
     A = len(pol.anti_affinity)
+    has_sstat = bool(pol.label_prefs)
+    has_aff = L > 0
 
     def kernel(smask_ref, podrow_ref, cap_ref, fit0_ref, score0_ref,
                fitexc_ref, ports0_ref, pds0_ref, counts0_ref, offl_ref,
                advx_ref, *rest):
         i = 0
+        sstat_ref = affv_ref = anchor0_ref = has0_ref = None
+        zones_ref = zlab_ref = None
+        if has_sstat:
+            sstat_ref = rest[i]
+            i += 1
+        if has_aff:
+            affv_ref, anchor0_ref, has0_ref = rest[i:i + 3]
+            i += 3
         if A:
-            zones_ref, zlab_ref = rest[0], rest[1]
-            i = 2
+            zones_ref, zlab_ref = rest[i], rest[i + 1]
+            i += 2
         chosen_ref, win_ref = rest[i], rest[i + 1]
-        fit_ref, score_ref, ports_ref, pds_ref, counts_ref = \
-            rest[i + 2:i + 7]
-        gang_refs = rest[i + 7:]
+        i += 2
+        fit_ref, score_ref, ports_ref, pds_ref, counts_ref = rest[i:i + 5]
+        i += 5
+        state_refs = [fit_ref, score_ref, ports_ref, pds_ref, counts_ref]
+        init_refs = [fit0_ref, score0_ref, ports0_ref, pds0_ref, counts0_ref]
+        aff_refs = None
+        if has_aff:
+            aff_refs = (rest[i], rest[i + 1])        # anchor values, has
+            i += 2
+            state_refs += list(aff_refs)
+            init_refs += [anchor0_ref, has0_ref]
+        gang_refs = rest[i:]
         p = pl.program_id(0)
-        state_refs = (fit_ref, score_ref, ports_ref, pds_ref, counts_ref)
         if gangs:
-            (cfit_ref, cscore_ref, cports_ref, cpds_ref, ccounts_ref,
-             flags_ref) = gang_refs
-            ckpt_refs = (cfit_ref, cscore_ref, cports_ref, cpds_ref,
-                         ccounts_ref)
+            ckpt_refs = tuple(gang_refs[:-1])        # mirrors state_refs
+            flags_ref = gang_refs[-1]
 
         @pl.when(p == 0)
         def _init():
-            fit_ref[:] = fit0_ref[:]
-            score_ref[:] = score0_ref[:]
-            ports_ref[:] = ports0_ref[:]
-            pds_ref[:] = pds0_ref[:]
-            counts_ref[:] = counts0_ref[:]
+            for s_ref, s0_ref in zip(state_refs, init_refs):
+                s_ref[:] = s0_ref[:]
             chosen_ref[:] = jnp.full_like(chosen_ref, NEG)
             win_ref[:] = jnp.full_like(win_ref, NEG)
             if gangs:
@@ -250,12 +283,12 @@ def _make_kernel(P, NR, PR, R, Wp, Wd, G, pol: BatchPolicy,
             failed = flags_ref[0, 0] != 0            # 0-d bool
         for b in range(B):
             failed = _pod_step(
-                p * B + b, b, pol, gangs, A, V, R, Wp, Wd, G, NR, PR,
+                p * B + b, b, pol, gangs, A, V, L, R, Wp, Wd, G, NR, PR,
                 w_lr, w_spread, w_equal,
                 smask_ref, podrow_ref, cap_ref, fitexc_ref, offl_ref,
-                advx_ref,
-                zones_ref if A else None, zlab_ref if A else None,
-                chosen_ref, win_ref, state_refs,
+                advx_ref, sstat_ref, affv_ref,
+                zones_ref, zlab_ref,
+                chosen_ref, win_ref, tuple(state_refs), aff_refs,
                 ckpt_refs if gangs else None,
                 failed if gangs else None)
         if gangs:
@@ -265,19 +298,22 @@ def _make_kernel(P, NR, PR, R, Wp, Wd, G, pol: BatchPolicy,
     return kernel
 
 
-def _pod_step(p_global, b, pol, gangs, A, V, R, Wp, Wd, G, NR, PR,
+def _pod_step(p_global, b, pol, gangs, A, V, L, R, Wp, Wd, G, NR, PR,
               w_lr, w_spread, w_equal,
               smask_ref, podrow_ref, cap_ref, fitexc_ref, offl_ref,
-              advx_ref, zones_ref, zlab_ref, chosen_ref, win_ref,
-              state_refs, ckpt_refs, failed):
+              advx_ref, sstat_ref, affv_ref, zones_ref, zlab_ref,
+              chosen_ref, win_ref, state_refs, aff_refs, ckpt_refs, failed):
     """One pod's filter/score/select/commit against the live VMEM state.
     Returns the threaded gang failed-flag (None when not a gang wave)."""
-    fit_ref, score_ref, ports_ref, pds_ref, counts_ref = state_refs
+    fit_ref, score_ref, ports_ref, pds_ref, counts_ref = state_refs[:5]
+    if aff_refs is not None:
+        aff_ref, has_ref = aff_refs
     # NOTE: every per-pod quantity is extracted as a 0-d scalar
     # (row[0, i]); the axon Mosaic compiler rejects [1,1]->[NR,128]
     # broadcasts but lowers 0-d broadcasts fine.
     row = podrow_ref[b]                          # [1, 128] i32
     static_row = smask_ref[b]                    # [NR, 128] i32
+    gid = row[0, _GID]                           # 0-d
 
     if True:
         # ---- gang bookkeeping (solve_jit gang_step twin) -----------------
@@ -322,6 +358,26 @@ def _pod_step(p_global, b, pol, gangs, A, V, R, Wp, Wd, G, NR, PR,
                 pw = row[0, _PDS0 + w]
                 conflict = conflict | ((pds_ref[w] & pw) != 0)
             feasible = feasible & ~conflict
+        if L:
+            # CheckServiceAffinity, anchor-derived constraints
+            # (predicates.go:256-276): once the pod's group has an anchor,
+            # labels the selector didn't pin must match the anchor's
+            # values. The anchor row is gathered by a masked [G, LANES]
+            # reduction (no dynamic VMEM indexing); the has flag is
+            # lane-replicated in has_ref so one masked lane read suffices.
+            g_iota = jax.lax.broadcasted_iota(jnp.int32, (G, LANES), 0)
+            l_iota = jax.lax.broadcasted_iota(jnp.int32, (G, LANES), 1)
+            selrow = g_iota == gid                   # gid<0 matches nothing
+            picked = jnp.where(selrow, aff_ref[:], 0)
+            has = jnp.sum(jnp.where(selrow & (l_iota == 0),
+                                    has_ref[:], 0)) != 0      # 0-d bool
+            dyn = jnp.ones((NR, LANES), jnp.bool_)
+            for l in range(L):
+                a_l = jnp.sum(jnp.where(l_iota == l, picked, 0))    # 0-d
+                pin_l = row[0, _AFF0 + l]                           # 0-d
+                need = (pin_l == -2) & (a_l >= 0)
+                dyn = dyn & (~need | (affv_ref[l] == a_l))
+            feasible = feasible & (~has | dyn)
 
         # ---- Score -------------------------------------------------------
         score = jnp.zeros((NR, LANES), jnp.int32)
@@ -341,7 +397,6 @@ def _pod_step(p_global, b, pol, gangs, A, V, R, Wp, Wd, G, NR, PR,
                     adv = jnp.any((advx_ref[r] != 0) & feasible)
                     n_dyn = n_dyn + adv.astype(jnp.int32)
             score = score + (total_sc // n_dyn) * w_lr
-        gid = row[0, _GID]                                      # 0-d
         if w_spread or A:
             # counts row of the pod's first service via masked reduction
             # (no dynamic VMEM indexing needed); gid < 0 matches no group
@@ -372,6 +427,9 @@ def _pod_step(p_global, b, pol, gangs, A, V, R, Wp, Wd, G, NR, PR,
             s = _spread_score_i32(num, cnt)
             s = s * (zlab_ref[a] != 0)
             score = score + s * w
+        if pol.label_prefs:
+            # NodeLabelPriority: static additive plane (priorities.go:98-134)
+            score = score + sstat_ref[:]
         if w_equal:
             score = score + w_equal
         masked = jnp.where(feasible, score, NEG)
@@ -432,6 +490,23 @@ def _pod_step(p_global, b, pol, gangs, A, V, R, Wp, Wd, G, NR, PR,
             in_g = (member >> g) & 1                     # 0-d
             counts_ref[g] = counts_ref[g] + \
                 jnp.where(onehot, in_g, 0)
+        if L:
+            # set the anchor of every group this commit gives its first
+            # peer (solve_jit's newly = member & ~has_anchor & committed):
+            # one full-plane masked write per scratch, no G-loop
+            g_iota = jax.lax.broadcasted_iota(jnp.int32, (G, LANES), 0)
+            l_iota = jax.lax.broadcasted_iota(jnp.int32, (G, LANES), 1)
+            in_g_rows = (jnp.right_shift(member, g_iota) & 1) != 0
+            newly = in_g_rows & (has_ref[:] == 0) & any_f
+            newvals = jnp.zeros((G, LANES), jnp.int32)
+            for l in range(L):
+                # the chosen node's value code for label l (0-d; harmless
+                # garbage when nothing was chosen — newly is then False)
+                ch_l = jnp.sum(jnp.where(onehot, affv_ref[l], 0))
+                newvals = jnp.where(l_iota == l, ch_l, newvals)
+            aff_ref[:] = jnp.where(newly & (l_iota < L), newvals,
+                                   aff_ref[:])
+            has_ref[:] = jnp.where(newly, 1, has_ref[:])
 
         # ---- gang rollback ------------------------------------------------
         if gangs:
@@ -499,6 +574,8 @@ def solve_pallas(inp, pol: Optional[BatchPolicy] = None,
             inp.pod_pds, inp.pod_host_idx, limbs, inp.pod_gid,
             inp.pod_group_member, inp.group_counts, inp.gang_start,
             inp.zone_onehot, inp.zone_labeled,
+            inp.score_static, inp.node_aff_vals, inp.pod_aff_static,
+            inp.anchor_vals0, inp.has_anchor0,
             pol=pol, interpret=interpret, gangs=gangs,
             B=int(os.environ.get("KTPU_PALLAS_BLOCK", "1")))
 
@@ -510,6 +587,8 @@ def _solve_pallas_x32(cap_in, advertises, fit_used, fit_exceeded,
                       node_extra_ok, req_in, pod_ports, pod_sel, pod_pds,
                       pod_host_idx, tie_limbs, pod_gid, pod_group_member,
                       group_counts, gang_start, zone_onehot, zone_labeled,
+                      score_static, node_aff_vals, pod_aff_static,
+                      anchor_vals0, has_anchor0,
                       *, pol: BatchPolicy, interpret: bool, gangs: bool,
                       B: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
     N, R = cap_in.shape
@@ -517,6 +596,7 @@ def _solve_pallas_x32(cap_in, advertises, fit_used, fit_exceeded,
     Wp = node_ports.shape[1]
     Wd = node_pds.shape[1]
     G = max(group_counts.shape[0], 1)
+    L = node_aff_vals.shape[1] if pol.has_affinity else 0
     NR = max(1, -(-N // LANES))
     Npad = NR * LANES
     PR = max(1, -(-P // LANES))
@@ -533,6 +613,13 @@ def _solve_pallas_x32(cap_in, advertises, fit_used, fit_exceeded,
         host_ok = (pod_host_idx[:, None] == -1) | \
                   (pod_host_idx[:, None] == arange_n[None, :])
         static_mask = static_mask & host_ok
+    if L:
+        # node-selector-pinned affinity constraints are static per pod
+        # (predicates.go:247-254); -2 = label not pinned by the selector
+        for l in range(L):
+            pinned = pod_aff_static[:, l, None]                # [P, 1]
+            static_mask = static_mask & (
+                (pinned == -2) | (node_aff_vals[None, :, l] == pinned))
     # int32, not int8: the axon Mosaic compiler 500s on int8 blocks in
     # non-trivial kernels (empirically bisected); the extra HBM footprint
     # (4 bytes/node/pod, ~200MB at 10k x 5k) streams at 20KB/step
@@ -557,6 +644,25 @@ def _solve_pallas_x32(cap_in, advertises, fit_used, fit_exceeded,
     counts0 = counts0.reshape(G, NR, LANES)
     offl = jnp.broadcast_to(gc[:, N:N + 1].astype(jnp.int32), (G, LANES))
     advx = plane(advertises)
+    # NodeLabelPriority static score plane + ServiceAffinity planes/anchors
+    extra_args, extra_specs = [], []
+    if pol.label_prefs:
+        sstat = _pad_nodes(score_static.astype(jnp.int32)[None, :], Npad,
+                           0).reshape(NR, LANES)
+        extra_args.append(sstat)
+        extra_specs.append(pl.BlockSpec((NR, LANES), lambda p: (0, 0)))
+    if L:
+        affv = plane(node_aff_vals)                  # fill 0 is fine: the
+        # padded nodes are statically infeasible, so their codes never win
+        anchor0 = jnp.zeros((G, LANES), jnp.int32)
+        anchor0 = anchor0.at[:, :L].set(
+            anchor_vals0[:G].astype(jnp.int32))
+        has0 = jnp.broadcast_to(
+            has_anchor0[:G].astype(jnp.int32)[:, None], (G, LANES))
+        extra_args += [affv, anchor0, has0]
+        extra_specs += [pl.BlockSpec((L, NR, LANES), lambda p: (0, 0, 0)),
+                        pl.BlockSpec((G, LANES), lambda p: (0, 0)),
+                        pl.BlockSpec((G, LANES), lambda p: (0, 0))]
 
     # ---- pod rows --------------------------------------------------------
     podrow = jnp.zeros((P, LANES), jnp.int32)
@@ -578,6 +684,9 @@ def _solve_pallas_x32(cap_in, advertises, fit_used, fit_exceeded,
         jnp.all(req_in == 0, axis=1).astype(jnp.int32))
     if gangs:
         podrow = podrow.at[:, _START].set(gang_start.astype(jnp.int32))
+    if L:
+        podrow = podrow.at[:, _AFF0:_AFF0 + L].set(
+            pod_aff_static.astype(jnp.int32))
 
     # ---- zone planes for anti-affinity ([A*V, NR, 128] i32 one-hots) -----
     A = len(pol.anti_affinity)
@@ -604,7 +713,17 @@ def _solve_pallas_x32(cap_in, advertises, fit_used, fit_exceeded,
         smask = jnp.pad(smask, ((0, Ppad - P), (0, 0), (0, 0)))
         podrow = jnp.pad(podrow, ((0, Ppad - P), (0, 0)))
 
-    kernel = _make_kernel(P, NR, PR, R, Wp, Wd, G, pol, gangs, V, B)
+    kernel = _make_kernel(P, NR, PR, R, Wp, Wd, G, pol, gangs, V, B, L)
+    state_shapes = [
+        pltpu.VMEM((R, NR, LANES), jnp.int32),   # fit
+        pltpu.VMEM((R, NR, LANES), jnp.int32),   # score_used
+        pltpu.VMEM((Wp, NR, LANES), jnp.int32),  # ports
+        pltpu.VMEM((Wd, NR, LANES), jnp.int32),  # pds
+        pltpu.VMEM((G, NR, LANES), jnp.int32),   # counts
+    ]
+    if L:
+        state_shapes += [pltpu.VMEM((G, LANES), jnp.int32),   # anchors
+                         pltpu.VMEM((G, LANES), jnp.int32)]   # has flags
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=0,
         grid=(PB,),
@@ -620,25 +739,16 @@ def _solve_pallas_x32(cap_in, advertises, fit_used, fit_exceeded,
             pl.BlockSpec((G, NR, LANES), lambda p: (0, 0, 0)),   # counts0
             pl.BlockSpec((G, LANES), lambda p: (0, 0)),          # offl
             pl.BlockSpec(advx.shape, lambda p: (0, 0, 0)),
-        ] + zone_specs,
+        ] + extra_specs + zone_specs,
         out_specs=[
             pl.BlockSpec((PR, LANES), lambda p: (0, 0)),
             pl.BlockSpec((PR, LANES), lambda p: (0, 0)),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((R, NR, LANES), jnp.int32),   # fit
-            pltpu.VMEM((R, NR, LANES), jnp.int32),   # score_used
-            pltpu.VMEM((Wp, NR, LANES), jnp.int32),  # ports
-            pltpu.VMEM((Wd, NR, LANES), jnp.int32),  # pds
-            pltpu.VMEM((G, NR, LANES), jnp.int32),   # counts
-        ] + ([
-            pltpu.VMEM((R, NR, LANES), jnp.int32),   # ckpt fit
-            pltpu.VMEM((R, NR, LANES), jnp.int32),   # ckpt score_used
-            pltpu.VMEM((Wp, NR, LANES), jnp.int32),  # ckpt ports
-            pltpu.VMEM((Wd, NR, LANES), jnp.int32),  # ckpt pds
-            pltpu.VMEM((G, NR, LANES), jnp.int32),   # ckpt counts
-            pltpu.VMEM((8, LANES), jnp.int32),       # failed flag
-        ] if gangs else []),
+        scratch_shapes=state_shapes + (
+            # gang checkpoints mirror state_shapes ref-for-ref, then the
+            # failed flag
+            state_shapes + [pltpu.VMEM((8, LANES), jnp.int32)]
+            if gangs else []),
     )
     chosen2d, win2d = pl.pallas_call(
         kernel,
@@ -647,5 +757,5 @@ def _solve_pallas_x32(cap_in, advertises, fit_used, fit_exceeded,
                    jax.ShapeDtypeStruct((PR, LANES), jnp.int32)],
         interpret=interpret,
     )(smask, podrow.reshape(-1, 1, LANES), cap, fit0, score0, fitexc,
-      ports0, pds0, counts0, offl, advx, *zone_args)
+      ports0, pds0, counts0, offl, advx, *extra_args, *zone_args)
     return chosen2d.reshape(-1)[:P], win2d.reshape(-1)[:P]

@@ -246,6 +246,160 @@ def test_gang_with_anti_affinity_interpret_matches_solve_jit(seed):
     assert np.array_equal(np.asarray(s1), np.asarray(s2))
 
 
+@pytest.mark.parametrize("seed", range(4))
+def test_label_prefs_interpret_matches_solve_jit(seed):
+    # NodeLabelPriority: static additive plane (priorities.go:98-134)
+    rng = random.Random(3000 + seed)
+    nodes = [mk_node(f"n-{i:03d}", cpu_m=rng.choice([2000, 4000]),
+                     labels=({"disk": "ssd"} if i % 3 == 0 else {}))
+             for i in range(9)]
+    _, existing, pending, services = fuzz_wave(3000 + seed, n_nodes=9)
+    pol = BatchPolicy(w_lr=1, label_prefs=(("disk", True, 2),
+                                           ("gpu", False, 1)))
+    snap = encode_snapshot(nodes, existing, pending, services, policy=pol)
+    inp = snapshot_to_inputs(snap)
+    assert pallas_solver.eligible(
+        inp, pol, False, int(snap.group_counts.sum(axis=1).max(initial=0)))
+    c1, s1 = solve_jit(inp, pol=pol, gangs=False)
+    c2, s2 = pallas_solver.solve_pallas(inp, pol=pol, interpret=True)
+    assert np.array_equal(np.asarray(c1), np.asarray(c2))
+    assert np.array_equal(np.asarray(s1), np.asarray(s2))
+
+
+def aff_wave(seed, n_nodes=9, n_pods=12, with_existing=True):
+    """Wave where ServiceAffinity anchors matter: nodes carry region/rack
+    labels, pods share services, some pods pin a region by selector."""
+    rng = random.Random(seed)
+    nodes = [mk_node(f"n-{i:03d}", cpu_m=rng.choice([2000, 4000, 8000]),
+                     labels={"region": f"r{i % 3}", "rack": f"k{i % 4}"})
+             for i in range(n_nodes)]
+    existing = []
+    if with_existing:
+        for i in range(3):
+            existing.append(mk_pod(
+                f"old-{i}", cpu_m=100, host=rng.choice(nodes).metadata.name,
+                labels={"app": f"a{i % 2}"}))
+    pending = []
+    for i in range(n_pods):
+        sel = {"region": f"r{rng.randrange(3)}"} if rng.random() < 0.3 else {}
+        pending.append(mk_pod(
+            f"new-{i}", cpu_m=rng.randrange(0, 2000, 100),
+            labels={"app": f"a{rng.randrange(2)}"}, selector=sel))
+    services = [api.Service(
+        metadata=api.ObjectMeta(name=f"s{s}", namespace="default"),
+        spec=api.ServiceSpec(port=80, selector={"app": f"a{s}"}))
+        for s in range(2)]
+    return nodes, existing, pending, services
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_service_affinity_interpret_matches_solve_jit(seed):
+    # CheckServiceAffinity (predicates.go:238-324): anchors from existing
+    # peers AND anchors set by the wave's own first commits
+    nodes, existing, pending, services = aff_wave(
+        4000 + seed, with_existing=seed % 2 == 0)
+    pol = BatchPolicy(w_lr=1, affinity_labels=("region",))
+    snap = encode_snapshot(nodes, existing, pending, services, policy=pol)
+    inp = snapshot_to_inputs(snap)
+    assert pallas_solver.eligible(
+        inp, pol, False, int(snap.group_counts.sum(axis=1).max(initial=0)))
+    c1, s1 = solve_jit(inp, pol=pol, gangs=False)
+    c2, s2 = pallas_solver.solve_pallas(inp, pol=pol, interpret=True)
+    assert np.array_equal(np.asarray(c1), np.asarray(c2))
+    assert np.array_equal(np.asarray(s1), np.asarray(s2))
+
+
+def test_service_affinity_two_labels_interpret():
+    nodes, existing, pending, services = aff_wave(4100)
+    pol = BatchPolicy(w_lr=1, affinity_labels=("region", "rack"))
+    snap = encode_snapshot(nodes, existing, pending, services, policy=pol)
+    inp = snapshot_to_inputs(snap)
+    assert pallas_solver.eligible(inp, pol, False, 8)
+    c1, s1 = solve_jit(inp, pol=pol, gangs=False)
+    c2, s2 = pallas_solver.solve_pallas(inp, pol=pol, interpret=True)
+    assert np.array_equal(np.asarray(c1), np.asarray(c2))
+    assert np.array_equal(np.asarray(s1), np.asarray(s2))
+
+
+def test_service_affinity_anchor_constrains_later_peer():
+    # two same-service pods: the first commit anchors region, the second
+    # must land in the anchor's region even if better-scored nodes exist
+    nodes = [mk_node("n-0", cpu_m=8000, labels={"region": "r0"}),
+             mk_node("n-1", cpu_m=2000, labels={"region": "r1"})]
+    services = [api.Service(
+        metadata=api.ObjectMeta(name="s0", namespace="default"),
+        spec=api.ServiceSpec(port=80, selector={"app": "a"}))]
+    pending = [
+        mk_pod("p-0", cpu_m=500, labels={"app": "a"},
+               selector={"region": "r0"}),     # pins + anchors r0
+        mk_pod("p-1", cpu_m=500, labels={"app": "a"}),  # must follow to r0
+    ]
+    pol = BatchPolicy(w_lr=1, affinity_labels=("region",))
+    snap = encode_snapshot(nodes, [], pending, services, policy=pol)
+    inp = snapshot_to_inputs(snap)
+    c2, _ = pallas_solver.solve_pallas(inp, pol=pol, interpret=True)
+    c2 = np.asarray(c2)
+    assert c2[0] == 0 and c2[1] == 0
+    c1, _ = solve_jit(inp, pol=pol, gangs=False)
+    assert np.array_equal(c2, np.asarray(c1))
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_gang_with_affinity_interpret_matches_solve_jit(seed):
+    # gang rollback must restore the anchor scratches: a failed run's
+    # first member must not leave a stale anchor behind
+    rng = random.Random(5000 + seed)
+    nodes = [mk_node(f"n-{i:03d}", cpu_m=rng.choice([2000, 4000]),
+                     labels={"region": f"r{i % 2}"})
+             for i in range(7)]
+    services = [api.Service(
+        metadata=api.ObjectMeta(name="sg", namespace="default"),
+        spec=api.ServiceSpec(port=80, selector={"app": "g"}))]
+    pending = []
+    for g in range(4):
+        size = rng.choice([2, 3])
+        cpu = rng.choice([700, 1500, 3800])
+        for m in range(size):
+            pending.append(mk_gang_pod(f"g{g}-m{m}", f"grp-{g}", size,
+                                       cpu_m=cpu, app="g"))
+        pending.append(mk_pod(f"solo-{g}", cpu_m=rng.randrange(0, 1500, 100),
+                              labels={"app": "g"}))
+    pol = BatchPolicy(w_lr=1, affinity_labels=("region",))
+    snap = encode_snapshot(nodes, [], pending, services, policy=pol)
+    assert snap.has_gangs
+    inp = snapshot_to_inputs(snap)
+    assert pallas_solver.eligible(
+        inp, pol, True, int(snap.group_counts.sum(axis=1).max(initial=0)))
+    c1, s1 = solve_jit(inp, pol=pol, gangs=True)
+    c2, s2 = pallas_solver.solve_pallas(inp, pol=pol, interpret=True,
+                                        gangs=True)
+    assert np.array_equal(np.asarray(c1), np.asarray(c2))
+    assert np.array_equal(np.asarray(s1), np.asarray(s2))
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_kitchen_sink_policy_interpret_matches_solve_jit(seed):
+    # every kernel-extension at once: affinity anchors + zone
+    # anti-affinity + label preferences + spreading
+    nodes, existing, pending, services = aff_wave(6000 + seed, n_nodes=11)
+    for i, n in enumerate(nodes):
+        n.metadata.labels["zone"] = f"z{i % 3}"
+        if i % 4 == 0:
+            n.metadata.labels["disk"] = "ssd"
+    pol = BatchPolicy(w_lr=1, w_spread=1,
+                      affinity_labels=("region",),
+                      anti_affinity=(("zone", 2),),
+                      label_prefs=(("disk", True, 1),))
+    snap = encode_snapshot(nodes, existing, pending, services, policy=pol)
+    inp = snapshot_to_inputs(snap)
+    assert pallas_solver.eligible(
+        inp, pol, False, int(snap.group_counts.sum(axis=1).max(initial=0)))
+    c1, s1 = solve_jit(inp, pol=pol, gangs=False)
+    c2, s2 = pallas_solver.solve_pallas(inp, pol=pol, interpret=True)
+    assert np.array_equal(np.asarray(c1), np.asarray(c2))
+    assert np.array_equal(np.asarray(s1), np.asarray(s2))
+
+
 def test_eligibility_gates():
     nodes, existing, pending, services = fuzz_wave(1)
     snap = encode_snapshot(nodes, existing, pending, services)
@@ -253,8 +407,8 @@ def test_eligibility_gates():
     pol = snap.policy or BatchPolicy()
     assert pallas_solver.eligible(inp, pol, False, 10)
     assert pallas_solver.eligible(inp, pol, True, 10)   # gangs in-domain
-    # affinity-bearing policies, i64 waves, count overflow: all fall
-    # back to the XLA scan
+    # a policy whose planes the snapshot was NOT encoded with, i64 waves,
+    # count overflow, too many affinity labels: all fall back to the scan
     aff = BatchPolicy(anti_affinity=(("zone", 1),))
     assert not pallas_solver.eligible(inp, aff, False, 10)
     labeled = BatchPolicy(affinity_labels=("region",))
@@ -262,6 +416,12 @@ def test_eligibility_gates():
     assert not pallas_solver.eligible(inp, pol, False, 1 << 15)
     i64 = inp._replace(cap=inp.cap.astype(jnp.int64))
     assert not pallas_solver.eligible(i64, pol, False, 10)
+    # >4 affinity labels exceed the podrow lane budget
+    wide = BatchPolicy(affinity_labels=("a", "b", "c", "d", "e"))
+    nodes2, ex2, pend2, svc2 = fuzz_wave(3)
+    snap2 = encode_snapshot(nodes2, ex2, pend2, svc2, policy=wide)
+    inp2 = snapshot_to_inputs(snap2)
+    assert not pallas_solver.eligible(inp2, wide, False, 10)
 
 
 def test_solve_device_honors_mode_env(monkeypatch):
@@ -288,6 +448,39 @@ def test_block_batched_kernel_matches(monkeypatch):
     c1, s1 = solve_jit(inp, pol=snap.policy, gangs=False)
     c2, s2 = pallas_solver.solve_pallas(inp, pol=snap.policy,
                                         interpret=True)
+    assert np.array_equal(np.asarray(c1), np.asarray(c2))
+    assert np.array_equal(np.asarray(s1), np.asarray(s2))
+
+
+def test_block_batched_affinity_gang_matches(monkeypatch):
+    # B>1 unrolls several pods per grid step; the anchor scratches are the
+    # only cross-pod mutable state added by the affinity extension, so the
+    # intra-block read-after-write ordering must be pinned at B>1 too —
+    # with gangs, whose checkpoints copy the anchor planes mid-block
+    monkeypatch.setenv("KTPU_PALLAS_BLOCK", "4")
+    rng = random.Random(7000)
+    nodes = [mk_node(f"n-{i:03d}", cpu_m=rng.choice([2000, 4000]),
+                     labels={"region": f"r{i % 2}"})
+             for i in range(7)]
+    services = [api.Service(
+        metadata=api.ObjectMeta(name="sg", namespace="default"),
+        spec=api.ServiceSpec(port=80, selector={"app": "g"}))]
+    pending = []
+    for g in range(4):
+        size = rng.choice([2, 3])
+        cpu = rng.choice([700, 1500, 3800])
+        for m in range(size):
+            pending.append(mk_gang_pod(f"g{g}-m{m}", f"grp-{g}", size,
+                                       cpu_m=cpu, app="g"))
+        pending.append(mk_pod(f"solo-{g}", cpu_m=rng.randrange(0, 1500, 100),
+                              labels={"app": "g"}))
+    pol = BatchPolicy(w_lr=1, affinity_labels=("region",),
+                      label_prefs=(("region", True, 1),))
+    snap = encode_snapshot(nodes, [], pending, services, policy=pol)
+    inp = snapshot_to_inputs(snap)
+    c1, s1 = solve_jit(inp, pol=pol, gangs=True)
+    c2, s2 = pallas_solver.solve_pallas(inp, pol=pol, interpret=True,
+                                        gangs=True)
     assert np.array_equal(np.asarray(c1), np.asarray(c2))
     assert np.array_equal(np.asarray(s1), np.asarray(s2))
 
