@@ -485,10 +485,12 @@ def solve_device(inp: SolverInputs, pol: Optional[BatchPolicy],
     return solve_jit(inp, pol=pol, gangs=gangs)
 
 
-def peer_bound_of(snap: ClusterSnapshot) -> int:
-    """Largest initial per-group peer total (numpy, host-side) — the
-    pallas-eligibility bound on spread/anti-affinity arithmetic."""
-    gc = snap.group_counts
+def peer_bound_of(source) -> int:
+    """Largest initial per-group peer total — the pallas-eligibility bound
+    on spread/anti-affinity arithmetic. ``source`` is anything carrying a
+    ``group_counts`` [G, N+1] array: a ClusterSnapshot (numpy, host-side)
+    or a SolverInputs (device array; int() forces one readback)."""
+    gc = source.group_counts
     return int(gc.sum(axis=1).max()) if gc.size else 0
 
 

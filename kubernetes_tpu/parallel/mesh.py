@@ -154,10 +154,53 @@ def shard_memory_report(inp: SolverInputs, mesh: Mesh) -> dict:
 
 def solve_sharded(inp: SolverInputs, mesh: Optional[Mesh] = None,
                   w_lr: int = 1, w_spread: int = 1, w_equal: int = 0,
-                  pol=None, gangs: bool = False) -> Tuple[np.ndarray, np.ndarray]:
-    """Run solve_jit under a device mesh. Decisions are identical to the
-    single-device path; only the layout changes. Gang callers apply
-    gang.apply_all_or_nothing to the returned decisions, as with solve."""
+                  pol=None, gangs: bool = False,
+                  peer_bound: Optional[int] = None,
+                  prefer_kernel: bool = True) -> Tuple[np.ndarray, np.ndarray]:
+    """Solve one wave under a device mesh. Decisions are identical to the
+    single-device path; only the layout (and dispatch) changes. Gang
+    callers apply gang.apply_all_or_nothing to the returned decisions, as
+    with solve.
+
+    Dispatch is a measured crossover, not a blind shard:
+
+    - **Kernel-eligible waves bypass the mesh and run on ONE device**
+      through models/batch_solver.solve_device — the Pallas
+      sequential-commit kernel on real TPUs (or KTPU_PALLAS=interpret),
+      the plain single-device scan on other backends. Either way that
+      beats sharding: the state for a whole 32k-node cluster fits a
+      single core's VMEM (ops/pallas_solver eligible()), while sharding
+      the node axis puts a cross-shard argmax + tie-break collective
+      inside EVERY pod step — per-step latency that dwarfs the step's
+      arithmetic. Measured on an 8-device host mesh (4097 nodes x 512
+      pods, solve only, inputs pre-placed; shared-memory collectives —
+      far cheaper than real ICI): the sharded scan runs ~7.5x SLOWER
+      than the same scan on one device (1.49s vs 0.20s median); on real
+      TPU hardware the kernel then beats the single-device scan by a
+      further ~4.5x (models/batch_solver.py solve_device). Sharding at
+      these sizes buys capacity, not speed.
+    - **Waves beyond the kernel's domain take the GSPMD scan over the
+      mesh** — node planes sharded, per-step reductions riding
+      XLA-inserted collectives. This is the capacity path: it is how a
+      wave whose planes exceed one chip's HBM/VMEM runs at all.
+
+    ``peer_bound`` (see batch_solver.peer_bound_of) gates kernel
+    eligibility; None computes it from the inputs (one host readback)."""
+    from kubernetes_tpu.models.batch_solver import peer_bound_of, solve_device
+    from kubernetes_tpu.models.policy import BatchPolicy
+    from kubernetes_tpu.ops import pallas_solver
+
+    p = pol or BatchPolicy(w_lr=w_lr, w_spread=w_spread, w_equal=w_equal)
+    if prefer_kernel:
+        if peer_bound is None:
+            peer_bound = peer_bound_of(inp)
+        if pallas_solver.eligible(inp, p, gangs, peer_bound):
+            # solve_device re-checks eligibility plus the mode/backend
+            # gate and is the authority on kernel-vs-scan; this branch
+            # only decides one-device-vs-mesh
+            chosen, scores = solve_device(inp, p, gangs, peer_bound)
+            return np.asarray(chosen), np.asarray(scores)
+
     mesh = mesh or make_mesh()
     padded, n = pad_inputs_for_mesh(inp, mesh)
     shardings = _input_shardings(mesh)

@@ -52,7 +52,8 @@ def test_sharded_solve_bit_identical():
 
     single, _ = solve(snap)
     mesh = make_mesh(pods_axis=1)  # 1x8: all devices shard the node axis
-    sharded, _ = solve_sharded(snapshot_to_inputs(snap), mesh)
+    sharded, _ = solve_sharded(snapshot_to_inputs(snap), mesh,
+                               prefer_kernel=False)
     assert np.array_equal(single, sharded)
     assert decisions_to_names(snap, sharded) == serial
 
@@ -62,7 +63,8 @@ def test_sharded_2d_mesh():
     serial = solve_serial(nodes, existing, pending, services)
     snap = encode_snapshot(nodes, existing, pending, services)
     mesh = make_mesh(pods_axis=2)  # 2x4 mesh: dp over pods in the pre-pass
-    sharded, _ = solve_sharded(snapshot_to_inputs(snap), mesh)
+    sharded, _ = solve_sharded(snapshot_to_inputs(snap), mesh,
+                               prefer_kernel=False)
     assert decisions_to_names(snap, sharded) == serial
 
 
@@ -72,9 +74,35 @@ def test_padding_nodes_never_win():
     mesh = make_mesh(pods_axis=1)
     inp, n = pad_inputs_for_mesh(snapshot_to_inputs(snap), mesh)
     assert inp.cap.shape[0] == 8 and n == 3
-    chosen, _ = solve_sharded(snapshot_to_inputs(snap), mesh)
+    chosen, _ = solve_sharded(snapshot_to_inputs(snap), mesh,
+                              prefer_kernel=False)
     assert chosen.max() < 3  # padding indices unreachable
     assert decisions_to_names(snap, chosen) == solve_serial(
+        nodes, existing, pending, services)
+
+
+def test_crossover_dispatch_runs_kernel_for_eligible_waves(monkeypatch):
+    """solve_sharded's default dispatch: a kernel-eligible wave skips the
+    sharded scan entirely and runs the Pallas sequential-commit kernel on
+    one device (sharding buys capacity, not speed — see the measured
+    numbers in solve_sharded's docstring). KTPU_PALLAS=interpret routes
+    the kernel through the interpreter so the dispatch is testable on
+    the CPU mesh."""
+    from kubernetes_tpu.models.batch_solver import peer_bound_of
+    from kubernetes_tpu.models.policy import BatchPolicy
+    from kubernetes_tpu.ops import pallas_solver
+
+    monkeypatch.setenv("KTPU_PALLAS", "interpret")
+    nodes, existing, pending, services = _cluster()
+    snap = encode_snapshot(nodes, existing, pending, services)
+    inp = snapshot_to_inputs(snap)
+    assert pallas_solver.eligible(inp, snap.policy or BatchPolicy(), False,
+                                  peer_bound_of(snap))
+    mesh = make_mesh(pods_axis=1)
+    via_dispatch, _ = solve_sharded(inp, mesh)            # kernel route
+    via_gspmd, _ = solve_sharded(inp, mesh, prefer_kernel=False)
+    assert np.array_equal(via_dispatch, via_gspmd)
+    assert decisions_to_names(snap, via_dispatch) == solve_serial(
         nodes, existing, pending, services)
 
 
@@ -91,7 +119,7 @@ def test_sharded_at_partitioning_scale():
     snap = encode_snapshot(nodes, existing, pending, services)
     inp = snapshot_to_inputs(snap)
     mesh = make_mesh(pods_axis=1)
-    chosen_sh, _ = solve_sharded(inp, mesh)
+    chosen_sh, _ = solve_sharded(inp, mesh, prefer_kernel=False)
     chosen_un, _ = solve_jit(inp)
     assert np.array_equal(np.asarray(chosen_sh), np.asarray(chosen_un))
     batch = decisions_to_names(snap, np.asarray(chosen_sh))
