@@ -5,7 +5,7 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-VERSIONS="${TEST_API_VERSIONS:-v1}"
+VERSIONS="${TEST_API_VERSIONS:-v1,v1beta1,v1beta2}"
 rc=0
 for v in ${VERSIONS//,/ }; do
     echo "=== test run with KUBE_TEST_API_VERSION=${v} ==="

@@ -27,6 +27,24 @@ asserts internal -> v1beta1 wire -> internal identity over randomized
 objects of every kind), decode applies the era's defaulting pass, and
 field labels convert per version (``DesiredState.Host`` <->
 ``spec.host``, ref: pkg/api/v1beta1/conversion.go field-label funcs).
+
+v1beta1 additionally carries the era's *deprecated wire aliases*, which
+are exactly what distinguishes it from its v1beta2 sibling in the
+reference (v1beta2 is the same envelope shape minus the aliases):
+
+- ``EnvVar.key`` — deprecated duplicate of ``name``; encode writes both,
+  decode prefers ``name`` and falls back to ``key``
+  (ref: pkg/api/v1beta1/conversion.go:114-129, absent from v1beta2);
+- ``VolumeMount.path``/``mountType`` — deprecated aliases of
+  ``mountPath``; decode falls back to ``path``
+  (ref: pkg/api/v1beta1/conversion.go:131-149);
+- ``MinionList.minions`` — duplicate of ``items`` on the wire; decode
+  prefers ``items`` (ref: pkg/api/v1beta1/conversion.go:151-196
+  "MinionList.Items had a wrong name in v1beta1").
+
+The transform registry is built by :func:`make_kind_transforms` so the
+v1beta2 module can instantiate the shared envelope with
+``legacy_aliases=False`` and its own manifest version stamp.
 """
 
 from __future__ import annotations
@@ -34,7 +52,8 @@ from __future__ import annotations
 from typing import Callable, Dict, Tuple
 
 __all__ = ["KIND_TRANSFORMS", "KIND_ALIASES", "DEFAULTERS",
-           "FIELD_LABELS", "encode_for", "decode_for"]
+           "FIELD_LABELS", "encode_for", "decode_for",
+           "make_kind_transforms"]
 
 
 # -- metadata flattening (name is spelled "id") ------------------------------
@@ -87,14 +106,78 @@ _POLICY_OUT = {"Always": "always", "OnFailure": "onFailure", "Never": "never"}
 _POLICY_IN = {v: k for k, v in _POLICY_OUT.items()}
 
 
-def _podspec_out(spec: dict) -> dict:
+def _containers_alias_out(containers: list) -> list:
+    """Write the v1beta1-only deprecated duplicates: EnvVar.key mirrors
+    name, VolumeMount.path mirrors mountPath (ref: v1beta1/conversion.go
+    EnvVar/VolumeMount funcs; v1beta2 dropped both fields)."""
+    out = []
+    for c in containers:
+        if not isinstance(c, dict):
+            out.append(c)
+            continue
+        c = dict(c)
+        env = c.get("env")
+        if isinstance(env, list):
+            c["env"] = [dict(e, key=e["name"])
+                        if isinstance(e, dict) and e.get("name") else e
+                        for e in env]
+        vms = c.get("volumeMounts")
+        if isinstance(vms, list):
+            c["volumeMounts"] = [dict(v, path=v["mountPath"])
+                                 if isinstance(v, dict) and v.get("mountPath")
+                                 else v for v in vms]
+        out.append(c)
+    return out
+
+
+def _containers_alias_in(containers: list) -> list:
+    """Accept the deprecated aliases: key -> name, path -> mountPath;
+    mountType is ignored (ref: v1beta1/conversion.go "MountType is
+    ignored")."""
+    out = []
+    for c in containers:
+        if not isinstance(c, dict):
+            out.append(c)
+            continue
+        c = dict(c)
+        env = c.get("env")
+        if isinstance(env, list):
+            fixed = []
+            for e in env:
+                if isinstance(e, dict):
+                    e = dict(e)
+                    key = e.pop("key", None)
+                    if not e.get("name") and key:
+                        e["name"] = key
+                fixed.append(e)
+            c["env"] = fixed
+        vms = c.get("volumeMounts")
+        if isinstance(vms, list):
+            fixed = []
+            for v in vms:
+                if isinstance(v, dict):
+                    v = dict(v)
+                    path = v.pop("path", None)
+                    v.pop("mountType", None)
+                    if not v.get("mountPath") and path:
+                        v["mountPath"] = path
+                fixed.append(v)
+            c["volumeMounts"] = fixed
+        out.append(c)
+    return out
+
+
+def _podspec_out(spec: dict, version: str = "v1beta1",
+                 legacy: bool = True) -> dict:
     spec = dict(spec)
-    manifest: dict = {"version": "v1beta1"}
+    manifest: dict = {"version": version}
     for k, mk in (("containers", "containers"), ("volumes", "volumes"),
                   ("dnsPolicy", "dnsPolicy"), ("hostNetwork", "hostNetwork"),
                   ("terminationGracePeriodSeconds",
                    "terminationGracePeriodSeconds")):
         _move(spec, k, manifest, mk)
+    if legacy and isinstance(manifest.get("containers"), list):
+        manifest["containers"] = _containers_alias_out(manifest["containers"])
     rp = spec.pop("restartPolicy", None)
     if rp is not None:
         manifest["restartPolicy"] = {_POLICY_OUT.get(rp, "always"): {}}
@@ -105,12 +188,14 @@ def _podspec_out(spec: dict) -> dict:
     return out
 
 
-def _podspec_in(ds: dict) -> dict:
+def _podspec_in(ds: dict, legacy: bool = True) -> dict:
     ds = dict(ds)
     spec: dict = {}
     manifest = dict(ds.pop("manifest", {}) or {})
     manifest.pop("version", None)
     manifest.pop("id", None)
+    if legacy and isinstance(manifest.get("containers"), list):
+        manifest["containers"] = _containers_alias_in(manifest["containers"])
     rp = manifest.pop("restartPolicy", None)
     if isinstance(rp, dict) and rp:
         spec["restartPolicy"] = _POLICY_IN.get(next(iter(rp)), "Always")
@@ -139,19 +224,20 @@ def _podstatus_in(cs: dict) -> dict:
     return status
 
 
-def _pod_out(wire: dict) -> dict:
+def _pod_out(wire: dict, version: str = "v1beta1",
+             legacy: bool = True) -> dict:
     wire = _meta_out(wire)
     if "spec" in wire:
-        wire["desiredState"] = _podspec_out(wire.pop("spec"))
+        wire["desiredState"] = _podspec_out(wire.pop("spec"), version, legacy)
     if "status" in wire:
         wire["currentState"] = _podstatus_out(wire.pop("status"))
     return wire
 
 
-def _pod_in(wire: dict) -> dict:
+def _pod_in(wire: dict, legacy: bool = True) -> dict:
     wire = _meta_in(wire)
     if "desiredState" in wire:
-        wire["spec"] = _podspec_in(wire.pop("desiredState"))
+        wire["spec"] = _podspec_in(wire.pop("desiredState"), legacy)
     if "currentState" in wire:
         wire["status"] = _podstatus_in(wire.pop("currentState"))
     return wire
@@ -159,28 +245,31 @@ def _pod_in(wire: dict) -> dict:
 
 # -- replication controller --------------------------------------------------
 
-def _template_out(t: dict) -> dict:
+def _template_out(t: dict, version: str = "v1beta1",
+                  legacy: bool = True) -> dict:
     t = _meta_out(t)  # template metadata flattens like any object's
     if "spec" in t:
-        t["desiredState"] = _podspec_out(t.pop("spec"))
+        t["desiredState"] = _podspec_out(t.pop("spec"), version, legacy)
     return t
 
 
-def _template_in(t: dict) -> dict:
+def _template_in(t: dict, legacy: bool = True) -> dict:
     t = _meta_in(t)
     if "desiredState" in t:
-        t["spec"] = _podspec_in(t.pop("desiredState"))
+        t["spec"] = _podspec_in(t.pop("desiredState"), legacy)
     return t
 
 
-def _rc_out(wire: dict) -> dict:
+def _rc_out(wire: dict, version: str = "v1beta1",
+            legacy: bool = True) -> dict:
     wire = _meta_out(wire)
     spec = dict(wire.pop("spec", {}) or {})
     ds: dict = {}
     _move(spec, "replicas", ds, "replicas")
     _move(spec, "selector", ds, "replicaSelector")
     if "template" in spec:
-        ds["podTemplate"] = _template_out(spec.pop("template"))
+        ds["podTemplate"] = _template_out(spec.pop("template"), version,
+                                          legacy)
     ds.update(spec)
     wire["desiredState"] = ds
     if "status" in wire:
@@ -188,14 +277,14 @@ def _rc_out(wire: dict) -> dict:
     return wire
 
 
-def _rc_in(wire: dict) -> dict:
+def _rc_in(wire: dict, legacy: bool = True) -> dict:
     wire = _meta_in(wire)
     ds = dict(wire.pop("desiredState", {}) or {})
     spec: dict = {}
     _move(ds, "replicas", spec, "replicas")
     _move(ds, "replicaSelector", spec, "selector")
     if "podTemplate" in ds:
-        spec["template"] = _template_in(ds.pop("podTemplate"))
+        spec["template"] = _template_in(ds.pop("podTemplate"), legacy)
     spec.update(ds)
     wire["spec"] = spec
     if "currentState" in wire:
@@ -373,60 +462,100 @@ def _limitrange_in(wire: dict) -> dict:
 
 WireFn = Callable[[dict], dict]
 
+
+def make_kind_transforms(manifest_version: str = "v1beta1",
+                         legacy_aliases: bool = True,
+                         ) -> Dict[str, Tuple[WireFn, WireFn]]:
+    """Build the kind -> (encode, decode) registry for one legacy wire
+    version. v1beta1 = ("v1beta1", True); the v1beta2 sibling shares the
+    whole envelope shape but stamps its own manifest version and drops
+    the deprecated aliases (ref: pkg/api/v1beta2/ is v1beta1 minus
+    EnvVar.Key / VolumeMount.Path / MinionList.Minions)."""
+    v, leg = manifest_version, legacy_aliases
+    reg: Dict[str, Tuple[WireFn, WireFn]] = {
+        "Pod": (lambda w: _pod_out(w, v, leg),
+                lambda w: _pod_in(w, leg)),
+        "ReplicationController": (lambda w: _rc_out(w, v, leg),
+                                  lambda w: _rc_in(w, leg)),
+        "Service": (_service_out, _service_in),
+        "Node": (_node_out, _node_in),
+        "Endpoints": (_endpoints_out, _endpoints_in),
+        "Binding": (_binding_out, _binding_in),
+        "Namespace": (_namespace_out, _namespace_in),
+        "ResourceQuota": (_quota_out, _quota_in),
+        "LimitRange": (_limitrange_out, _limitrange_in),
+        # flat-metadata-only kinds
+        "Event": (_meta_out, _meta_in),
+        "Secret": (_meta_out, _meta_in),
+        "Status": (lambda w: w, lambda w: w),
+        "DeleteOptions": (lambda w: w, lambda w: w),
+    }
+    if legacy_aliases:
+        # MinionList carries a duplicate "minions" field on the wire;
+        # decode prefers "items" and falls back to "minions"
+        # (ref: v1beta1/conversion.go "MinionList.Items had a wrong name")
+        node_out, node_in = reg["Node"]
+
+        def _nodelist_out(wire: dict) -> dict:
+            wire = _list_out(node_out, wire)
+            if isinstance(wire.get("items"), list):
+                wire["minions"] = wire["items"]
+            return wire
+
+        def _nodelist_in(wire: dict) -> dict:
+            wire = dict(wire)
+            minions = wire.pop("minions", None)
+            if "items" not in wire and isinstance(minions, list):
+                wire["items"] = minions
+            return _list_in(node_in, wire)
+
+        reg["NodeList"] = (_nodelist_out, _nodelist_in)
+    return reg
+
+
+def _list_out(item: WireFn, wire: dict) -> dict:
+    wire = _meta_out(wire)
+    items = wire.get("items")
+    if isinstance(items, list):
+        wire["items"] = [item(i) if isinstance(i, dict) else i
+                         for i in items]
+    return wire
+
+
+def _list_in(item: WireFn, wire: dict) -> dict:
+    wire = _meta_in(wire)
+    items = wire.get("items")
+    if isinstance(items, list):
+        wire["items"] = [item(i) if isinstance(i, dict) else i
+                         for i in items]
+    return wire
+
+
 # kind -> (encode internal-wire -> v1beta1-wire, decode back)
-KIND_TRANSFORMS: Dict[str, Tuple[WireFn, WireFn]] = {
-    "Pod": (_pod_out, _pod_in),
-    "ReplicationController": (_rc_out, _rc_in),
-    "Service": (_service_out, _service_in),
-    "Node": (_node_out, _node_in),
-    "Endpoints": (_endpoints_out, _endpoints_in),
-    "Binding": (_binding_out, _binding_in),
-    "Namespace": (_namespace_out, _namespace_in),
-    "ResourceQuota": (_quota_out, _quota_in),
-    "LimitRange": (_limitrange_out, _limitrange_in),
-    # flat-metadata-only kinds
-    "Event": (_meta_out, _meta_in),
-    "Secret": (_meta_out, _meta_in),
-    "Status": (lambda w: w, lambda w: w),
-    "DeleteOptions": (lambda w: w, lambda w: w),
-}
+KIND_TRANSFORMS: Dict[str, Tuple[WireFn, WireFn]] = make_kind_transforms()
 
 # v1beta1 wire kind -> internal kind (ref: Node was "Minion" on the wire)
 KIND_ALIASES: Dict[str, str] = {"Minion": "Node", "MinionList": "NodeList"}
 
 
-def encode_for(kind: str) -> WireFn:
+def encode_for(kind: str, registry: Dict[str, Tuple[WireFn, WireFn]]
+               = KIND_TRANSFORMS) -> WireFn:
     """Encoder for a kind, deriving List transforms from the item kind."""
-    if kind in KIND_TRANSFORMS:
-        return KIND_TRANSFORMS[kind][0]
-    if kind.endswith("List") and kind[:-4] in KIND_TRANSFORMS:
-        item = KIND_TRANSFORMS[kind[:-4]][0]
-
-        def enc(wire: dict) -> dict:
-            wire = _meta_out(wire)
-            items = wire.get("items")
-            if isinstance(items, list):
-                wire["items"] = [item(i) if isinstance(i, dict) else i
-                                 for i in items]
-            return wire
-        return enc
+    if kind in registry:
+        return registry[kind][0]
+    if kind.endswith("List") and kind[:-4] in registry:
+        item = registry[kind[:-4]][0]
+        return lambda wire: _list_out(item, wire)
     return _meta_out
 
 
-def decode_for(kind: str) -> WireFn:
-    if kind in KIND_TRANSFORMS:
-        return KIND_TRANSFORMS[kind][1]
-    if kind.endswith("List") and kind[:-4] in KIND_TRANSFORMS:
-        item = KIND_TRANSFORMS[kind[:-4]][1]
-
-        def dec(wire: dict) -> dict:
-            wire = _meta_in(wire)
-            items = wire.get("items")
-            if isinstance(items, list):
-                wire["items"] = [item(i) if isinstance(i, dict) else i
-                                 for i in items]
-            return wire
-        return dec
+def decode_for(kind: str, registry: Dict[str, Tuple[WireFn, WireFn]]
+               = KIND_TRANSFORMS) -> WireFn:
+    if kind in registry:
+        return registry[kind][1]
+    if kind.endswith("List") and kind[:-4] in registry:
+        item = registry[kind[:-4]][1]
+        return lambda wire: _list_in(item, wire)
     return _meta_in
 
 
@@ -441,6 +570,12 @@ def _default_pod(pod) -> None:
         for p in c.ports:
             if not p.protocol:
                 p.protocol = "TCP"
+            # with host networking, unset host ports default to the
+            # container port (ref: v1beta1/defaults.go:112-121
+            # defaultHostNetworkPorts; v1beta2/defaults.go:114-123 is
+            # code-identical — only its comment claims the reverse)
+            if pod.spec.host_network and not p.host_port:
+                p.host_port = p.container_port
 
 
 def _default_service(svc) -> None:

@@ -105,6 +105,10 @@ def test_roundtrip_fuzz(cls, version):
         # string seeding is PYTHONHASHSEED-independent: failures reproduce
         rng = random.Random(f"{cls.__name__}-{version}-{seed}")
         obj = _fuzz_dataclass(cls, rng)
+        # normalize through one v1 decode so version defaulters (which
+        # mutate, e.g. hostNetwork port defaulting) are already applied —
+        # the reference fuzzes with defaulted objects for the same reason
+        obj = scheme.decode(scheme.encode(obj, "v1"))
         wire = scheme.encode(obj, version)
         back = scheme.decode(wire)
         assert _canonical(back) == _canonical(obj), (
@@ -120,10 +124,13 @@ def test_cross_version_conversion(cls):
     for seed in range(4):
         rng = random.Random(500 + seed)
         obj = _fuzz_dataclass(cls, rng)
+        obj = scheme.decode(scheme.encode(obj, "v1"))  # apply defaulters
         wire_v1 = scheme.encode_to_wire(obj, "v1")
-        beta = scheme.convert_wire(wire_v1, "v1", "v1beta1")
-        back = scheme.decode_from_wire(beta)
-        assert _canonical(back) == _canonical(obj)
+        for target in ("v1beta1", "v1beta2"):
+            beta = scheme.convert_wire(wire_v1, "v1", target)
+            back = scheme.decode_from_wire(beta)
+            assert _canonical(back) == _canonical(obj), (
+                f"{cls.__name__} seed {seed} lost data via {target}")
 
 
 def test_v1beta1_wire_shape_is_genuinely_divergent():
@@ -157,6 +164,70 @@ def test_v1beta1_wire_shape_is_genuinely_divergent():
                         endpoints=[api.Endpoint(ip="10.0.0.1", port=80)])
     w = scheme.encode_to_wire(eps, "v1beta1")
     assert w["endpoints"] == ["10.0.0.1:80"]
+
+
+def test_v1beta2_drops_the_deprecated_aliases():
+    """The delta separating the two betas in the reference: v1beta1
+    carries deprecated duplicate fields (EnvVar.key, VolumeMount.path,
+    MinionList.minions) that v1beta2 removed (ref:
+    pkg/api/v1beta1/conversion.go:114-196 vs pkg/api/v1beta2/types.go)."""
+    pod = api.Pod(
+        metadata=api.ObjectMeta(name="web"),
+        spec=api.PodSpec(containers=[api.Container(
+            name="c", image="nginx",
+            env=[api.EnvVar(name="MODE", value="fast")],
+            volume_mounts=[api.VolumeMount(name="v", mount_path="/data")])]))
+    w1 = scheme.encode_to_wire(pod, "v1beta1")
+    c1 = w1["desiredState"]["manifest"]["containers"][0]
+    assert c1["env"][0]["key"] == "MODE"            # duplicate written
+    assert c1["volumeMounts"][0]["path"] == "/data"
+    assert w1["desiredState"]["manifest"]["version"] == "v1beta1"
+
+    w2 = scheme.encode_to_wire(pod, "v1beta2")
+    c2 = w2["desiredState"]["manifest"]["containers"][0]
+    assert "key" not in c2["env"][0]                # v1beta2 dropped it
+    assert "path" not in c2["volumeMounts"][0]
+    assert w2["desiredState"]["manifest"]["version"] == "v1beta2"
+
+    # v1beta1 decode accepts alias-only wire (key/path without name/
+    # mountPath, mountType ignored)
+    back = scheme.decode_from_wire({
+        "kind": "Pod", "apiVersion": "v1beta1", "id": "p",
+        "desiredState": {"manifest": {"containers": [{
+            "name": "c", "image": "i",
+            "env": [{"key": "LEGACY", "value": "1"}],
+            "volumeMounts": [{"name": "v", "path": "/old",
+                              "mountType": "bind"}]}]}}})
+    assert back.spec.containers[0].env[0].name == "LEGACY"
+    assert back.spec.containers[0].volume_mounts[0].mount_path == "/old"
+
+    nodes = api.NodeList(items=[api.Node(metadata=api.ObjectMeta(name="n1"))])
+    wl1 = scheme.encode_to_wire(nodes, "v1beta1")
+    assert wl1["kind"] == "MinionList" and wl1["minions"] == wl1["items"]
+    wl2 = scheme.encode_to_wire(nodes, "v1beta2")
+    assert wl2["kind"] == "MinionList" and "minions" not in wl2
+    # decode prefers items but accepts a minions-only list
+    back = scheme.decode_from_wire(
+        {"kind": "MinionList", "apiVersion": "v1beta1",
+         "minions": [{"id": "n9"}]})
+    assert back.items[0].metadata.name == "n9"
+
+
+def test_hostnetwork_port_defaulting():
+    """With host networking, unset host ports default to the container
+    port on decode (ref: v1beta1/defaults.go defaultHostNetworkPorts,
+    code-identical in v1beta2)."""
+    for v in VERSIONS:
+        pod = api.Pod(metadata=api.ObjectMeta(name="p"), spec=api.PodSpec(
+            host_network=True,
+            containers=[api.Container(name="c", image="i", ports=[
+                api.ContainerPort(container_port=8080)])]))
+        back = scheme.decode(scheme.encode(pod, v))
+        assert back.spec.containers[0].ports[0].host_port == 8080, v
+        # without host networking the port is left alone
+        pod.spec.host_network = False
+        back = scheme.decode(scheme.encode(pod, v))
+        assert back.spec.containers[0].ports[0].host_port == 0, v
 
 
 def test_v1beta1_defaulting_pass():
