@@ -375,6 +375,33 @@ def probe_child(deadline_s: float) -> int:
 # Child: the actual benchmarks.
 # --------------------------------------------------------------------------
 
+def affinity_policy():
+    """The anti-affinity benchmark policy: the full default predicate set
+    + zone spreading. Single definition shared by the bench matrix and
+    hack/fullgate.py so the out-of-band full-scale gate always certifies
+    exactly the config the benchmark runs."""
+    from kubernetes_tpu.scheduler.plugins import (Policy, PolicyPredicate,
+                                                  PolicyPriority)
+    return Policy(
+        predicates=[PolicyPredicate(name=n) for n in
+                    ("PodFitsPorts", "PodFitsResources", "NoDiskConflict",
+                     "MatchNodeSelector", "HostName")],
+        priorities=[PolicyPriority(name="LeastRequestedPriority", weight=1),
+                    PolicyPriority(name="zoneSpread", weight=2,
+                                   service_anti_affinity_label="zone")])
+
+
+# full-scale shapes per solver config: (nodes, pods, build_cluster kwargs);
+# the policy for "affinity" is affinity_policy(). Shared with fullgate.
+FULL_SHAPES = {
+    "north_star": (5_000, 10_000, {}),
+    "basic": (500, 1_000, {}),
+    "affinity": (5_000, 5_000, {}),
+    "binpack3": (5_000, 10_000, {"three_resources": True}),
+    "gang": (2_000, 0, {"gang_groups": 1_000, "gang_size": 8}),
+}
+
+
 def build_cluster(n_nodes: int, n_pods: int, n_services: int = 8,
                   existing_per_node: int = 2, three_resources: bool = False,
                   gang_groups: int = 0, gang_size: int = 8):
@@ -817,12 +844,6 @@ def child(argv) -> int:
     backend, devices = res
     log(f"backend={backend} devices={devices}")
 
-    from kubernetes_tpu.scheduler.plugins import (
-        Policy,
-        PolicyPredicate,
-        PolicyPriority,
-    )
-
     s = args.smoke
     known = {"north_star", "basic", "affinity", "binpack3", "gang", "churn"}
     want = set(args.configs.split(",")) if args.configs != "all" else known
@@ -838,14 +859,8 @@ def child(argv) -> int:
     configs = {}
     failed = []
 
-    # anti-affinity policy: the full default predicate set + zone spreading
-    aff_policy = Policy(
-        predicates=[PolicyPredicate(name=n) for n in
-                    ("PodFitsPorts", "PodFitsResources", "NoDiskConflict",
-                     "MatchNodeSelector", "HostName")],
-        priorities=[PolicyPriority(name="LeastRequestedPriority", weight=1),
-                    PolicyPriority(name="zoneSpread", weight=2,
-                                   service_anti_affinity_label="zone")])
+    # anti-affinity policy: shared definition (see affinity_policy)
+    aff_policy = affinity_policy()
 
     def build_record():
         """One shape for every emission: success, cumulative partial

@@ -37,27 +37,53 @@ ENV = dict(os.environ, PYTHONPATH=_REPO + (
     if os.environ.get("PYTHONPATH") else ""))
 
 
-def feed(prefix: str, count: int, rate: float, master: str) -> int:
-    """Paced feeder (one process). Prints one JSON line when done."""
-    from kubernetes_tpu.api import types as api
-    from kubernetes_tpu.api.quantity import Quantity
-    from kubernetes_tpu.client.client import Client
-    from kubernetes_tpu.client.http import HTTPTransport
+def cpu_env() -> dict:
+    """Child env pinned to the CPU backend. Strips the TPU-tunnel site
+    hook trigger: with it set, every python interpreter dials the tunnel
+    at startup and BLOCKS if another process holds the device — a churn
+    run must never hinge on tunnel availability when its solver runs on
+    CPU anyway."""
+    env = dict(ENV, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return env
 
-    client = Client(HTTPTransport(master))
+
+def feed(prefix: str, count: int, rate: float, master: str) -> int:
+    """Paced feeder (one process). Prints one JSON line when done.
+
+    Offers pods over a raw keep-alive connection from a pre-rendered
+    wire template (only the name varies) — a load generator must be
+    cheaper than the server it measures, and on a small machine the
+    typed client's per-create encode was a visible slice of the shared
+    CPU budget (the kubemark principle)."""
+    import http.client
+    import urllib.parse
+
+    u = urllib.parse.urlparse(master)
+    template = json.dumps({
+        "kind": "Pod", "apiVersion": "v1",
+        "metadata": {"name": "@@NAME@@", "namespace": "default"},
+        "spec": {"containers": [{
+            "name": "c", "image": "img",
+            "resources": {"limits": {"cpu": "100m",
+                                     "memory": "128Mi"}}}]}})
+    head, tail = template.split("@@NAME@@")
+    conn = http.client.HTTPConnection(u.hostname, u.port)
+    path = "/api/v1/namespaces/default/pods"
     interval = 1.0 / rate
     t0 = time.perf_counter()
     next_t = t0
     behind_max = 0.0
     for i in range(count):
-        client.pods().create(api.Pod(
-            metadata=api.ObjectMeta(name=f"{prefix}-{i:06d}",
-                                    namespace="default"),
-            spec=api.PodSpec(containers=[api.Container(
-                name="c", image="img",
-                resources=api.ResourceRequirements(limits={
-                    "cpu": Quantity("100m"),
-                    "memory": Quantity("128Mi")}))])))
+        body = f"{head}{prefix}-{i:06d}{tail}"
+        conn.request("POST", path, body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        resp.read()
+        if resp.status >= 300:
+            print(json.dumps({"error": f"create failed: {resp.status}",
+                              "created": i}), flush=True)
+            return 1
         next_t += interval
         now = time.perf_counter()
         behind_max = max(behind_max, now - next_t)
@@ -81,10 +107,20 @@ def main(argv=None) -> int:
     ap.add_argument("--rate", type=float, default=1000.0)
     ap.add_argument("--nodes", type=int, default=500)
     ap.add_argument("--feeders", type=int, default=4)
+    ap.add_argument("--apiservers", type=int, default=3,
+                    help="apiserver worker processes sharing the listen "
+                    "port (SO_REUSEPORT) and one kube-store process; 1 = "
+                    "single apiserver with its own in-process store")
     ap.add_argument("--port", type=int, default=18410)
     ap.add_argument("--out", default=None)
+    ap.add_argument("--platform", choices=["cpu", "ambient"], default="cpu",
+                    help="scheduler solver backend: cpu (default; the "
+                    "churn contract measures the control plane, and cpu "
+                    "children never block on the TPU tunnel) or ambient "
+                    "(inherit env, e.g. to ride the real TPU)")
     args = ap.parse_args(argv)
     master = f"http://127.0.0.1:{args.port}"
+    child_env = cpu_env() if args.platform == "cpu" else ENV
 
     procs = []
 
@@ -93,13 +129,25 @@ def main(argv=None) -> int:
 
     def spawn(name, *cmd):
         log = open(os.path.join(logdir, f"{name}.log"), "w")
-        p = subprocess.Popen(cmd, env=ENV, stdout=log, stderr=log)
+        p = subprocess.Popen(cmd, env=child_env, stdout=log, stderr=log)
         procs.append(p)
         return p
 
     try:
-        spawn("apiserver", PY, "-m", "kubernetes_tpu.cmd.apiserver",
-              "--port", str(args.port))
+        if args.apiservers > 1:
+            # reference topology at scale: one store process (etcd analog)
+            # + N apiserver workers sharing the port via SO_REUSEPORT
+            store_port = args.port + 1
+            spawn("storeserver", PY, "-m", "kubernetes_tpu.cmd.storeserver",
+                  "--port", str(store_port))
+            for w in range(args.apiservers):
+                spawn(f"apiserver{w}", PY, "-m",
+                      "kubernetes_tpu.cmd.apiserver",
+                      "--port", str(args.port), "--reuse-port",
+                      "--store-server", f"127.0.0.1:{store_port}")
+        else:
+            spawn("apiserver", PY, "-m", "kubernetes_tpu.cmd.apiserver",
+                  "--port", str(args.port))
         deadline = time.time() + 60
         while time.time() < deadline:
             try:
@@ -159,11 +207,21 @@ def main(argv=None) -> int:
         feeders = [subprocess.Popen(
             [PY, os.path.abspath(__file__), "--_feed", f"churn{f}",
              str(counts[f]), str(args.rate / args.feeders), master],
-            env=ENV, stdout=subprocess.PIPE, text=True)
+            env=child_env, stdout=subprocess.PIPE, text=True)
             for f in range(args.feeders)]
         stats = [json.loads(p.communicate(timeout=600)[0].strip().splitlines()[-1])
                  for p in feeders]
         feed_s = time.perf_counter() - t0
+        errors = [s["error"] for s in stats if "error" in s]
+        if errors:
+            record = {"config": f"churn multi-process: {args.pods} pods",
+                      "error": f"feeder failures: {errors}",
+                      "created": sum(s.get("created", 0) for s in stats)}
+            print(json.dumps(record, indent=1))
+            if args.out:
+                with open(args.out, "w") as f:
+                    f.write(json.dumps(record, indent=1) + "\n")
+            return 1
         ok = wait_all_bound(args.pods)
         total_s = time.perf_counter() - t0
         offered = sum(s["created"] for s in stats) / feed_s
@@ -171,7 +229,10 @@ def main(argv=None) -> int:
         record = {
             "config": f"churn multi-process: {args.pods} pods at "
                       f"{args.rate:.0f}/s onto {args.nodes} nodes",
-            "topology": "apiserver + tpu-batch scheduler + "
+            "topology": (f"{args.apiservers} apiserver workers "
+                         "(SO_REUSEPORT) + kube-store + "
+                         if args.apiservers > 1 else "apiserver + ")
+                        + "tpu-batch scheduler + "
                         f"{args.feeders} feeders, separate processes, HTTP",
             "offered_pods_per_s": round(offered, 1),
             "sustained_pods_per_s": round(sustained, 1),

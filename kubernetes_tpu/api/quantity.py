@@ -183,7 +183,27 @@ class Quantity:
         return f"Quantity({str(self)!r})"
 
 
+# string -> (Fraction, fmt) memo: decode paths parse the same handful of
+# wire strings ("100m", "128Mi", ...) millions of times under churn, and
+# Fraction construction dominates. Both members of the tuple are
+# immutable, so sharing across instances is safe. Bounded by wholesale
+# clear (the working set is tiny; eviction order is irrelevant).
+_PARSE_CACHE: dict = {}
+_PARSE_CACHE_MAX = 4096
+
+
 def _parse(s: str):
+    hit = _PARSE_CACHE.get(s)
+    if hit is not None:
+        return hit
+    out = _parse_uncached(s)
+    if len(_PARSE_CACHE) >= _PARSE_CACHE_MAX:
+        _PARSE_CACHE.clear()
+    _PARSE_CACHE[s] = out
+    return out
+
+
+def _parse_uncached(s: str):
     s = s.strip()
     m = _QUANTITY_RE.match(s)
     if not m:

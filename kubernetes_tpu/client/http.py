@@ -18,6 +18,7 @@ import threading
 import urllib.error
 import urllib.parse
 import urllib.request
+from collections import OrderedDict
 from typing import Any, Dict, NoReturn, Optional
 
 from kubernetes_tpu import watch as watchpkg
@@ -32,6 +33,41 @@ __all__ = ["HTTPTransport"]
 # Deliberately NOT read from os.environ here: a stray env var must not be
 # able to change the wire version of production clients (advisor r1 #4).
 test_version_override: str = ""
+
+class _EventDecodeCache:
+    """(apiVersion, kind, namespace, name, resourceVersion) -> decoded
+    object. A component typically runs several watches over overlapping
+    sets (the scheduler's unassigned/assigned reflectors both see every
+    bind), and a revision's decode is immutable — the client-side mirror
+    of StoreHelper's decode cache. Callers get a deep_clone, never the
+    cached tree. Bounded FIFO. One instance PER TRANSPORT: resource
+    versions are only unique within one server's store, so a shared
+    cache would let two clusters collide on the same (kind, name, rv)."""
+
+    MAX = 4096
+
+    def __init__(self):
+        self._cache: "OrderedDict" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def decode(self, scheme, wire: dict):
+        from kubernetes_tpu.runtime.clone import deep_clone
+
+        meta = wire.get("metadata") or {}
+        key = (wire.get("apiVersion", ""), wire.get("kind", ""),
+               meta.get("namespace", ""), meta.get("name", ""),
+               meta.get("resourceVersion", ""))
+        if not (key[3] and key[4]):  # unversioned/unnamed: decode directly
+            return scheme.decode_from_wire(wire)
+        with self._lock:
+            obj = self._cache.get(key)
+        if obj is None:
+            obj = scheme.decode_from_wire(wire)
+            with self._lock:
+                self._cache[key] = obj
+                while len(self._cache) > self.MAX:
+                    self._cache.popitem(last=False)
+        return deep_clone(obj)
 
 
 class HTTPTransport:
@@ -61,6 +97,7 @@ class HTTPTransport:
                 ctx.load_cert_chain(client_cert, client_key or None)
             self.ssl_context = ctx
         self._tl = threading.local()   # per-thread kept-alive connection
+        self._event_cache = _EventDecodeCache()
         self._headers: Dict[str, str] = {"Content-Type": "application/json"}
         if auth is not None:
             if auth[0] == "basic":
@@ -301,7 +338,8 @@ class HTTPTransport:
                         continue
                     try:
                         frame = json.loads(line)
-                        obj = self.scheme.decode_from_wire(frame["object"])
+                        obj = self._event_cache.decode(self.scheme,
+                                                       frame["object"])
                         watcher.send(watchpkg.Event(frame["type"], obj))
                     except Exception:
                         break

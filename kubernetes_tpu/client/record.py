@@ -89,19 +89,44 @@ class AsyncEventRecorder:
     control loop keeps running and old events are shed, never the loop
     blocked (events are best-effort diagnostics, not state)."""
 
-    def __init__(self, recorder: EventRecorder, max_queue: int = 4096):
+    def __init__(self, recorder: EventRecorder, max_queue: int = 4096,
+                 qps: float = 0.0, burst: int = 100):
         self.recorder = recorder
         self._q: "deque" = deque(maxlen=max_queue)
         self._cond = threading.Condition()
         self._stopped = False
         self._in_flight = 0   # popped but not yet posted
+        # optional client-side rate limit: events are best-effort
+        # diagnostics, and a scheduler binding 1k pods/s would otherwise
+        # emit 1k API writes/s of "Scheduled" events — the successor
+        # codebase caps this the same way (--event-qps, default 50, in
+        # kubelet/scheduler component config; the v0 reference predates
+        # it, shipping only count compression). qps<=0 disables.
+        self._qps = qps
+        self._tokens = float(burst)
+        self._burst = float(burst)
+        self._last = time.monotonic()
+        self.dropped = 0
         self._worker = threading.Thread(target=self._run, daemon=True,
                                         name="event-recorder")
         self._worker.start()
 
+    def _admit(self) -> bool:
+        if self._qps <= 0:
+            return True
+        now = time.monotonic()
+        self._tokens = min(self._burst,
+                           self._tokens + (now - self._last) * self._qps)
+        self._last = now
+        if self._tokens < 1.0:
+            self.dropped += 1
+            return False
+        self._tokens -= 1.0
+        return True
+
     def eventf(self, obj: Any, reason: str, message_fmt: str, *args) -> None:
         with self._cond:
-            if self._stopped:
+            if self._stopped or not self._admit():
                 return
             self._q.append((obj, reason, message_fmt, args))
             self._cond.notify()

@@ -31,6 +31,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="persist cluster state here (WAL + snapshots); "
                         "empty = in-memory only (the etcd_servers analog: "
                         "ref cmd/kube-apiserver/app/server.go etcd flags)")
+    p.add_argument("--store-server", "--store_server", default="",
+                   help="HOST:PORT of a kube-store process to use instead "
+                        "of an in-process store (the --etcd_servers "
+                        "analog); lets several apiserver workers share one "
+                        "store")
+    p.add_argument("--reuse-port", "--reuse_port", action="store_true",
+                   help="bind with SO_REUSEPORT so several apiserver "
+                        "worker processes share one listen port")
     return p
 
 
@@ -58,7 +66,10 @@ def build_server(opts, ready_event: Optional[threading.Event] = None):
             authorizer = ABACAuthorizer.from_text(f.read())
 
     store = None
-    if getattr(opts, "data_dir", ""):
+    if getattr(opts, "store_server", ""):
+        from kubernetes_tpu.storage.remote import RemoteStore
+        store = RemoteStore(opts.store_server)
+    elif getattr(opts, "data_dir", ""):
         from kubernetes_tpu.storage.durable import DurableStore
         store = DurableStore(opts.data_dir)
 
@@ -73,7 +84,8 @@ def build_server(opts, ready_event: Optional[threading.Event] = None):
     ))
     return APIServer(master, host=opts.address, port=opts.port,
                      authenticator=authenticator,
-                     kubelet_port=opts.kubelet_port)
+                     kubelet_port=opts.kubelet_port,
+                     reuse_port=getattr(opts, "reuse_port", False))
 
 
 def apiserver_server(argv: List[str],

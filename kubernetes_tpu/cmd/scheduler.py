@@ -26,6 +26,10 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["serial", "tpu-batch"])
     p.add_argument("--wave-period", type=float, default=0.05,
                    help="tpu-batch: max wait to accumulate a wave")
+    p.add_argument("--event-qps", "--event_qps", type=float, default=50.0,
+                   help="client-side event rate limit (successor "
+                        "codebases' --event-qps; 0 disables)")
+    p.add_argument("--event-burst", "--event_burst", type=int, default=100)
     return p
 
 
@@ -40,8 +44,11 @@ def build_scheduler(opts):
     client = Client(HTTPTransport(opts.master))
     # async like the reference's StartRecording goroutine (event.go:53):
     # recording must never stall scheduleOne/wave loops on an API write
-    recorder = AsyncEventRecorder(EventRecorder(client, api.EventSource(
-        component=api.DefaultSchedulerName)))
+    recorder = AsyncEventRecorder(
+        EventRecorder(client, api.EventSource(
+            component=api.DefaultSchedulerName)),
+        qps=getattr(opts, "event_qps", 50.0),
+        burst=getattr(opts, "event_burst", 100))
     factory = ConfigFactory(client)
 
     policy = None

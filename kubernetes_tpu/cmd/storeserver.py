@@ -1,0 +1,59 @@
+"""kube-store — the cluster store as its own server process.
+
+The reference does not ship this binary because it delegates the role to
+etcd (ref: DESIGN.md:17 "all persistent master state is stored in etcd";
+cmd/kube-apiserver flags --etcd_servers). This is that missing process
+for the rebuild: it owns the one MemStore/DurableStore and serves it to
+any number of apiserver workers over the RemoteStore protocol.
+
+Usage: python -m kubernetes_tpu.cmd.storeserver [--port 2379]
+           [--data-dir DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+__all__ = ["main"]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="kube-store", exit_on_error=False)
+    p.add_argument("--address", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=2379)  # etcd's port, homage
+    p.add_argument("--data-dir", "--data_dir", default="",
+                   help="persist state here (WAL + snapshots); empty = "
+                        "in-memory only")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    try:
+        opts = build_parser().parse_args(argv)
+    except argparse.ArgumentError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    from kubernetes_tpu.storage.remote import StoreServer
+
+    if opts.data_dir:
+        from kubernetes_tpu.storage.durable import DurableStore
+        store = DurableStore(opts.data_dir)
+    else:
+        from kubernetes_tpu.storage.memstore import MemStore
+        store = MemStore()
+    srv = StoreServer(store, host=opts.address, port=opts.port)
+    print(f"kube-store listening on {srv.address}", flush=True)
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,7 +21,6 @@ behind identical plumbing.
 
 from __future__ import annotations
 
-import copy
 import logging
 import threading
 import time
@@ -41,6 +40,7 @@ from kubernetes_tpu.client.cache import (
     meta_namespace_key_func,
 )
 from kubernetes_tpu.client.record import EventRecorder
+from kubernetes_tpu.runtime.clone import deep_clone
 from kubernetes_tpu.scheduler import plugins as schedplugins
 from kubernetes_tpu.scheduler.generic import GenericScheduler
 from kubernetes_tpu.util import metrics
@@ -188,7 +188,7 @@ class Scheduler:
                      pod.metadata.name, dest)
         # copy before mutating, like the reference's `assumed := *pod`
         # (scheduler.go:114-117) — the popped pod may be shared
-        assumed = copy.deepcopy(pod)
+        assumed = deep_clone(pod)
         assumed.spec.host = dest
         assumed.status.host = dest
         c.modeler.assume_pod(assumed)

@@ -1,0 +1,378 @@
+"""Remote store: the cluster store as its own process.
+
+The reference keeps ALL state in etcd, a separate process every apiserver
+talks to over a socket (ref: pkg/tools/etcd_helper.go over the etcd v2
+HTTP client; DESIGN.md:17-40 — components share state only through the
+store). The in-process MemStore/DurableStore gave this rebuild its
+FakeEtcdClient-style test backend; this module completes the topology
+parity: ``StoreServer`` serves any MemStore-compatible store over a local
+TCP socket, and ``RemoteStore`` is a drop-in MemStore replacement so
+SEVERAL apiserver worker processes can share one consistent store — the
+horizontal-scaling shape the reference gets from Go threads inside one
+apiserver, recovered here across Python processes (one GIL each).
+
+Protocol: length-prefixed JSON frames (4-byte big-endian size + UTF-8
+body). Values are already JSON strings (StoreHelper encodes before
+storing, like EtcdHelper), so the framing cost is one small dict per op.
+Request/response on a pooled connection; ``watch`` upgrades its
+connection to a one-way event stream, exactly like an etcd watch. Store
+errors travel as {"err": <class name>, "msg": ...} and are re-raised as
+the same StoreError classes clients of MemStore already handle.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+from typing import List, Optional, Tuple
+
+from kubernetes_tpu import watch as watchpkg
+from kubernetes_tpu.storage.memstore import (
+    KV,
+    ErrCASConflict,
+    ErrIndexOutdated,
+    ErrKeyExists,
+    ErrKeyNotFound,
+    MemStore,
+    StoreError,
+    StoreEvent,
+)
+
+__all__ = ["StoreServer", "RemoteStore"]
+
+_ERRORS = {
+    "ErrKeyExists": ErrKeyExists,
+    "ErrKeyNotFound": ErrKeyNotFound,
+    "ErrCASConflict": ErrCASConflict,
+    "ErrIndexOutdated": ErrIndexOutdated,
+    "StoreError": StoreError,
+}
+
+
+# -- framing -----------------------------------------------------------------
+
+def _send_frame(sock: socket.socket, obj: dict) -> None:
+    body = json.dumps(obj, separators=(",", ":")).encode("utf-8")
+    sock.sendall(struct.pack(">I", len(body)) + body)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> Optional[dict]:
+    head = _recv_exact(sock, 4)
+    if head is None:
+        return None
+    (size,) = struct.unpack(">I", head)
+    body = _recv_exact(sock, size)
+    if body is None:
+        return None
+    return json.loads(body)
+
+
+def _kv_out(kv: Optional[KV]) -> Optional[dict]:
+    if kv is None:
+        return None
+    return {"k": kv.key, "v": kv.value, "c": kv.created_index,
+            "m": kv.modified_index, "e": kv.expiration}
+
+
+def _kv_in(d: Optional[dict]) -> Optional[KV]:
+    if d is None:
+        return None
+    return KV(d["k"], d["v"], d["c"], d["m"], d.get("e"))
+
+
+def _err_out(e: Exception) -> dict:
+    return {"err": type(e).__name__, "msg": str(e)}
+
+
+def _raise_err(d: dict) -> None:
+    raise _ERRORS.get(d.get("err", ""), StoreError)(d.get("msg", ""))
+
+
+# -- server ------------------------------------------------------------------
+
+class StoreServer:
+    """Serves a MemStore-compatible store over TCP (the etcd process
+    analog). One thread per connection; watch connections stream."""
+
+    def __init__(self, store: Optional[MemStore] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.store = store if store is not None else MemStore()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(128)
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._sock.getsockname()[1]
+
+    @property
+    def address(self) -> str:
+        host, port = self._sock.getsockname()[:2]
+        return f"{host}:{port}"
+
+    def start(self) -> "StoreServer":
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True, name="store-accept")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stopped.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def serve_forever(self) -> None:
+        self._accept_loop()
+
+    def _accept_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True, name="store-conn").start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                req = _recv_frame(conn)
+                if req is None:
+                    return
+                op = req.get("op", "")
+                if op == "watch":
+                    self._serve_watch(conn, req)
+                    return  # the connection is consumed by the stream
+                try:
+                    resp = self._dispatch(op, req)
+                except StoreError as e:
+                    resp = _err_out(e)
+                _send_frame(conn, resp)
+        except (OSError, ValueError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, op: str, req: dict) -> dict:
+        s = self.store
+        if op == "get":
+            return {"ok": _kv_out(s.get(req["key"]))}
+        if op == "get_many":
+            return {"ok": [_kv_out(kv) for kv in s.get_many(req["keys"])]}
+        if op == "list":
+            kvs, index = s.list(req["prefix"])
+            return {"ok": {"kvs": [_kv_out(kv) for kv in kvs],
+                           "index": index}}
+        if op == "create":
+            return {"ok": _kv_out(s.create(req["key"], req["value"],
+                                           ttl=req.get("ttl")))}
+        if op == "set":
+            return {"ok": _kv_out(s.set(req["key"], req["value"],
+                                        ttl=req.get("ttl")))}
+        if op == "cas":
+            return {"ok": _kv_out(s.compare_and_swap(
+                req["key"], req["value"], req["prev_index"],
+                ttl=req.get("ttl")))}
+        if op == "cas_many":
+            outcomes = s.compare_and_swap_many(
+                [(k, v, p) for k, v, p in req["items"]])
+            return {"ok": [_err_out(oc) if isinstance(oc, Exception)
+                           else {"kv": _kv_out(oc)} for oc in outcomes]}
+        if op == "delete":
+            return {"ok": _kv_out(s.delete(req["key"],
+                                           prev_index=req.get("prev_index")))}
+        if op == "index":
+            return {"ok": s.index}
+        raise StoreError(f"unknown op {op!r}")
+
+    def _serve_watch(self, conn: socket.socket, req: dict) -> None:
+        try:
+            src = self.store.watch(req.get("prefix", ""),
+                                   from_index=req.get("from_index", 0),
+                                   recursive=req.get("recursive", True))
+        except StoreError as e:
+            _send_frame(conn, _err_out(e))
+            return
+        _send_frame(conn, {"ok": True})
+
+        # reader side: an EOF/garbage from the client stops the watch, so
+        # a dropped apiserver worker releases its server-side watcher
+        def reap():
+            try:
+                conn.recv(1)
+            except OSError:
+                pass
+            src.stop()
+
+        threading.Thread(target=reap, daemon=True,
+                         name="store-watch-reap").start()
+        try:
+            for ev in src:
+                sev: StoreEvent = ev.object
+                _send_frame(conn, {"ev": {
+                    "action": sev.action, "key": sev.key, "index": sev.index,
+                    "kv": _kv_out(sev.kv), "prev_kv": _kv_out(sev.prev_kv)}})
+        except (OSError, ValueError):
+            pass
+        finally:
+            src.stop()
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+# -- client ------------------------------------------------------------------
+
+class RemoteStore:
+    """Drop-in MemStore replacement speaking to a StoreServer.
+
+    One pooled connection per thread (apiserver handler threads are
+    long-lived); watches open a dedicated streaming connection each, and
+    stopping the client-side Watcher closes it, which the server notices.
+    """
+
+    def __init__(self, address: str):
+        host, _, port = address.rpartition(":")
+        self._addr = (host or "127.0.0.1", int(port))
+        self._local = threading.local()
+
+    # -- plumbing ----------------------------------------------------------
+    def _connect(self) -> socket.socket:
+        sock = socket.create_connection(self._addr, timeout=30)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
+    def _call(self, req: dict):
+        sock = getattr(self._local, "sock", None)
+        if sock is None:
+            sock = self._local.sock = self._connect()
+        try:
+            _send_frame(sock, req)
+        except OSError:
+            # the pooled connection died while idle and the request never
+            # went out: reconnect and resend. Failures AFTER a successful
+            # send are NOT retried — the op may have applied (same
+            # non-idempotent-retry discipline as client/http._open)
+            try:
+                sock.close()
+            except OSError:
+                pass
+            sock = self._local.sock = self._connect()
+            _send_frame(sock, req)
+        try:
+            resp = _recv_frame(sock)
+        except OSError as e:
+            self._local.sock = None
+            raise StoreError(f"store connection failed mid-call: {e}")
+        if resp is None:
+            self._local.sock = None
+            raise StoreError("store connection closed mid-call")
+        if "err" in resp:
+            _raise_err(resp)
+        return resp["ok"]
+
+    # -- MemStore surface --------------------------------------------------
+    @property
+    def index(self) -> int:
+        return self._call({"op": "index"})
+
+    def get(self, key: str) -> KV:
+        return _kv_in(self._call({"op": "get", "key": key}))
+
+    def get_many(self, keys: List[str]) -> List[Optional[KV]]:
+        return [_kv_in(d) for d in
+                self._call({"op": "get_many", "keys": list(keys)})]
+
+    def list(self, prefix: str) -> Tuple[List[KV], int]:
+        out = self._call({"op": "list", "prefix": prefix})
+        return [_kv_in(d) for d in out["kvs"]], out["index"]
+
+    def create(self, key: str, value: str,
+               ttl: Optional[float] = None) -> KV:
+        return _kv_in(self._call({"op": "create", "key": key,
+                                  "value": value, "ttl": ttl}))
+
+    def set(self, key: str, value: str, ttl: Optional[float] = None) -> KV:
+        return _kv_in(self._call({"op": "set", "key": key, "value": value,
+                                  "ttl": ttl}))
+
+    def compare_and_swap(self, key: str, value: str, prev_index: int,
+                         ttl: Optional[float] = None) -> KV:
+        return _kv_in(self._call({"op": "cas", "key": key, "value": value,
+                                  "prev_index": prev_index, "ttl": ttl}))
+
+    def compare_and_swap_many(self, items: List[Tuple[str, str, int]]
+                              ) -> List[object]:
+        out = self._call({"op": "cas_many",
+                          "items": [list(i) for i in items]})
+        results: List[object] = []
+        for d in out:
+            if "err" in d:
+                results.append(_ERRORS.get(d["err"], StoreError)(d["msg"]))
+            else:
+                results.append(_kv_in(d["kv"]))
+        return results
+
+    def delete(self, key: str, prev_index: Optional[int] = None) -> KV:
+        return _kv_in(self._call({"op": "delete", "key": key,
+                                  "prev_index": prev_index}))
+
+    def watch(self, prefix: str, from_index: int = 0,
+              recursive: bool = True) -> watchpkg.Watcher:
+        sock = self._connect()
+        _send_frame(sock, {"op": "watch", "prefix": prefix,
+                           "from_index": from_index, "recursive": recursive})
+        resp = _recv_frame(sock)
+        if resp is None:
+            raise StoreError("store connection closed opening watch")
+        if "err" in resp:
+            _raise_err(resp)
+
+        def on_stop(_w):
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+        w = watchpkg.Watcher(on_stop=on_stop)
+
+        def pump():
+            try:
+                while True:
+                    frame = _recv_frame(sock)
+                    if frame is None or "ev" not in frame:
+                        break
+                    d = frame["ev"]
+                    w.send(watchpkg.Event(d["action"], StoreEvent(
+                        d["action"], d["key"], d["index"],
+                        _kv_in(d.get("kv")), _kv_in(d.get("prev_kv")))))
+            except (OSError, ValueError):
+                pass
+            finally:
+                w.close()
+
+        threading.Thread(target=pump, daemon=True,
+                         name=f"remote-watch-{prefix}").start()
+        return w

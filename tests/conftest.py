@@ -14,6 +14,11 @@ backend initialization.
 import os
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")  # for any subprocesses
+# Tests never need the TPU tunnel; with this trigger set, every spawned
+# interpreter dials the tunnel at startup and BLOCKS whenever another
+# process holds the device (the round-3 wedge signature). CPU-only test
+# children must not depend on tunnel availability.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

@@ -120,3 +120,24 @@ def test_async_recorder_stop_is_idempotent_and_rejects_after():
     arec.stop()
     arec.stop()
     arec.eventf(mk_pod(), "Scheduled", "ok")   # no-op, no crash
+
+
+def test_async_recorder_event_qps_token_bucket():
+    """Client-side event rate limit (the successor codebases' --event-qps):
+    a burst beyond the bucket is dropped without blocking the caller, and
+    tokens refill over time."""
+    client, rec = setup()
+    arec = AsyncEventRecorder(rec, qps=10.0, burst=5)
+    try:
+        for i in range(50):
+            arec.eventf(mk_pod(f"q{i}"), "Scheduled", "ok")
+        assert arec.flush(timeout=10.0)
+        posted = len(client.events("default").list().items)
+        assert posted <= 6          # burst of 5 (+1 refill at most)
+        assert arec.dropped >= 44
+        time.sleep(0.35)            # ~3 tokens refill at 10 qps
+        arec.eventf(mk_pod("late"), "Scheduled", "ok")
+        assert arec.flush(timeout=10.0)
+        assert len(client.events("default").list().items) > posted
+    finally:
+        arec.stop()

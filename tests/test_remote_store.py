@@ -1,0 +1,176 @@
+"""Remote store: the kube-store server + RemoteStore client.
+
+The topology parity piece (ref: DESIGN.md:17-40 — etcd is its own
+process; every apiserver shares it): RemoteStore must behave exactly
+like MemStore through the same contract, including watch resume
+semantics, CAS conflicts as typed errors, and the batched wave-commit
+ops. The final test drives a full apiserver + a second worker sharing
+one listen port (SO_REUSEPORT) against one store process, the
+multi-worker deployment hack/churn_mp.py --apiservers N uses.
+"""
+
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.storage.memstore import (
+    ErrCASConflict,
+    ErrIndexOutdated,
+    ErrKeyExists,
+    ErrKeyNotFound,
+    MemStore,
+)
+from kubernetes_tpu.storage.remote import RemoteStore, StoreServer
+
+
+@pytest.fixture()
+def remote():
+    srv = StoreServer(MemStore()).start()
+    try:
+        yield RemoteStore(srv.address)
+    finally:
+        srv.stop()
+
+
+def test_crud_and_errors(remote):
+    kv = remote.create("/r/a", "1")
+    assert (kv.key, kv.value, kv.modified_index) == ("/r/a", "1", 1)
+    with pytest.raises(ErrKeyExists):
+        remote.create("/r/a", "x")
+    kv2 = remote.compare_and_swap("/r/a", "2", kv.modified_index)
+    assert kv2.modified_index == 2 and kv2.created_index == 1
+    with pytest.raises(ErrCASConflict):
+        remote.compare_and_swap("/r/a", "x", 1)
+    with pytest.raises(ErrKeyNotFound):
+        remote.get("/r/missing")
+    with pytest.raises(ErrKeyNotFound):
+        remote.delete("/r/missing")
+    kvs, index = remote.list("/r")
+    assert [k.value for k in kvs] == ["2"] and index == 2
+    assert remote.index == 2
+    assert remote.delete("/r/a").value == "2"
+
+
+def test_get_many_and_cas_many(remote):
+    a = remote.create("/m/a", "1")
+    b = remote.create("/m/b", "1")
+    got = remote.get_many(["/m/a", "/m/zz", "/m/b"])
+    assert got[0].value == "1" and got[1] is None and got[2].value == "1"
+    out = remote.compare_and_swap_many([
+        ("/m/a", "2", a.modified_index),
+        ("/m/b", "2", 999),          # stale -> conflict
+        ("/m/c", "2", 1),            # absent -> not found
+    ])
+    assert out[0].modified_index == 3
+    assert isinstance(out[1], ErrCASConflict)
+    assert isinstance(out[2], ErrKeyNotFound)
+
+
+def test_watch_stream_and_resume(remote):
+    w = remote.watch("/w", from_index=0)
+    remote.create("/w/a", "1")
+    remote.set("/w/a", "2")
+    it = iter(w)
+    e1, e2 = next(it), next(it)
+    assert e1.object.action == "create" and e1.object.kv.value == "1"
+    assert e2.object.action == "set" and e2.object.prev_kv.value == "1"
+    w.stop()
+    # resume from index replays history after that index
+    w2 = remote.watch("/w", from_index=1)
+    e = next(iter(w2))
+    assert e.object.index == 2 and e.object.kv.value == "2"
+    w2.stop()
+
+
+def test_watch_outdated_index_raises(remote):
+    for i in range(MemStore.HISTORY_WINDOW + 10):
+        remote.set("/h/k", str(i))
+    with pytest.raises(ErrIndexOutdated):
+        remote.watch("/h", from_index=1)
+
+
+def test_client_watch_stop_releases_server_watcher(remote):
+    w = remote.watch("/s", from_index=0)
+    remote.create("/s/a", "1")
+    assert next(iter(w)).object.kv.value == "1"
+    w.stop()
+    time.sleep(0.2)
+    # a stopped remote watcher must not leak server-side: new writes
+    # still succeed and a fresh watch sees them
+    remote.create("/s/b", "1")
+    w2 = remote.watch("/s", from_index=0)
+    remote.create("/s/c", "1")
+    assert next(iter(w2)).object.key == "/s/c"
+    w2.stop()
+
+
+def test_concurrent_clients_share_indices(remote):
+    # two client objects (distinct connections) interleave writes; the
+    # store's global index stays monotonic across them
+    other = RemoteStore(f"127.0.0.1:{remote._addr[1]}")
+    seen = []
+    lock = threading.Lock()
+
+    def writer(store, tag):
+        for i in range(50):
+            kv = store.set(f"/c/{tag}-{i}", "x")
+            with lock:
+                seen.append(kv.modified_index)
+
+    t1 = threading.Thread(target=writer, args=(remote, "a"))
+    t2 = threading.Thread(target=writer, args=(other, "b"))
+    t1.start(); t2.start(); t1.join(); t2.join()
+    assert len(seen) == 100 and len(set(seen)) == 100
+    assert max(seen) == remote.index
+
+
+def test_apiserver_workers_share_store_via_reuseport():
+    """Two apiserver workers on ONE port (SO_REUSEPORT), one kube-store:
+    an object created through the shared port is visible no matter which
+    worker serves the read, and resourceVersions are globally ordered."""
+    import http.client
+    import json
+
+    from kubernetes_tpu.apiserver.http import APIServer
+    from kubernetes_tpu.apiserver.master import Master, MasterConfig
+
+    store_srv = StoreServer(MemStore()).start()
+    workers = []
+    try:
+        w0 = APIServer(Master(MasterConfig(
+            store=RemoteStore(store_srv.address))),
+            port=0, reuse_port=True).start()
+        workers.append(w0)
+        port = w0.port
+        w1 = APIServer(Master(MasterConfig(
+            store=RemoteStore(store_srv.address))),
+            port=port, reuse_port=True).start()
+        workers.append(w1)
+
+        def do(method, path, body=None):
+            conn = http.client.HTTPConnection("127.0.0.1", port)
+            conn.request(method, path, body=body)
+            resp = conn.getresponse()
+            out = json.loads(resp.read())
+            conn.close()
+            return resp.status, out
+
+        rvs = set()
+        for i in range(8):  # fresh connection each time -> both workers
+            code, out = do("POST", "/api/v1/namespaces/default/pods",
+                           json.dumps({
+                               "kind": "Pod", "apiVersion": "v1",
+                               "metadata": {"name": f"shared-{i}",
+                                            "namespace": "default"},
+                               "spec": {"containers": [
+                                   {"name": "c", "image": "i"}]}}))
+            assert code == 201, out
+            rvs.add(out["metadata"]["resourceVersion"])
+        assert len(rvs) == 8  # globally unique revisions across workers
+        code, out = do("GET", "/api/v1/namespaces/default/pods")
+        assert code == 200 and len(out["items"]) == 8
+    finally:
+        for w in workers:
+            w.stop()
+        store_srv.stop()
