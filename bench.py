@@ -909,24 +909,32 @@ def child(argv) -> int:
     # north star: budget-sized oracle gate over the FULL node axis (a
     # complete 10k x 5k serial oracle is ~20min; FULLGATE_r03.json records
     # the out-of-band full-scale equivalence run)
+    # full shapes come from FULL_SHAPES — the ONE definition shared with
+    # hack/fullgate.py, so the out-of-band gate always certifies exactly
+    # the config this matrix runs
+    ns_nodes, ns_pods, _ = FULL_SHAPES["north_star"]
     run("north_star", run_solver_config,
-        args.nodes or (100 if s else 5_000),
-        args.pods or (500 if s else 10_000),
+        args.nodes or (100 if s else ns_nodes),
+        args.pods or (500 if s else ns_pods),
         full_gate=s, profile=args.profile)
+    b_nodes, b_pods, _ = FULL_SHAPES["basic"]
     run("basic", run_solver_config,
-        50 if s else 500, 100 if s else 1_000, full_gate=True)
+        50 if s else b_nodes, 100 if s else b_pods, full_gate=True)
+    a_nodes, a_pods, _ = FULL_SHAPES["affinity"]
     run("affinity", run_solver_config,
-        100 if s else 5_000, 200 if s else 5_000,
+        100 if s else a_nodes, 200 if s else a_pods,
         gate_nodes=100 if s else 600, gate_pods=200 if s else 600,
         policy=aff_policy)
+    p3_nodes, p3_pods, p3_kw = FULL_SHAPES["binpack3"]
     run("binpack3", run_solver_config,
-        100 if s else 5_000, 300 if s else 10_000,
+        100 if s else p3_nodes, 300 if s else p3_pods,
         gate_nodes=100 if s else 600, gate_pods=300 if s else 600,
-        three_resources=True)
+        **p3_kw)
+    g_nodes, g_pods, g_kw = FULL_SHAPES["gang"]
     run("gang", run_solver_config,
-        100 if s else 2_000, 0,
+        100 if s else g_nodes, g_pods,
         gate_nodes=50 if s else 200, gate_pods=160 if s else 400,
-        gang_groups=20 if s else 1_000, gang_size=8)
+        **({"gang_groups": 20, "gang_size": 8} if s else g_kw))
     run("churn", run_churn_config,
         20 if s else 500, 300 if s else 4_000,
         rate_pods_per_s=300 if s else 1_000)

@@ -105,10 +105,16 @@ class TokenAuthenticator:
 
 
 def load_token_file(text: str) -> TokenAuthenticator:
+    """token,user,uid[,\"group1,group2\"] per line (ref: the tokenfile
+    authenticator's CSV shape, plugin/pkg/auth/authenticator/token/
+    tokenfile — the optional fourth column carries group memberships)."""
     tokens: Dict[str, UserInfo] = {}
     for row in csv.reader(io.StringIO(text)):
         if len(row) >= 3:
-            tokens[row[0].strip()] = UserInfo(name=row[1].strip(), uid=row[2].strip())
+            groups = tuple(g.strip() for g in row[3].split(",") if g.strip()) \
+                if len(row) >= 4 else ()
+            tokens[row[0].strip()] = UserInfo(
+                name=row[1].strip(), uid=row[2].strip(), groups=groups)
     return TokenAuthenticator(tokens)
 
 

@@ -9,6 +9,8 @@ object or None, exactly like the reference's (T, bool) pairs. Providers:
   instance list is localhost, load balancers are kube-proxy portals
 - ``InventoryCloud`` — JSON-inventory-file provider (the vagrant/ovirt
   config-driven pattern); registered as "inventory"
+- ``ProbeCloud``     — discovery-command provider (the GCE-metadata /
+  live-query pattern) with Clusters support; registered as "probe"
 
 The registry (``register_provider``/``get_provider``) mirrors
 pkg/cloudprovider/plugins.go; importing this package registers the
@@ -21,3 +23,4 @@ from kubernetes_tpu.cloudprovider.cloud import (Clusters, FakeCloud,  # noqa: F4
                                                 Zone, Zones, get_provider,
                                                 register_provider)
 from kubernetes_tpu.cloudprovider.inventory import InventoryCloud  # noqa: F401,E402
+from kubernetes_tpu.cloudprovider.probe import ProbeCloud  # noqa: F401,E402

@@ -29,6 +29,7 @@ import os
 import shutil
 import signal
 import subprocess
+import sys
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -43,15 +44,19 @@ from kubernetes_tpu.kubelet.runtime import (
     pod_full_name,
 )
 
-__all__ = ["ProcessRuntime", "find_pause_binary"]
+__all__ = ["ProcessRuntime", "find_pause_binary", "pause_command"]
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
 def find_pause_binary(build_dir: Optional[str] = None) -> Optional[str]:
-    """Locate (or build) the native pause binary. Returns None when no
-    binary exists and the toolchain is unavailable."""
+    """Locate (or build) the native pause binary, falling back to the
+    pure-Python sandbox (native/pause/pause.py) when no binary exists
+    and the toolchain is unavailable — the flagship runtime must work in
+    toolchain-less environments. Returns the sandbox entry path (binary
+    or .py script; see pause_command), or None only if even the Python
+    fallback is missing."""
     candidates = [
         os.path.join(_REPO_ROOT, "native", "pause", "pause"),
         os.path.join(build_dir, "pause") if build_dir else None,
@@ -68,8 +73,21 @@ def find_pause_binary(build_dir: Optional[str] = None) -> Optional[str]:
                            check=True, capture_output=True, timeout=120)
             return out
         except (subprocess.SubprocessError, OSError):
-            return None
+            pass
+    fallback = os.path.join(_REPO_ROOT, "native", "pause", "pause.py")
+    if os.path.isfile(fallback):
+        return fallback
     return None
+
+
+def pause_command(pause_path: Optional[str]) -> Optional[list]:
+    """argv for the sandbox holder: the native binary directly, or the
+    Python fallback through this interpreter."""
+    if pause_path is None:
+        return None
+    if pause_path.endswith(".py"):
+        return [sys.executable, pause_path]
+    return [pause_path]
 
 
 class _Proc:
@@ -97,6 +115,10 @@ class ProcessRuntime(ContainerRuntime):
         os.makedirs(self.log_dir, exist_ok=True)
         self.pause_binary = pause_binary or find_pause_binary(
             build_dir=os.path.join(root_dir, "bin"))
+        # argv the sandbox holder is spawned with (binary, or the Python
+        # fallback through sys.executable); identity checks compare argv
+        # against this list
+        self.pause_cmd = pause_command(self.pause_binary)
         self.stop_grace_s = stop_grace_s
         self._lock = threading.RLock()
         self._procs: Dict[str, _Proc] = {}
@@ -153,7 +175,7 @@ class ProcessRuntime(ContainerRuntime):
         # never unblock, which would break graceful stop); they rely on
         # the _refresh spawn-kill heal instead.
         preexec = None
-        if p.argv[0] == self.pause_binary:
+        if p.argv == self.pause_cmd:
             def preexec():
                 signal.pthread_sigmask(signal.SIG_BLOCK,
                                        {signal.SIGTERM, signal.SIGINT})
@@ -213,7 +235,7 @@ class ProcessRuntime(ContainerRuntime):
                     raise RuntimeError(
                         f"container {container.name!r} has no command and "
                         "no pause binary is available")
-                argv = [self.pause_binary]
+                argv = list(self.pause_cmd)
             cid = f"p{next(self._id_counter)}"
             env = dict(os.environ)
             for e in container.env:
@@ -242,7 +264,7 @@ class ProcessRuntime(ContainerRuntime):
                 # so HTTP/TCP probes and the service proxy hit real sockets
                 ip="127.0.0.1")
             self._procs[cid] = _Proc(
-                record, [self.pause_binary], dict(os.environ), self.root_dir,
+                record, list(self.pause_cmd), dict(os.environ), self.root_dir,
                 os.path.join(self.log_dir, f"{cid}.log"))
             return cid
 
@@ -264,7 +286,7 @@ class ProcessRuntime(ContainerRuntime):
             if not p.record.running:
                 return
             pgid = p.popen.pid
-            is_pause = p.argv[0] == self.pause_binary
+            is_pause = p.argv == self.pause_cmd
         # TERM -> grace -> KILL outside the lock (the wait can take seconds).
         # For the pause sandbox only, TERM is re-sent every 0.5s through the
         # grace period: pause may classify one early TERM as a spawn-kill

@@ -342,6 +342,10 @@ class RemoteStore:
     def watch(self, prefix: str, from_index: int = 0,
               recursive: bool = True) -> watchpkg.Watcher:
         sock = self._connect()
+        # the pooled-call timeout must NOT apply to the stream: a watch
+        # over a quiet prefix legitimately sees nothing for minutes, and
+        # a timed-out recv would silently end every downstream watcher
+        sock.settimeout(None)
         _send_frame(sock, {"op": "watch", "prefix": prefix,
                            "from_index": from_index, "recursive": recursive})
         resp = _recv_frame(sock)

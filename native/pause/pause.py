@@ -1,0 +1,44 @@
+"""Pure-Python pod-sandbox fallback for environments without a C++
+toolchain (and no prebuilt ``pause``).
+
+Mirrors native/pause/pause.cc, our redesign of the reference's one
+native artifact (ref: third_party/pause/pause.asm:44-55 — a minimal
+process that parks forever and exits cleanly on termination, holding
+the pod sandbox alive):
+
+- parks until SIGTERM/SIGINT, then exits 0 (graceful);
+- stray-signal hardening: ProcessRuntime spawns the sandbox with TERM
+  blocked (spawn-time strays must not kill a fresh sandbox); after
+  installing handlers this script unblocks and discards at most ONE
+  TERM arriving inside the startup window, exactly like pause.cc. The
+  runtime compensates by re-sending TERM every 0.5s during a graceful
+  stop, so a real stop is never lost.
+"""
+
+import signal
+import sys
+import time
+
+_T0 = time.monotonic()
+_STRAY_WINDOW_S = 0.25
+_strays = 0
+
+
+def _on_term(signum, frame):
+    global _strays
+    if time.monotonic() - _T0 < _STRAY_WINDOW_S and _strays == 0:
+        _strays = 1  # spawn-time stray: discard once
+        return
+    sys.exit(0)
+
+
+signal.signal(signal.SIGTERM, _on_term)
+signal.signal(signal.SIGINT, _on_term)
+try:
+    signal.pthread_sigmask(signal.SIG_UNBLOCK,
+                           {signal.SIGTERM, signal.SIGINT})
+except (AttributeError, ValueError):
+    pass
+
+while True:
+    time.sleep(3600)

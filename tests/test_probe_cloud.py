@@ -1,0 +1,117 @@
+"""ProbeCloud — the discovery-command cloud provider.
+
+Second real provider through the same seam as InventoryCloud, from the
+live-query angle (ref: the reference's GCE/vagrant/ovirt providers poll
+an external system, pkg/cloudprovider/cloud.go:26-80). The probe here
+is a real subprocess printing JSON; the tests cover TTL-cached refresh,
+degradation to the stale snapshot on probe failure, the never-readable
+error, and the Clusters facet the inventory provider doesn't implement.
+"""
+
+import json
+import sys
+
+import pytest
+
+from kubernetes_tpu.api.quantity import Quantity
+from kubernetes_tpu.cloudprovider import get_provider
+from kubernetes_tpu.cloudprovider.probe import ProbeCloud, ProbeError
+
+INVENTORY = {
+    "zone": {"failure_domain": "cell-a", "region": "dc1"},
+    "instances": [
+        {"name": "w1", "addresses": ["10.1.0.1"], "cpu": "4",
+         "memory": "8Gi"},
+        {"name": "w2", "addresses": ["10.1.0.2"]},
+    ],
+    "clusters": {"names": ["alpha", "beta"],
+                 "masters": {"alpha": "10.1.0.100", "beta": "10.1.0.200"}},
+}
+
+
+def probe_cmd_printing(data) -> list:
+    return [sys.executable, "-c",
+            f"import sys; sys.stdout.write({json.dumps(json.dumps(data))!s})"]
+
+
+def probe_cmd_from_file(path) -> list:
+    # each run re-reads the file — lets tests change what discovery finds
+    return [sys.executable, "-c",
+            f"import sys; sys.stdout.write(open({str(path)!r}).read())"]
+
+
+def test_probe_discovers_instances_zones_clusters():
+    cloud = ProbeCloud(probe_cmd_printing(INVENTORY))
+    inst = cloud.instances()
+    assert inst.list_instances() == ["w1", "w2"]
+    assert inst.list_instances("w1") == ["w1"]
+    assert inst.node_addresses("w1") == ["10.1.0.1"]
+    spec = inst.get_node_resources("w1")
+    assert spec.capacity["cpu"] == Quantity("4")
+    assert inst.get_node_resources("w2") is None
+    z = cloud.zones().get_zone()
+    assert (z.failure_domain, z.region) == ("cell-a", "dc1")
+    c = cloud.clusters()
+    assert c.list_clusters() == ["alpha", "beta"]
+    assert c.master("alpha") == "10.1.0.100"
+    with pytest.raises(KeyError):
+        c.master("nope")
+
+
+def test_probe_ttl_refresh_picks_up_changes(tmp_path):
+    src = tmp_path / "inv.json"
+    src.write_text(json.dumps(INVENTORY))
+    t = [0.0]
+    cloud = ProbeCloud(probe_cmd_from_file(src), ttl_s=10.0,
+                       clock=lambda: t[0])
+    assert cloud.instances().list_instances() == ["w1", "w2"]
+
+    changed = dict(INVENTORY, instances=[{"name": "w3"}])
+    src.write_text(json.dumps(changed))
+    # inside the TTL: cached snapshot still served (no re-probe)
+    t[0] = 5.0
+    assert cloud.instances().list_instances() == ["w1", "w2"]
+    # past the TTL: discovery re-runs and sees the new world
+    t[0] = 11.0
+    assert cloud.instances().list_instances() == ["w3"]
+
+
+def test_probe_failure_degrades_to_stale_not_empty(tmp_path):
+    src = tmp_path / "inv.json"
+    src.write_text(json.dumps(INVENTORY))
+    t = [0.0]
+    cloud = ProbeCloud(probe_cmd_from_file(src), ttl_s=1.0,
+                       clock=lambda: t[0])
+    assert cloud.instances().list_instances() == ["w1", "w2"]
+
+    src.write_text("{ torn json")          # discovery backend flaps
+    t[0] = 2.0
+    assert cloud.instances().list_instances() == ["w1", "w2"]  # stale, not []
+
+    src.unlink()                           # command itself now fails
+    t[0] = 4.0
+    assert cloud.instances().list_instances() == ["w1", "w2"]
+
+    src.write_text(json.dumps(INVENTORY))  # backend recovers
+    t[0] = 6.0
+    assert cloud.instances().node_addresses("w2") == ["10.1.0.2"]
+
+
+def test_probe_never_readable_raises():
+    cloud = ProbeCloud([sys.executable, "-c", "import sys; sys.exit(3)"])
+    with pytest.raises(ProbeError):
+        cloud.instances()
+
+
+def test_probe_registered_in_provider_registry(tmp_path, monkeypatch):
+    src = tmp_path / "inv.json"
+    src.write_text(json.dumps(INVENTORY))
+    cmd = " ".join([sys.executable, "-c",
+                    f"'import sys; sys.stdout.write(open({str(src)!r}).read())'"])
+    # the registry factory reads KTPU_CLOUD_PROBE_CMD (shlex-split)
+    monkeypatch.setenv(
+        "KTPU_CLOUD_PROBE_CMD",
+        f'{sys.executable} -c "import sys; '
+        f"sys.stdout.write(open('{src}').read())\"")
+    cloud = get_provider("probe")
+    assert cloud.instances().list_instances() == ["w1", "w2"]

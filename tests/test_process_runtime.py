@@ -282,3 +282,46 @@ def test_group_stats_include_forked_children(runtime):
     assert rss > 200_000
     rt.stop_container(cid)
     assert rt.group_stats(cid) is None  # dead group -> None, not zeros
+
+
+class TestPythonPauseFallback:
+    """Toolchain-less environments: the pure-Python sandbox
+    (native/pause/pause.py) stands in for the native pause binary, so the
+    flagship runtime never skips for lack of g++."""
+
+    def _fallback_runtime(self, tmp_path):
+        script = os.path.join(os.path.dirname(__file__), "..",
+                              "native", "pause", "pause.py")
+        return ProcessRuntime(str(tmp_path), pause_binary=script)
+
+    def test_sandbox_runs_and_stops_gracefully(self, tmp_path):
+        rt = self._fallback_runtime(tmp_path)
+        try:
+            assert rt.pause_cmd[0].endswith("python") \
+                or "python" in os.path.basename(rt.pause_cmd[0])
+            pod = mk_pod("fb", ["true"])
+            cid = rt.create_infra_container(pod)
+            rt.start_container(cid)
+            time.sleep(0.5)
+            recs = {r.id: r for r in rt.list_containers(include_dead=True)}
+            assert recs[cid].running
+            rt.stop_container(cid)
+            recs = {r.id: r for r in rt.list_containers(include_dead=True)}
+            assert not recs[cid].running
+            assert recs[cid].exit_code == 0  # graceful TERM exit
+        finally:
+            rt.shutdown()
+
+    def test_commandless_container_holds_slot_via_fallback(self, tmp_path):
+        rt = self._fallback_runtime(tmp_path)
+        try:
+            rt.pull_image("img:slot")
+            pod = mk_pod("fb2", ["true"])
+            c = api.Container(name="slot", image="img:slot")
+            cid = rt.create_container(pod, c, 0)
+            rt.start_container(cid)
+            time.sleep(0.5)
+            recs = {r.id: r for r in rt.list_containers(include_dead=True)}
+            assert recs[cid].running
+        finally:
+            rt.shutdown()

