@@ -26,13 +26,16 @@ python -m kubernetes_tpu.cmd.kubelet --api-servers "${MASTER}" \
     --hostname-override "$(hostname)" --register-node --port 10250 \
     --root-dir /tmp/kubelet-tpu &
 PIDS+=($!)
-# addons (ref: cluster/addons/{dns,cluster-monitoring})
+# addons (ref: cluster/addons/{dns,cluster-monitoring,fluentd-elasticsearch})
 python -m kubernetes_tpu.cmd.dns --master "${MASTER}" --port 10053 &
 PIDS+=($!)
 python -m kubernetes_tpu.cmd.monitoring --master "${MASTER}" --port 10251 &
+PIDS+=($!)
+python -m kubernetes_tpu.cmd.logging --master "${MASTER}" --port 10252 &
 PIDS+=($!)
 
 echo "control plane up: ${MASTER} (Ctrl-C to stop)"
 echo "  dns:        udp://127.0.0.1:10053  (<svc>.<ns>.cluster.local)"
 echo "  monitoring: http://127.0.0.1:10251/api/v1/model"
+echo "  logging:    http://127.0.0.1:10252/logs?namespace=default"
 wait

@@ -82,20 +82,28 @@ class ProbeCloud(Interface):
             data = json.loads(p.stdout.decode("utf-8", "replace"))
             if not isinstance(data, dict):
                 raise ValueError("probe output is not a JSON object")
-        except (OSError, subprocess.SubprocessError, ValueError):
+            # parse the WHOLE schema before touching any state: a
+            # structurally-malformed inventory (instance without "name",
+            # zone as a string, ...) must degrade to the stale snapshot
+            # like any other torn output, never crash a sync tick or
+            # leave snapshot/clusters half-replaced
+            zone = data.get("zone") or {}
+            snapshot = _Snapshot(
+                Zone(failure_domain=zone.get("failure_domain", ""),
+                     region=zone.get("region", "")),
+                {inst["name"]: inst for inst in data.get("instances", [])})
+            clusters = data.get("clusters") or {}
+            clusters_view = _ClustersView(
+                list(clusters.get("names", [])),
+                dict(clusters.get("masters", {})))
+        except (OSError, subprocess.SubprocessError, ValueError, KeyError,
+                AttributeError, TypeError):
             # keep the previous snapshot; retry on the next access past TTL
             if self._snapshot is not None:
                 self._fetched_at = now
             return
-        zone = data.get("zone") or {}
-        self._snapshot = _Snapshot(
-            Zone(failure_domain=zone.get("failure_domain", ""),
-                 region=zone.get("region", "")),
-            {inst["name"]: inst for inst in data.get("instances", [])})
-        clusters = data.get("clusters") or {}
-        self._clusters = _ClustersView(
-            list(clusters.get("names", [])),
-            dict(clusters.get("masters", {})))
+        self._snapshot = snapshot
+        self._clusters = clusters_view
         self._fetched_at = now
 
     def _current(self) -> _Snapshot:

@@ -253,14 +253,16 @@ class RemoteStore:
     stopping the client-side Watcher closes it, which the server notices.
     """
 
-    def __init__(self, address: str):
+    def __init__(self, address: str, call_timeout_s: float = 30.0):
         host, _, port = address.rpartition(":")
         self._addr = (host or "127.0.0.1", int(port))
+        self._call_timeout_s = call_timeout_s
         self._local = threading.local()
 
     # -- plumbing ----------------------------------------------------------
     def _connect(self) -> socket.socket:
-        sock = socket.create_connection(self._addr, timeout=30)
+        sock = socket.create_connection(self._addr,
+                                        timeout=self._call_timeout_s)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return sock
 
@@ -342,10 +344,8 @@ class RemoteStore:
     def watch(self, prefix: str, from_index: int = 0,
               recursive: bool = True) -> watchpkg.Watcher:
         sock = self._connect()
-        # the pooled-call timeout must NOT apply to the stream: a watch
-        # over a quiet prefix legitimately sees nothing for minutes, and
-        # a timed-out recv would silently end every downstream watcher
-        sock.settimeout(None)
+        # the open handshake stays under the connect timeout (a wedged
+        # store must fail watch() in bounded time) ...
         _send_frame(sock, {"op": "watch", "prefix": prefix,
                            "from_index": from_index, "recursive": recursive})
         resp = _recv_frame(sock)
@@ -353,6 +353,10 @@ class RemoteStore:
             raise StoreError("store connection closed opening watch")
         if "err" in resp:
             _raise_err(resp)
+        # ... but the STREAM must carry no timeout: a watch over a quiet
+        # prefix legitimately sees nothing for minutes, and a timed-out
+        # recv would silently end every downstream watcher
+        sock.settimeout(None)
 
         def on_stop(_w):
             try:

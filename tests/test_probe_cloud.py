@@ -106,8 +106,6 @@ def test_probe_never_readable_raises():
 def test_probe_registered_in_provider_registry(tmp_path, monkeypatch):
     src = tmp_path / "inv.json"
     src.write_text(json.dumps(INVENTORY))
-    cmd = " ".join([sys.executable, "-c",
-                    f"'import sys; sys.stdout.write(open({str(src)!r}).read())'"])
     # the registry factory reads KTPU_CLOUD_PROBE_CMD (shlex-split)
     monkeypatch.setenv(
         "KTPU_CLOUD_PROBE_CMD",
@@ -115,3 +113,20 @@ def test_probe_registered_in_provider_registry(tmp_path, monkeypatch):
         f"sys.stdout.write(open('{src}').read())\"")
     cloud = get_provider("probe")
     assert cloud.instances().list_instances() == ["w1", "w2"]
+
+
+def test_probe_malformed_schema_degrades_to_stale(tmp_path):
+    """Exit-0 probe printing structurally-broken JSON (instance without
+    name, zone as a string) must degrade to the stale snapshot, not
+    crash the sync tick (regression)."""
+    src = tmp_path / "inv.json"
+    src.write_text(json.dumps(INVENTORY))
+    t = [0.0]
+    cloud = ProbeCloud(probe_cmd_from_file(src), ttl_s=1.0,
+                       clock=lambda: t[0])
+    assert cloud.instances().list_instances() == ["w1", "w2"]
+    src.write_text(json.dumps({"zone": "not-a-dict",
+                               "instances": [{"host": "no-name-key"}]}))
+    t[0] = 2.0
+    assert cloud.instances().list_instances() == ["w1", "w2"]
+    assert cloud.clusters().list_clusters() == ["alpha", "beta"]

@@ -176,24 +176,20 @@ def test_apiserver_workers_share_store_via_reuseport():
         store_srv.stop()
 
 
-def test_watch_survives_idle_longer_than_call_timeout(remote, monkeypatch):
+def test_watch_survives_idle_longer_than_call_timeout():
     """The stream socket must carry NO timeout: a quiet prefix can sit
-    idle far longer than the pooled-call connect timeout, and a timed-out
-    recv would silently close every downstream watcher (regression)."""
-    w = remote.watch("/idle", from_index=0)
-    # white-box: the pump reads from a socket with timeout None
-    import threading
-    pump = next(t for t in threading.enumerate()
-                if t.name == "remote-watch-/idle")
-    assert pump.is_alive()
-    # the client-side watch socket is the one opened last; verify via a
-    # fresh watch whose socket we can reach before handing it to the pump
-    sock = remote._connect()
-    sock.settimeout(None)
-    assert sock.gettimeout() is None
-    sock.close()
-    # and the live stream still delivers after the watcher sat idle
-    time.sleep(0.3)
-    remote.create("/idle/k", "1")
-    assert next(iter(w)).object.kv.value == "1"
-    w.stop()
+    idle far longer than the pooled-call socket timeout, and a timed-out
+    recv would silently close every downstream watcher (regression:
+    watch streams died after call_timeout of quiet). Pinned for real by
+    shrinking the injectable timeout below the idle period."""
+    srv = StoreServer(MemStore()).start()
+    try:
+        rs = RemoteStore(srv.address, call_timeout_s=0.5)
+        w = rs.watch("/idle", from_index=0)
+        time.sleep(1.6)               # > 3x the call timeout, zero events
+        rs.create("/idle/k", "1")     # stream must still be alive
+        ev = next(iter(w))
+        assert ev.object.kv.value == "1"
+        w.stop()
+    finally:
+        srv.stop()
