@@ -136,13 +136,15 @@ def _zero_record(reason: str) -> str:
 
 def _emit_fallback(cmd, child_args, deadline, reason, last_err) -> int:
     """Terminal fallback, always prints exactly one JSON line: replay the
-    committed CPU record when the invocation is the driver's default (costs
-    milliseconds), else one fresh labeled CPU run on the remaining budget,
-    else a zero-value error record."""
+    best committed record (TPU preferred; see _find_replay_record) when
+    the invocation is the driver's default (costs milliseconds), else one
+    fresh labeled CPU run on the remaining budget, else a zero-value
+    error record."""
     if not child_args:   # replay only answers the default invocation
         replay = _find_replay_record(reason)
         if replay is not None:
-            log(f"[bench] {reason}; replaying the committed CPU record")
+            src = json.loads(replay).get("replayed_from", "?")
+            log(f"[bench] {reason}; replaying the committed record {src}")
             print(replay)
             return 1
     env = {k: v for k, v in os.environ.items()
