@@ -266,49 +266,61 @@ class RemoteStore:
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return sock
 
-    def _call(self, req: dict):
-        sock = getattr(self._local, "sock", None)
-        if sock is None:
-            sock = self._local.sock = self._connect()
-        try:
-            _send_frame(sock, req)
-        except OSError:
-            # the pooled connection died while idle and the request never
-            # went out: reconnect and resend. Failures AFTER a successful
-            # send are NOT retried — the op may have applied (same
-            # non-idempotent-retry discipline as client/http._open)
+    def _call(self, req: dict, idempotent: bool = False):
+        for attempt in (0, 1):
+            sock = getattr(self._local, "sock", None)
+            if sock is None:
+                sock = self._local.sock = self._connect()
             try:
-                sock.close()
+                _send_frame(sock, req)
             except OSError:
-                pass
-            sock = self._local.sock = self._connect()
-            _send_frame(sock, req)
-        try:
-            resp = _recv_frame(sock)
-        except OSError as e:
-            self._local.sock = None
-            raise StoreError(f"store connection failed mid-call: {e}")
-        if resp is None:
-            self._local.sock = None
-            raise StoreError("store connection closed mid-call")
-        if "err" in resp:
-            _raise_err(resp)
-        return resp["ok"]
+                # the pooled connection died while idle and the request
+                # never went out: reconnect and resend (always safe)
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                sock = self._local.sock = self._connect()
+                _send_frame(sock, req)
+            try:
+                resp = _recv_frame(sock)
+            except OSError as e:
+                resp, recv_err = None, e
+            else:
+                recv_err = None
+            if resp is None:
+                # the server died between send and response. Reads are
+                # idempotent — reconnect and retry once (a restarted
+                # kube-store serves them from recovered state). Writes are
+                # NOT retried: the op may have applied (same discipline as
+                # client/http._open for non-idempotent methods).
+                self._local.sock = None
+                if idempotent and attempt == 0:
+                    continue
+                raise StoreError("store connection "
+                                 + (f"failed mid-call: {recv_err}"
+                                    if recv_err else "closed mid-call"))
+            if "err" in resp:
+                _raise_err(resp)
+            return resp["ok"]
 
     # -- MemStore surface --------------------------------------------------
     @property
     def index(self) -> int:
-        return self._call({"op": "index"})
+        return self._call({"op": "index"}, idempotent=True)
 
     def get(self, key: str) -> KV:
-        return _kv_in(self._call({"op": "get", "key": key}))
+        return _kv_in(self._call({"op": "get", "key": key},
+                              idempotent=True))
 
     def get_many(self, keys: List[str]) -> List[Optional[KV]]:
         return [_kv_in(d) for d in
-                self._call({"op": "get_many", "keys": list(keys)})]
+                self._call({"op": "get_many", "keys": list(keys)},
+                           idempotent=True)]
 
     def list(self, prefix: str) -> Tuple[List[KV], int]:
-        out = self._call({"op": "list", "prefix": prefix})
+        out = self._call({"op": "list", "prefix": prefix},
+                         idempotent=True)
         return [_kv_in(d) for d in out["kvs"]], out["index"]
 
     def create(self, key: str, value: str,
