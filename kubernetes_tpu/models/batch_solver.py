@@ -52,6 +52,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -198,36 +199,102 @@ def snapshot_to_inputs(snap: ClusterSnapshot) -> SolverInputs:
                    ).astype(np.float32)                       # [A, N, V]
     zone_labeled = node_zone >= 0                             # [A, N]
 
-    return SolverInputs(
-        cap=jnp.asarray(cap.astype(rdt)),
-        advertises=jnp.asarray(snap.advertised),
-        fit_used=jnp.asarray(fit_used.astype(rdt)),
-        fit_exceeded=jnp.asarray(snap.fit_exceeded),
-        score_used=jnp.asarray(score_used.astype(rdt)),
-        node_ports=jnp.asarray(_pack_bits(snap.node_ports)),
-        node_sel=jnp.asarray(snap.node_sel),
-        node_pds=jnp.asarray(_pack_bits(snap.node_pds)),
-        node_extra_ok=jnp.asarray(snap.node_extra_ok),
-        req=jnp.asarray(req.astype(rdt)),
-        pod_ports=jnp.asarray(_pack_bits(snap.pod_ports)),
-        pod_sel=jnp.asarray(snap.pod_sel),
-        pod_pds=jnp.asarray(_pack_bits(snap.pod_pds)),
-        pod_host_idx=jnp.asarray(snap.pod_host_idx),
-        tie_hi=jnp.asarray(snap.tie_hi), tie_lo=jnp.asarray(snap.tie_lo),
-        pod_gid=jnp.asarray(snap.pod_gid),
-        pod_group_member=jnp.asarray(snap.pod_group_member),
-        group_counts=jnp.asarray(snap.group_counts),
-        gang_start=jnp.asarray(snap.pod_run_start
-                               if snap.pod_run_start is not None
-                               else np.ones(P, bool)),
-        score_static=jnp.asarray(score_static.astype(np.int32)),
-        node_aff_vals=jnp.asarray(node_aff_vals.astype(np.int32)),
-        pod_aff_static=jnp.asarray(pod_aff_static.astype(np.int32)),
-        anchor_vals0=jnp.asarray(anchor_vals0.astype(np.int32)),
-        has_anchor0=jnp.asarray(has_anchor0),
-        zone_labeled=jnp.asarray(zone_labeled),
-        zone_onehot=jnp.asarray(zone_onehot),
+    host = SolverInputs(
+        cap=cap.astype(rdt),
+        advertises=np.asarray(snap.advertised, bool),
+        fit_used=fit_used.astype(rdt),
+        fit_exceeded=np.asarray(snap.fit_exceeded, bool),
+        score_used=score_used.astype(rdt),
+        node_ports=_pack_bits(snap.node_ports),
+        node_sel=np.ascontiguousarray(snap.node_sel),
+        node_pds=_pack_bits(snap.node_pds),
+        node_extra_ok=np.asarray(snap.node_extra_ok, bool),
+        req=req.astype(rdt),
+        pod_ports=_pack_bits(snap.pod_ports),
+        pod_sel=np.ascontiguousarray(snap.pod_sel),
+        pod_pds=_pack_bits(snap.pod_pds),
+        pod_host_idx=np.ascontiguousarray(snap.pod_host_idx),
+        tie_hi=np.ascontiguousarray(snap.tie_hi),
+        tie_lo=np.ascontiguousarray(snap.tie_lo),
+        pod_gid=np.ascontiguousarray(snap.pod_gid),
+        pod_group_member=np.ascontiguousarray(snap.pod_group_member),
+        group_counts=np.ascontiguousarray(snap.group_counts),
+        gang_start=np.asarray(snap.pod_run_start
+                              if snap.pod_run_start is not None
+                              else np.ones(P, bool), bool),
+        score_static=score_static.astype(np.int32),
+        node_aff_vals=node_aff_vals.astype(np.int32),
+        pod_aff_static=pod_aff_static.astype(np.int32),
+        anchor_vals0=anchor_vals0.astype(np.int32),
+        has_anchor0=np.asarray(has_anchor0, bool),
+        zone_labeled=np.asarray(zone_labeled, bool),
+        zone_onehot=zone_onehot.astype(np.float32),
     )
+    if _pack_transfer_enabled():
+        return pack_and_ship(host)
+    return SolverInputs(*(jnp.asarray(a) for a in host))
+
+
+# -- packed transfer ---------------------------------------------------------
+# Over a tunnel-attached TPU every host->device transfer pays a fixed
+# round trip; shipping SolverInputs' ~27 arrays separately makes small
+# waves transfer-latency-bound (the `basic` bench config). Instead the
+# whole tree is packed into ONE uint8 buffer host-side (memcpy-speed),
+# shipped as a single transfer, and re-materialized on device by a tiny
+# jitted unpack program (static offsets per shape bucket; XLA bitcasts —
+# backend-independent semantics). KTPU_PACK_TRANSFER: auto (default: on
+# for non-CPU backends) | on | off.
+
+_PACK_ALIGN = 8
+
+
+def _pack_transfer_enabled() -> bool:
+    mode = os.environ.get("KTPU_PACK_TRANSFER", "auto").strip().lower()
+    if mode in ("on", "1", "true"):
+        return True
+    if mode in ("off", "0", "false"):
+        return False
+    if mode != "auto":
+        raise ValueError(
+            f"KTPU_PACK_TRANSFER={mode!r}: expected on|off|auto")
+    return jax.default_backend() != "cpu"
+
+
+def _pack_spec(host: "SolverInputs"):
+    """-> (hashable spec, total bytes). Offsets are _PACK_ALIGN-aligned."""
+    spec = []
+    off = 0
+    for a in host:
+        off = (off + _PACK_ALIGN - 1) // _PACK_ALIGN * _PACK_ALIGN
+        spec.append((str(a.dtype), tuple(a.shape), off, int(a.nbytes)))
+        off += a.nbytes
+    return tuple(spec), off
+
+
+def pack_and_ship(host: "SolverInputs") -> "SolverInputs":
+    spec, total = _pack_spec(host)
+    buf = np.zeros(total, np.uint8)
+    for a, (_, _, off, nb) in zip(host, spec):
+        buf[off:off + nb] = np.ascontiguousarray(a).view(np.uint8).reshape(-1)
+    return SolverInputs(*_unpack_device(jnp.asarray(buf), spec))
+
+
+@functools.partial(jax.jit, static_argnames=("spec",))
+def _unpack_device(buf: jnp.ndarray, spec) -> tuple:
+    out = []
+    for dtype_str, shape, off, nb in spec:
+        seg = jax.lax.slice(buf, (off,), (off + nb,))
+        dt = np.dtype(dtype_str)
+        if dt == np.bool_:
+            arr = (seg != 0).reshape(shape)
+        elif dt.itemsize == 1:
+            arr = jax.lax.bitcast_convert_type(seg, dt).reshape(shape)
+        else:
+            arr = jax.lax.bitcast_convert_type(
+                seg.reshape(-1, dt.itemsize), jnp.dtype(dtype_str)
+            ).reshape(shape)
+        out.append(arr)
+    return tuple(out)
 
 
 @functools.partial(jax.jit,
@@ -469,8 +536,6 @@ def solve_device(inp: SolverInputs, pol: Optional[BatchPolicy],
     else takes the XLA scan. ``KTPU_PALLAS``: auto (default, TPU only) |
     off | interpret (run the kernel through the Pallas interpreter — any
     backend, tests)."""
-    import os
-
     from kubernetes_tpu.ops import pallas_solver
 
     mode = os.environ.get("KTPU_PALLAS", "auto")

@@ -323,3 +323,30 @@ def test_fuzz_equivalence_r_dimensional(seed):
     existing = [rpod(f"e{i}", True) for i in range(rng.randint(0, 15))]
     pending = [rpod(f"p{i}", False) for i in range(rng.randint(1, 30))]
     assert_equivalent(nodes, existing, pending)
+
+
+def test_packed_transfer_is_bit_identical(monkeypatch):
+    """KTPU_PACK_TRANSFER=on ships the whole SolverInputs tree as ONE
+    uint8 buffer re-materialized on device by jitted bitcasts (transfer-
+    latency fix for tunnel-attached TPUs); decisions and scores must be
+    bit-identical to the per-array transfer path across dtype variety
+    (int32/int64 planes, bool masks, uint32 bitmask words, float32
+    zone one-hots)."""
+    import os
+
+    import bench
+    from kubernetes_tpu.models.batch_solver import solve
+    from kubernetes_tpu.models.snapshot import encode_snapshot
+
+    for kw in ({}, {"three_resources": True},
+               {"gang_groups": 6, "gang_size": 8}):
+        n_pods = 0 if kw.get("gang_groups") else 120
+        nodes, existing, pending, services = bench.build_cluster(
+            40, n_pods, **kw)
+        snap = encode_snapshot(nodes, existing, pending, services)
+        monkeypatch.setenv("KTPU_PACK_TRANSFER", "on")
+        cp, sp = solve(snap)
+        monkeypatch.setenv("KTPU_PACK_TRANSFER", "off")
+        cd, sd = solve(snap)
+        assert np.array_equal(np.asarray(cp), np.asarray(cd)), kw
+        assert np.array_equal(np.asarray(sp), np.asarray(sd)), kw
