@@ -115,11 +115,16 @@ class LogAggregator:
         """One tail pass over every running container; returns new lines."""
         nodes = {n.metadata.name: n for n in self.node_store.list()}
         new_lines = 0
+        live_keys = set()
         for pod in self.pod_store.list():
+            ns = pod.metadata.namespace or "default"
+            # a pod is "live" whether or not its node currently resolves —
+            # a node-store flap must not reset offsets (duplicate ingestion)
+            for c in pod.spec.containers:
+                live_keys.add((ns, pod.metadata.name, c.name))
             node = nodes.get(pod.status.host or pod.spec.host)
             if node is None:
                 continue
-            ns = pod.metadata.namespace or "default"
             for c in pod.spec.containers:
                 key = (ns, pod.metadata.name, c.name)
                 text = self.fetch(node, ns, pod.metadata.name, c.name)
@@ -143,6 +148,10 @@ class LogAggregator:
                             "node": node.metadata.name, "line": line})
                         new_lines += 1
                 self.metric_lines.inc(ns, by=len(lines))
+        # prune offsets of deleted pods so churn doesn't grow the dict forever
+        for key in list(self._offsets):
+            if key not in live_keys:
+                del self._offsets[key]
         return new_lines
 
     def _collect_loop(self) -> None:

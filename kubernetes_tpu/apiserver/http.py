@@ -151,6 +151,7 @@ class _Handler(BaseHTTPRequestHandler):
         self.command, self.path, self.request_version = command, path, version
 
         headers: dict = {}
+        n_lines = 0
         while True:
             line = self.rfile.readline(65537)
             if len(line) > 65536:
@@ -158,24 +159,47 @@ class _Handler(BaseHTTPRequestHandler):
                 return False
             if line in (b"\r\n", b"\n", b""):
                 break
-            if len(headers) >= 200:
-                self.send_error(431, "Too many headers")
+            n_lines += 1
+            if n_lines > 200:  # bound header LINES, not dict entries —
+                self.send_error(431, "Too many headers")  # joins don't grow it
                 return False
             name, sep, value = line.decode("iso-8859-1").partition(":")
             if not sep:
                 self.send_error(400, "Malformed header line")
                 return False
             name = name.strip()
-            headers[name.lower()] = (name, value.strip())
+            lname = name.lower()
+            prev = headers.get(lname)
+            if prev is None:
+                headers[lname] = (name, value.strip())
+            elif lname == "content-length":
+                # RFC 7230 §3.3.2: repeats must be identical; a joined value
+                # would fail int() later, so reject differing repeats here
+                if value.strip() != prev[1]:
+                    self.send_error(400, "Conflicting Content-Length")
+                    return False
+            else:  # RFC 7230 §3.2.2: join repeats with ", "
+                headers[lname] = (prev[0], prev[1] + ", " + value.strip())
         self.headers = _FastHeaders(headers)
 
-        conntype = (self.headers.get("Connection") or "").lower()
-        if conntype == "close":
+        # bodies are framed by Content-Length only; a chunked body would be
+        # left unread in rfile and desync the kept-alive stream (CL.TE
+        # smuggling, RFC 7230 §3.3.3) — refuse rather than desync
+        te = headers.get("transfer-encoding")
+        if te is not None and te[1].strip().lower() not in ("", "identity"):
+            self.send_error(501, "Transfer-Encoding not supported")
+            return False
+
+        conntokens = [t.strip() for t in
+                      (self.headers.get("Connection") or "").lower().split(",")]
+        if "close" in conntokens:
             self.close_connection = True
-        elif version >= "HTTP/1.1" or (conntype == "keep-alive"
+        elif version >= "HTTP/1.1" or ("keep-alive" in conntokens
                                        and self.protocol_version >= "HTTP/1.1"):
             self.close_connection = False
-        if (self.headers.get("Expect", "").lower() == "100-continue"
+        expect = [t.strip() for t in
+                  self.headers.get("Expect", "").lower().split(",")]
+        if ("100-continue" in expect
                 and self.protocol_version >= "HTTP/1.1"
                 and version >= "HTTP/1.1"):
             if not self.handle_expect_100():
@@ -722,8 +746,12 @@ class APIServer:
         try:
             obj_wire = self.scheme.encode_to_wire(ev.object, version)
         except Exception:
-            obj_wire = {"kind": "Status", "status": "Failure",
-                        "message": "encode error"}
+            # never cache the fallback: a transient encode failure must not
+            # poison this revision for later watchers
+            return json.dumps({"type": ev.type,
+                               "object": {"kind": "Status",
+                                          "status": "Failure",
+                                          "message": "encode error"}})
         frame = json.dumps({"type": ev.type, "object": obj_wire})
         if key is not None:
             with self._frame_lock:

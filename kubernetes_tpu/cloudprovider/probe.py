@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import json
 import subprocess
+import threading
 import time
 from typing import Dict, List, Optional
 
@@ -67,10 +68,15 @@ class ProbeCloud(Interface):
         self._snapshot: Optional[_Snapshot] = None
         self._clusters: Optional[_ClustersView] = None
         self._fetched_at: float = -1.0
+        self._refresh_lock = threading.Lock()
         self._refresh()
 
     # -- probing -----------------------------------------------------------
     def _refresh(self) -> None:
+        with self._refresh_lock:
+            self._refresh_locked()
+
+    def _refresh_locked(self) -> None:
         now = self._clock()
         if self._fetched_at >= 0 and now - self._fetched_at < self.ttl_s:
             return
@@ -98,9 +104,10 @@ class ProbeCloud(Interface):
                 dict(clusters.get("masters", {})))
         except (OSError, subprocess.SubprocessError, ValueError, KeyError,
                 AttributeError, TypeError):
-            # keep the previous snapshot; retry on the next access past TTL
-            if self._snapshot is not None:
-                self._fetched_at = now
+            # keep the previous snapshot; retry on the next access past TTL.
+            # Record the attempt time even before any success so a dead probe
+            # command costs one subprocess per TTL window, not per call.
+            self._fetched_at = now
             return
         self._snapshot = snapshot
         self._clusters = clusters_view

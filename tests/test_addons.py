@@ -172,6 +172,39 @@ def test_dns_suffix_is_label_bounded(cluster_client):
         dns.stop()
 
 
+def test_logging_offsets_pruned_on_pod_delete_kept_on_node_flap():
+    """Churn hygiene: the per-container byte offsets must be dropped when
+    the pod is deleted (else the dict grows forever under churn) but kept
+    when only the NODE store flaps (else the whole log re-ingests)."""
+    from kubernetes_tpu.addons.logging import LogAggregator
+
+    agg = LogAggregator(client=None, fetch=lambda *a: "one\ntwo\n",
+                        period_s=999)
+    try:
+        node = api.Node(metadata=api.ObjectMeta(name="n1"))
+        pod = api.Pod(
+            metadata=api.ObjectMeta(name="p1", namespace="default"),
+            spec=api.PodSpec(host="n1", containers=[
+                api.Container(name="c", image="img")]))
+        agg.node_store.replace([node])
+        agg.pod_store.replace([pod])
+        assert agg.collect_once() == 2
+        assert ("default", "p1", "c") in agg._offsets
+        # node-store flap: pod still listed, node briefly unresolvable —
+        # offsets survive, and nothing re-ingests when the node returns
+        agg.node_store.replace([])
+        agg.collect_once()
+        assert ("default", "p1", "c") in agg._offsets
+        agg.node_store.replace([node])
+        assert agg.collect_once() == 0  # no duplicate ingestion
+        # pod deleted: offsets pruned
+        agg.pod_store.replace([])
+        agg.collect_once()
+        assert agg._offsets == {}
+    finally:
+        agg._httpd.server_close()
+
+
 def test_logging_addon_collects_and_queries_container_logs():
     """The fluentd-elasticsearch analog: tail container logs through each
     kubelet's /containerLogs, store centrally, query over HTTP

@@ -274,6 +274,102 @@ class TestUnversionedEndpoints:
         assert got.metadata.name == "beta"
 
 
+class TestHeaderParsing:
+    """RFC 7230 semantics of the fast request parser: repeated fields
+    join with ", " (§3.2.2), conflicting Content-Length repeats are
+    rejected (§3.3.2), Connection is matched as a token list."""
+
+    def raw(self, server, request: bytes) -> bytes:
+        import socket as socketlib
+        s = socketlib.create_connection(("127.0.0.1", server.port), timeout=5)
+        try:
+            s.sendall(request)
+            s.shutdown(socketlib.SHUT_WR)
+            chunks = []
+            while True:
+                b = s.recv(65536)
+                if not b:
+                    break
+                chunks.append(b)
+            return b"".join(chunks)
+        finally:
+            s.close()
+
+    def parse(self, raw: bytes):
+        """Drive _Handler.parse_request over in-memory pipes; returns the
+        parsed handler (inspect .headers) — or the error response bytes."""
+        import io
+        from kubernetes_tpu.apiserver.http import _Handler
+        h = object.__new__(_Handler)
+        h.rfile = io.BytesIO(raw)
+        h.wfile = io.BytesIO()
+        h.client_address = ("127.0.0.1", 0)
+        h.server = None
+        h.requestline = ""
+        h.raw_requestline = h.rfile.readline()
+        ok = h.parse_request()
+        return h if ok else h.wfile.getvalue()
+
+    def test_repeated_headers_join(self, server):
+        # two X-Forwarded-For lines must BOTH survive, joined per §3.2.2
+        # (a last-wins parser would drop the first)
+        h = self.parse(b"GET / HTTP/1.1\r\nHost: h\r\n"
+                       b"X-Forwarded-For: 1.1.1.1\r\n"
+                       b"X-Forwarded-For: 2.2.2.2\r\n\r\n")
+        assert h.headers.get("X-Forwarded-For") == "1.1.1.1, 2.2.2.2"
+        # and the live server still serves such a request
+        resp = self.raw(server,
+                        b"GET / HTTP/1.1\r\nHost: h\r\n"
+                        b"X-Forwarded-For: 1.1.1.1\r\n"
+                        b"X-Forwarded-For: 2.2.2.2\r\n"
+                        b"Connection: close\r\n\r\n")
+        assert resp.startswith(b"HTTP/1.1 200")
+
+    def test_expect_tokens_no_space(self, server):
+        # "100-continue,ext" (no space after comma) must still trigger
+        # the 100 Continue path; parse alone proves token recognition
+        h = self.parse(b"POST /x HTTP/1.0\r\nHost: h\r\n"
+                       b"Expect: 100-continue,ext\r\n\r\n")
+        # HTTP/1.0 request: no 100-continue sent, but parse must succeed
+        assert h.headers.get("Expect") == "100-continue,ext"
+
+    def test_chunked_transfer_encoding_501(self, server):
+        resp = self.raw(server,
+                        b"POST /api/v1/namespaces/default/pods HTTP/1.1\r\n"
+                        b"Host: h\r\nTransfer-Encoding: chunked\r\n\r\n"
+                        b"5\r\nhello\r\n0\r\n\r\n")
+        assert resp.startswith(b"HTTP/1.1 501")
+
+    def test_conflicting_content_length_400(self, server):
+        resp = self.raw(server,
+                        b"POST /api/v1/namespaces/default/pods HTTP/1.1\r\n"
+                        b"Host: h\r\nContent-Length: 2\r\n"
+                        b"Content-Length: 5\r\nConnection: close\r\n\r\n{}abc")
+        assert resp.startswith(b"HTTP/1.1 400")
+
+    def test_identical_content_length_repeat_ok(self, server):
+        resp = self.raw(server,
+                        b"GET /healthz HTTP/1.1\r\nHost: h\r\n"
+                        b"Content-Length: 0\r\nContent-Length: 0\r\n"
+                        b"Connection: close\r\n\r\n")
+        assert resp.startswith(b"HTTP/1.1 200")
+
+    def test_connection_close_among_tokens(self, server):
+        # "keep-alive, close" must be honored as close: the server must
+        # finish the response and EOF rather than hold the socket open
+        resp = self.raw(server,
+                        b"GET /healthz HTTP/1.1\r\nHost: h\r\n"
+                        b"Connection: keep-alive, close\r\n\r\n")
+        assert resp.startswith(b"HTTP/1.1 200") and resp.endswith(b"ok")
+
+    def test_many_repeated_headers_431(self, server):
+        lines = b"".join(b"X-A: spam\r\n" for _ in range(250))
+        resp = self.raw(server,
+                        b"GET /healthz HTTP/1.1\r\nHost: h\r\n" + lines +
+                        b"\r\n")
+        assert resp.startswith(b"HTTP/1.1 431")
+
+
 class TestAuth:
     def make_server(self, authorizer=None, authenticator=None):
         m = Master(MasterConfig(authorizer=authorizer))
