@@ -473,41 +473,67 @@ def build_cluster(n_nodes: int, n_pods: int, n_services: int = 8,
 
 
 def timed_wave(nodes, existing, pending, services, batch_policy=None,
-               profile=None, runs: int = 5):
+               profile=None, runs: int = 30):
     """One honest scheduling wave, measured at steady state: every timed
     run performs the FULL pipeline — snapshot encode (numpy), host->device
     transfer, solve, decision readback (+ gang post-pass) — inside the
-    clock; the reported wave is the median run. One untimed warmup pass
-    first pays the per-shape costs a live scheduler pays once and then
-    never again: XLA compilation and the transfer path's per-shape setup
-    (the axon tunnel spends ~1.5s the first time it ships a given shape
-    set and ~10ms thereafter; pow-2 bucketing keeps the shape set finite,
-    which the churn config proves end-to-end). Both one-time costs are
-    logged. Returns a result dict and the decisions from the last run."""
+    clock; the reported wave is the median run and the record carries the
+    full per-run distribution (p50/p95/p99/max over >=30 runs — BASELINE's
+    metric is pods/s + p99 latency, ref: docs/roadmap.md:61). One untimed
+    warmup pass first pays the per-shape costs a live scheduler pays once
+    and then never again: XLA compilation and the transfer path's
+    per-shape setup (the axon tunnel spends ~1.5s the first time it ships
+    a given shape set and ~10ms thereafter; pow-2 bucketing keeps the
+    shape set finite, which the churn config proves end-to-end). Both
+    one-time costs are logged. Small waves route through the measured
+    host-vs-device dispatch (batch_solver.WaveRouter); the chosen path
+    and both calibration times land in the record. Returns a result dict
+    and the decisions from the last run."""
     import jax
     import numpy as np
 
     from kubernetes_tpu.models import gang as gang_mod
     from kubernetes_tpu.models.batch_solver import (
+        default_router,
         peer_bound_of,
-        snapshot_to_inputs,
+        ship_inputs,
+        snapshot_to_host_inputs,
         solve_device,
     )
     from kubernetes_tpu.models.snapshot import encode_snapshot
 
-    # -- untimed warmup: compile + transfer-shape setup ---------------------
+    # -- untimed warmup: router calibration + compile + shape setup ---------
     snap = encode_snapshot(nodes, existing, pending, services,
                            policy=batch_policy)
     gangs = snap.has_gangs
     peer_bound = peer_bound_of(snap)
+    host = snapshot_to_host_inputs(snap)
     t0 = time.perf_counter()
-    inp = snapshot_to_inputs(snap)
-    jax.block_until_ready(inp)
-    shape_setup_s = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    out = solve_device(inp, snap.policy, gangs, peer_bound)
-    jax.block_until_ready(out)
-    compile_s = time.perf_counter() - t0
+    plan = default_router.plan_for(host, snap.policy, gangs, peer_bound)
+    router_s = time.perf_counter() - t0
+    force_scan = plan.device is not None
+    calibrated = plan.host_s == plan.host_s  # not nan
+    if plan.path == "host":
+        log(f"[router] host CPU wins this shape: host {plan.host_s:.4f}s "
+            f"vs device {plan.device_s:.4f}s (calibrated in {router_s:.1f}s)")
+    if calibrated:
+        # calibration already paid the one-time costs (both backends
+        # compiled inside plan_for) — report the chosen path's COLD first
+        # pipeline so compile_s stays comparable across rounds instead of
+        # silently becoming a warm-cache number; the full calibration
+        # bill is router_cal_s in the record
+        shape_setup_s = 0.0
+        compile_s = plan.cold_s
+    else:
+        t0 = time.perf_counter()
+        inp = ship_inputs(host, plan.device)
+        jax.block_until_ready(inp)
+        shape_setup_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        out = solve_device(inp, snap.policy, gangs, peer_bound,
+                           force_scan=force_scan)
+        jax.block_until_ready(out)
+        compile_s = time.perf_counter() - t0
 
     # -- timed steady-state runs: the whole pipeline in the clock -----------
     if profile:
@@ -523,8 +549,9 @@ def timed_wave(nodes, existing, pending, services, batch_policy=None,
         # uploads into the device call (one tunnel round-trip per wave
         # instead of two — exactly what a live scheduler does); the
         # decision readback is the sync
-        inp = snapshot_to_inputs(snap)      # jnp.asarray = host->device
-        chosen, scores = solve_device(inp, snap.policy, gangs, peer_bound)
+        inp = ship_inputs(snapshot_to_host_inputs(snap), plan.device)
+        chosen, scores = solve_device(inp, snap.policy, gangs, peer_bound,
+                                      force_scan=force_scan)
         chosen_np = np.asarray(chosen)      # device->host readback (sync)
         if gangs:
             chosen_np = gang_mod.apply_all_or_nothing(snap.pod_rid, chosen_np)
@@ -535,10 +562,16 @@ def timed_wave(nodes, existing, pending, services, batch_policy=None,
         jax.profiler.stop_trace()
         log(f"jax.profiler trace written to {profile}")
 
+    srt = sorted(wave_runs)
+    p50, p95, p99 = (float(v) for v in
+                     np.percentile(wave_runs, [50.0, 95.0, 99.0]))
     # the median RUN (upper middle for even counts): wave_s and its
     # component breakdown come from the same run, so the parts sum to it
-    wave_med = sorted(wave_runs)[len(wave_runs) // 2]
+    wave_med = srt[len(srt) // 2]
     encode_s, device_s = parts[wave_runs.index(wave_med)]
+    for i, w in enumerate(wave_runs):       # tail forensics in the log
+        if w > 2 * wave_med:
+            log(f"[tail] run {i}/{runs}: {w:.3f}s (median {wave_med:.3f}s)")
     n = len(pending)
     res = {
         "pods": n,
@@ -546,14 +579,24 @@ def timed_wave(nodes, existing, pending, services, batch_policy=None,
         "value": round(n / wave_med, 1),
         "unit": "pods/s",
         "wave_s": round(wave_med, 4),
-        "wave_s_min": round(min(wave_runs), 4),
-        "wave_s_max": round(max(wave_runs), 4),
+        "wave_s_min": round(srt[0], 4),
+        "wave_s_max": round(srt[-1], 4),
+        "wave_s_p50": round(p50, 4),
+        "wave_s_p95": round(p95, 4),
+        "wave_s_p99": round(p99, 4),
+        "runs": runs,
+        "runs_s": [round(w, 4) for w in wave_runs],
+        "path": plan.path,
         "encode_s": round(encode_s, 4),
         "device_s": round(device_s, 4),
         "compile_s": round(compile_s, 3),
         "shape_setup_s": round(shape_setup_s, 3),
         "scheduled": int((chosen_np[:n] >= 0).sum()),
     }
+    if calibrated:
+        res["router_host_s"] = round(plan.host_s, 4)
+        res["router_device_s"] = round(plan.device_s, 4)
+        res["router_cal_s"] = round(router_s, 2)
     return res, snap, chosen_np
 
 
@@ -581,7 +624,7 @@ def check_equivalence(tag, snap, chosen_np, nodes, existing, pending,
 def run_solver_config(tag, n_nodes, n_pods, gate_nodes=0, gate_pods=0,
                      policy=None, three_resources=False, gang_groups=0,
                      gang_size=8, profile=None, full_gate=False,
-                     gate_budget_s=75.0):
+                     gate_budget_s=75.0, runs=30):
     """Benchmark one solver-path config. Gate variants: full_gate runs the
     serial oracle over the whole wave; gate_pods/gate_nodes take a fixed
     slice; gate_pods=0 with gate_nodes=0 sizes the pod slice to
@@ -602,7 +645,7 @@ def run_solver_config(tag, n_nodes, n_pods, gate_nodes=0, gate_pods=0,
     batch_policy = batch_policy_from(policy=policy) if policy else None
     res, snap, chosen_np = timed_wave(nodes, existing, pending, services,
                                       batch_policy=batch_policy,
-                                      profile=profile)
+                                      profile=profile, runs=runs)
 
     if full_gate:
         g_nodes, g_exist, g_pend = nodes, existing, pending
@@ -665,8 +708,10 @@ def run_solver_config(tag, n_nodes, n_pods, gate_nodes=0, gate_pods=0,
         log(f"[{tag}] all-or-nothing invariant OK: "
             f"{placed}/{gang_groups} groups fully placed")
 
-    log(f"[{tag}] wave {res['wave_s']:.3f}s (min {res['wave_s_min']:.3f} "
-        f"max {res['wave_s_max']:.3f}) = encode {res['encode_s']:.3f} "
+    log(f"[{tag}] wave {res['wave_s']:.3f}s over {res['runs']} runs "
+        f"(p95 {res['wave_s_p95']:.3f} p99 {res['wave_s_p99']:.3f} "
+        f"max {res['wave_s_max']:.3f}; path={res['path']}) "
+        f"= encode {res['encode_s']:.3f} "
         f"+ device(transfer+solve+readback) {res['device_s']:.4f}; "
         f"{res['value']:.0f} pods/s; "
         f"scheduled {res['scheduled']}/{res['pods']}")
@@ -838,6 +883,9 @@ def _child_parser() -> argparse.ArgumentParser:
     ap.add_argument("--profile", default=None, metavar="DIR",
                     help="capture a jax.profiler trace of the north-star "
                          "solve into DIR")
+    ap.add_argument("--runs", type=int, default=None,
+                    help="timed steady-state waves per config (default: 30 "
+                         "on TPU, 12 on the CPU fallback, 5 for --smoke)")
     return ap
 
 
@@ -848,6 +896,16 @@ def child(argv) -> int:
 
     if args.smoke or args.cpu:
         jax.config.update("jax_platforms", "cpu")
+    else:
+        # expose the host CPU backend BESIDE the accelerator (first platform
+        # stays the default) so the wave router can run dispatch-bound waves
+        # on the host — see models/batch_solver.WaveRouter
+        plats = os.environ.get("JAX_PLATFORMS", "")
+        if plats and "cpu" not in plats.split(","):
+            try:
+                jax.config.update("jax_platforms", plats + ",cpu")
+            except Exception as e:  # never let the router cost the capture
+                log(f"[bench] cpu-beside-accelerator unavailable: {e}")
 
     # Fail fast if the backend is unreachable OR WEDGED: a dead TPU tunnel
     # makes backend init hang forever (not raise), which would burn the
@@ -859,6 +917,7 @@ def child(argv) -> int:
     log(f"backend={backend} devices={devices}")
 
     s = args.smoke
+    runs = args.runs or (5 if s else 12 if args.cpu else 30)
     known = {"north_star", "basic", "affinity", "binpack3", "gang", "churn"}
     want = set(args.configs.split(",")) if args.configs != "all" else known
     unknown = want - known
@@ -930,25 +989,26 @@ def child(argv) -> int:
     run("north_star", run_solver_config,
         args.nodes or (100 if s else ns_nodes),
         args.pods or (500 if s else ns_pods),
-        full_gate=s, profile=args.profile)
+        full_gate=s, profile=args.profile, runs=runs)
     b_nodes, b_pods, _ = FULL_SHAPES["basic"]
     run("basic", run_solver_config,
-        50 if s else b_nodes, 100 if s else b_pods, full_gate=True)
+        50 if s else b_nodes, 100 if s else b_pods, full_gate=True,
+        runs=runs)
     a_nodes, a_pods, _ = FULL_SHAPES["affinity"]
     run("affinity", run_solver_config,
         100 if s else a_nodes, 200 if s else a_pods,
         gate_nodes=100 if s else 600, gate_pods=200 if s else 600,
-        policy=aff_policy)
+        policy=aff_policy, runs=runs)
     p3_nodes, p3_pods, p3_kw = FULL_SHAPES["binpack3"]
     run("binpack3", run_solver_config,
         100 if s else p3_nodes, 300 if s else p3_pods,
         gate_nodes=100 if s else 600, gate_pods=300 if s else 600,
-        **p3_kw)
+        runs=runs, **p3_kw)
     g_nodes, g_pods, g_kw = FULL_SHAPES["gang"]
     run("gang", run_solver_config,
         100 if s else g_nodes, g_pods,
         gate_nodes=50 if s else 200, gate_pods=160 if s else 400,
-        **({"gang_groups": 20, "gang_size": 8} if s else g_kw))
+        runs=runs, **({"gang_groups": 20, "gang_size": 8} if s else g_kw))
     run("churn", run_churn_config,
         20 if s else 500, 300 if s else 4_000,
         rate_pods_per_s=300 if s else 1_000)

@@ -350,3 +350,75 @@ def test_packed_transfer_is_bit_identical(monkeypatch):
         cd, sd = solve(snap)
         assert np.array_equal(np.asarray(cp), np.asarray(cd)), kw
         assert np.array_equal(np.asarray(sp), np.asarray(sd)), kw
+
+
+# -- host-vs-device wave router ---------------------------------------------
+
+class TestWaveRouter:
+    """The measured small-wave dispatch (batch_solver.WaveRouter). On the
+    CPU-only test backend there is no second device, so auto mode must
+    degrade to the device plan; the calibration machinery is exercised by
+    pointing the router's CPU seam at the default device."""
+
+    def _host(self):
+        from kubernetes_tpu.models.batch_solver import (
+            peer_bound_of, snapshot_to_host_inputs)
+        nodes = [mk_node(f"n{i}") for i in range(8)]
+        pending = [mk_pod(f"p{i}", cpu_m=100) for i in range(16)]
+        snap = encode_snapshot(nodes, [], pending, [])
+        return (snap, snapshot_to_host_inputs(snap), snap.policy,
+                snap.has_gangs, peer_bound_of(snap))
+
+    def test_auto_without_second_backend_is_device(self, monkeypatch):
+        from kubernetes_tpu.models import batch_solver as bs
+        monkeypatch.setenv("KTPU_WAVE_ROUTER", "auto")
+        _, host, pol, gangs, pb = self._host()
+        plan = bs.WaveRouter().plan_for(host, pol, gangs, pb)
+        assert plan.path == "device" and plan.device is None
+
+    def test_off_and_bad_mode(self, monkeypatch):
+        from kubernetes_tpu.models import batch_solver as bs
+        _, host, pol, gangs, pb = self._host()
+        monkeypatch.setenv("KTPU_WAVE_ROUTER", "off")
+        assert bs.WaveRouter().plan_for(host, pol, gangs, pb).path == "device"
+        monkeypatch.setenv("KTPU_WAVE_ROUTER", "bogus")
+        monkeypatch.setattr(bs, "_host_cpu_device",
+                            lambda: __import__("jax").devices()[0])
+        with pytest.raises(ValueError):
+            bs.WaveRouter().plan_for(host, pol, gangs, pb)
+
+    def test_calibration_measures_both_and_caches(self, monkeypatch):
+        import jax
+
+        from kubernetes_tpu.models import batch_solver as bs
+        monkeypatch.setenv("KTPU_WAVE_ROUTER", "auto")
+        monkeypatch.setattr(bs, "_host_cpu_device",
+                            lambda: jax.devices()[0])
+        router = bs.WaveRouter()
+        snap, host, pol, gangs, pb = self._host()
+        plan = router.plan_for(host, pol, gangs, pb)
+        assert plan.path in ("host", "device")
+        assert plan.host_s == plan.host_s          # calibration ran
+        assert plan.device_s == plan.device_s
+        assert router.plan_for(host, pol, gangs, pb) is plan  # cached
+        # decisions via the routed pipeline match the serial oracle
+        inp = bs.ship_inputs(host, plan.device)
+        chosen, _ = bs.solve_device(inp, pol, gangs, pb,
+                                    force_scan=plan.device is not None)
+        nodes = [mk_node(f"n{i}") for i in range(8)]
+        pending = [mk_pod(f"p{i}", cpu_m=100) for i in range(16)]
+        assert decisions_to_names(snap, np.asarray(chosen)) == \
+            solve_serial(nodes, [], pending, [])
+
+    def test_big_wave_skips_host_calibration(self, monkeypatch):
+        import jax
+
+        from kubernetes_tpu.models import batch_solver as bs
+        monkeypatch.setenv("KTPU_WAVE_ROUTER", "auto")
+        monkeypatch.setattr(bs, "_host_cpu_device",
+                            lambda: jax.devices()[0])
+        monkeypatch.setattr(bs, "_ROUTER_MAX_HOST_CELLS", 4)
+        _, host, pol, gangs, pb = self._host()
+        plan = bs.WaveRouter().plan_for(host, pol, gangs, pb)
+        assert plan.path == "device"
+        assert plan.host_s != plan.host_s          # no calibration paid
