@@ -175,7 +175,16 @@ class Quantity:
     def __str__(self) -> str:
         cached = getattr(self, "_str_cache", None)
         if cached is None:
-            cached = _format(self.value, self.format)
+            # global memo too: decode creates a fresh instance per object
+            # (so the per-instance cache starts cold every time), yet the
+            # wire value vocabulary under churn is a handful of strings
+            fk = (self.value, self.format)
+            cached = _FORMAT_CACHE.get(fk)
+            if cached is None:
+                cached = _format(self.value, self.format)
+                if len(_FORMAT_CACHE) >= _PARSE_CACHE_MAX:
+                    _FORMAT_CACHE.clear()
+                _FORMAT_CACHE[fk] = cached
             object.__setattr__(self, "_str_cache", cached)
         return cached
 
@@ -190,6 +199,8 @@ class Quantity:
 # clear (the working set is tiny; eviction order is irrelevant).
 _PARSE_CACHE: dict = {}
 _PARSE_CACHE_MAX = 4096
+# (Fraction value, fmt) -> wire string; same bounding discipline
+_FORMAT_CACHE: dict = {}
 
 
 def _parse(s: str):

@@ -270,7 +270,12 @@ class _Handler(BaseHTTPRequestHandler):
         # handlers use the single-value view; the node/pod proxy forwards
         # the raw pairs so repeated params (exec argv) survive
         self._raw_query_pairs = urllib.parse.parse_qsl(parsed.query)
-        query = {k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()}
+        # first-value view from the pairs already parsed (the stdlib
+        # parse_qs would re-parse the query string a second time)
+        query: dict = {}
+        for k, v in self._raw_query_pairs:
+            if k not in query:
+                query[k] = v
         parts = [p for p in parsed.path.split("/") if p]
         code = 200
         verb_label = method.lower()

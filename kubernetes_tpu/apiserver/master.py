@@ -96,6 +96,17 @@ class Master:
             ("resourcequotas", "status"): self.quota_status,
         }
 
+        # decode-time selfLink stamping: with the store's shared-read
+        # contract (storage/helper.py), cached objects must be born
+        # complete — a post-read stamp would make watch frames and list
+        # responses order-dependent on whether a GET ran first
+        for res_name, registry in self.storage.items():
+            prefix = getattr(registry, "prefix", None)
+            if prefix is None:
+                continue  # subresource REST (bindings): no storage of its own
+            self.helper.register_linker(
+                prefix, self._make_linker(res_name, registry))
+
         self.admission = admission_pkg.new_from_plugins(
             list(c.admission_control),
             namespaces=self.namespaces,
@@ -113,6 +124,13 @@ class Master:
                 raise
 
     # ------------------------------------------------------------------
+    def _make_linker(self, resource: str, registry):
+        def link(obj) -> None:
+            m = getattr(obj, "metadata", None)
+            if isinstance(m, api.ObjectMeta):
+                m.self_link = self._self_link(resource, obj)
+        return link
+
     def _self_link(self, resource: str, obj) -> str:
         """ref: resthandler.go setSelfLink — /api/<v>/namespaces/<ns>/<res>/<name>
         for namespaced resources, /api/<v>/<res>/<name> cluster-scoped."""
@@ -130,9 +148,12 @@ class Master:
         items = getattr(obj, "items", None)
         if items is not None:
             for item in items:
-                # result kinds (e.g. BindingResult) carry no ObjectMeta
-                if isinstance(getattr(item, "metadata", None), api.ObjectMeta):
-                    item.metadata.self_link = self._self_link(resource, item)
+                # result kinds (e.g. BindingResult) carry no ObjectMeta;
+                # storage reads arrive pre-stamped by the decode-time
+                # linker — never re-write a shared cached object here
+                m = getattr(item, "metadata", None)
+                if isinstance(m, api.ObjectMeta) and not m.self_link:
+                    m.self_link = self._self_link(resource, item)
             version = getattr(self.scheme, "version", "v1")
             if self.mapper.is_namespaced(resource) and namespace:
                 obj.metadata.self_link = \
@@ -140,7 +161,8 @@ class Master:
             else:
                 obj.metadata.self_link = f"/api/{version}/{resource}"
         elif hasattr(obj, "metadata") and isinstance(obj.metadata, api.ObjectMeta):
-            obj.metadata.self_link = self._self_link(resource, obj)
+            if not obj.metadata.self_link:
+                obj.metadata.self_link = self._self_link(resource, obj)
         return obj
 
     def _registry(self, resource: str):

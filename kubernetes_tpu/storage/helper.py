@@ -44,10 +44,23 @@ class StoreHelper:
     # (key, modified_index) -> decoded object. A stored revision is
     # immutable, so its decode is too: lists re-reading a stable cluster
     # and watch pumps fanning one event out to several watchers hit the
-    # cache and pay a deep_clone (~19us) instead of a full codec decode
-    # (~170us) — the difference between 250 and 1000 pods/s of churn
-    # through the live stack. Bounded FIFO; isolation semantics unchanged
-    # (every caller still gets its own copy).
+    # cache and pay a dict lookup instead of a full codec decode (~170us)
+    # — the difference between 250 and 1000 pods/s of churn through the
+    # live stack. Bounded FIFO.
+    #
+    # READ-SHARING CONTRACT: list and watch return the CACHED objects
+    # themselves, not copies (the per-read deep_clone was ~13 clones per
+    # churned pod — the single largest per-pod CPU item). Safe because
+    # bulk/stream consumers only enumerate or encode: the HTTP path
+    # serializes to wire bytes, the in-process transport deep-clones both
+    # directions (client/client.py InProcessTransport._copy), and
+    # controllers build fresh objects from what they read. The only
+    # in-tree mutation of a served bulk read is master._stamp_self_links,
+    # which writes the same deterministic string every time (idempotent).
+    # SINGLE-object reads (extract_obj/delete_obj) stay isolated: the
+    # get-mutate-set idiom is legitimate there and they are off the churn
+    # hot path. atomic_update isolates before calling update_fn; the
+    # DELETED-event resourceVersion rewrite clones explicitly.
     _DECODE_CACHE_MAX = 8192
 
     def __init__(self, store: MemStore, scheme):
@@ -55,20 +68,35 @@ class StoreHelper:
         self.scheme = scheme
         self._decode_cache: "OrderedDict" = OrderedDict()
         self._decode_lock = threading.Lock()
+        self._linkers: list = []  # (key prefix, decorate_fn)
+
+    def register_linker(self, prefix: str, fn) -> None:
+        """Register a decorator run ONCE per cached revision at decode time
+        (the master registers selfLink stamping per resource prefix). With
+        shared reads, decoration must happen before the object becomes
+        visible — a post-read stamp would mutate an object other readers
+        (watch pumps, concurrent lists) already see, making wire output
+        order-dependent."""
+        self._linkers.append((prefix if prefix.endswith("/") else prefix + "/",
+                              fn))
 
     # -- encode/decode ------------------------------------------------------
-    def _decode(self, kv) -> Any:
+    def _decode(self, kv, isolate: bool = False) -> Any:
         ck = (kv.key, kv.modified_index)
         with self._decode_lock:
             cached = self._decode_cache.get(ck)
         if cached is None:
             cached = self.scheme.decode(kv.value)
             accessor.set_resource_version(cached, str(kv.modified_index))
+            for prefix, fn in self._linkers:
+                if kv.key.startswith(prefix):
+                    fn(cached)
+                    break
             with self._decode_lock:
                 self._decode_cache[ck] = cached
                 while len(self._decode_cache) > self._DECODE_CACHE_MAX:
                     self._decode_cache.popitem(last=False)
-        return deep_clone(cached)
+        return deep_clone(cached) if isolate else cached
 
     def _encode(self, obj) -> str:
         # resourceVersion is storage metadata, not payload: clear before
@@ -87,9 +115,11 @@ class StoreHelper:
             kv = self.store.create(key, self._encode(obj), ttl=ttl)
         except ErrKeyExists:
             raise errors.new_already_exists(accessor.kind(obj), accessor.name(obj))
-        out = deep_clone(obj)  # isolation copy; codec runs in _encode
-        accessor.set_resource_version(out, str(kv.modified_index))
-        return out
+        # decorate the caller's object in place, like the reference
+        # (etcd_helper.go CreateObj leaves the passed runtime.Object as
+        # the result); nothing stored aliases it — the store holds bytes
+        accessor.set_resource_version(obj, str(kv.modified_index))
+        return obj
 
     def set_obj(self, key: str, obj: Any, ttl: Optional[float] = None) -> Any:
         """Write; CAS on the object's resourceVersion when set
@@ -104,9 +134,8 @@ class StoreHelper:
             raise errors.new_conflict(accessor.kind(obj), accessor.name(obj))
         except ErrKeyNotFound:
             raise errors.new_not_found(accessor.kind(obj), accessor.name(obj))
-        out = deep_clone(obj)  # isolation copy; codec runs in _encode
-        accessor.set_resource_version(out, str(kv.modified_index))
-        return out
+        accessor.set_resource_version(obj, str(kv.modified_index))
+        return obj
 
     def extract_obj(self, key: str, kind: str = "", name: str = "") -> Any:
         """ref: etcd_helper.go:144 ExtractObj."""
@@ -114,7 +143,7 @@ class StoreHelper:
             kv = self.store.get(key)
         except ErrKeyNotFound:
             raise errors.new_not_found(kind or "resource", name or key)
-        return self._decode(kv)
+        return self._decode(kv, isolate=True)
 
     def extract_to_list(self, prefix: str, list_type: Type) -> Any:
         """ref: etcd_helper.go:78 ExtractToList — items + list resourceVersion."""
@@ -129,7 +158,7 @@ class StoreHelper:
             prev = self.store.delete(key)
         except ErrKeyNotFound:
             raise errors.new_not_found(kind or "resource", name or key)
-        return self._decode(prev)
+        return self._decode(prev, isolate=True)
 
     def atomic_update(self, key: str, obj_type: Type,
                       update_fn: Callable[[Any], Any],
@@ -147,7 +176,8 @@ class StoreHelper:
         for _ in range(max_retries):
             try:
                 kv = self.store.get(key)
-                current = self._decode(kv)
+                # isolate: update_fn mutates what it is handed
+                current = self._decode(kv, isolate=True)
                 prev_index: Optional[int] = kv.modified_index
             except ErrKeyNotFound:
                 if not ignore_not_found:
@@ -163,9 +193,9 @@ class StoreHelper:
                     kv = self.store.compare_and_swap(key, encoded, prev_index, ttl=ttl)
             except (ErrCASConflict, ErrKeyExists, ErrKeyNotFound):
                 continue  # re-read and retry
-            out = deep_clone(desired)  # isolation copy; codec runs in _encode
-            accessor.set_resource_version(out, str(kv.modified_index))
-            return out
+            # desired is already private (isolated decode above)
+            accessor.set_resource_version(desired, str(kv.modified_index))
+            return desired
         raise errors.new_conflict(obj_type.__name__, key, "too many CAS retries")
 
     def atomic_update_many(self, obj_type: Type,
@@ -193,7 +223,7 @@ class StoreHelper:
                         obj_type.__name__, key.rsplit("/", 1)[-1])
                     continue
                 try:
-                    desired = fn(self._decode(kv))
+                    desired = fn(self._decode(kv, isolate=True))
                 except errors.StatusError as e:
                     results[i] = e
                     continue
@@ -211,9 +241,9 @@ class StoreHelper:
                 elif isinstance(oc, Exception):
                     results[i] = errors.new_internal_error(str(oc))
                 else:
-                    out = deep_clone(desired)
-                    accessor.set_resource_version(out, str(oc.modified_index))
-                    results[i] = out
+                    accessor.set_resource_version(desired,
+                                                  str(oc.modified_index))
+                    results[i] = desired
         for i in live:
             results[i] = errors.new_conflict(obj_type.__name__, updates[i][0],
                                              "too many CAS retries")
@@ -264,7 +294,9 @@ class StoreHelper:
                         out.send(watchpkg.Event(watchpkg.DELETED, cur))
                 elif sev.action in ("delete", "expire"):
                     if prev_ok:
-                        prev_out = prev
+                        # clone: the deletion-rv rewrite below must not
+                        # mutate the shared cached revision
+                        prev_out = deep_clone(prev)
                         # deleted object carries the deletion resourceVersion
                         accessor.set_resource_version(prev_out, str(sev.index))
                         out.send(watchpkg.Event(watchpkg.DELETED, prev_out))

@@ -158,9 +158,12 @@ def test_helper_create_and_extract():
 def test_helper_set_with_rv_cas():
     h = _helper()
     out = h.create_obj("/pods/default/p", _pod())
+    rv_before = int(out.metadata.resource_version)
     out.spec.host = "node-1"
+    # set_obj decorates the passed object in place (reference parity:
+    # etcd_helper.go SetObj) and returns it with the bumped rv
     out2 = h.set_obj("/pods/default/p", out)
-    assert int(out2.metadata.resource_version) > int(out.metadata.resource_version)
+    assert int(out2.metadata.resource_version) > rv_before
     # stale rv conflicts
     out.metadata.resource_version = "1"
     with pytest.raises(errors.StatusError) as ei:
