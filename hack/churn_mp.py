@@ -21,6 +21,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import subprocess
 import sys
 import time
@@ -48,15 +49,20 @@ def cpu_env() -> dict:
     return env
 
 
-def feed(prefix: str, count: int, rate: float, master: str) -> int:
+def feed(prefix: str, count: int, rate: float, master: str,
+         depth: int = 32) -> int:
     """Paced feeder (one process). Prints one JSON line when done.
 
-    Offers pods over a raw keep-alive connection from a pre-rendered
-    wire template (only the name varies) — a load generator must be
-    cheaper than the server it measures, and on a small machine the
-    typed client's per-create encode was a visible slice of the shared
-    CPU budget (the kubemark principle)."""
-    import http.client
+    Offers pods over a raw keep-alive socket from a pre-rendered wire
+    template (only the name varies) — a load generator must be cheaper
+    than the server it measures (the kubemark principle); the stdlib
+    http.client's per-response email-parser alone cost ~0.1ms/req of the
+    shared one-core budget. Requests are PIPELINED up to ``depth`` in
+    flight: the send side paces at the target rate while a reader thread
+    drains status lines, so the offered rate tracks the contract instead
+    of the server's per-request latency."""
+    import socket
+    import threading
     import urllib.parse
 
     u = urllib.parse.urlparse(master)
@@ -68,32 +74,148 @@ def feed(prefix: str, count: int, rate: float, master: str) -> int:
             "resources": {"limits": {"cpu": "100m",
                                      "memory": "128Mi"}}}]}})
     head, tail = template.split("@@NAME@@")
-    conn = http.client.HTTPConnection(u.hostname, u.port)
     path = "/api/v1/namespaces/default/pods"
+    sock = socket.create_connection((u.hostname, u.port))
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    done = [0]          # responses seen
+    bad = []            # non-2xx status lines
+    lock = threading.Lock()
+    got_all = threading.Event()
+
+    status_re = re.compile(rb"HTTP/1\.1 (\d{3})")
+
+    def reader():
+        buf = b""
+        while done[0] < count:
+            try:
+                chunk = sock.recv(1 << 16)
+            except OSError:
+                break
+            if not chunk:
+                break
+            buf += chunk
+            n, last_end = 0, 0
+            for m in status_re.finditer(buf):
+                n += 1
+                last_end = m.end()
+                if m.group(1)[:1] != b"2":
+                    with lock:
+                        bad.append(m.group(1).decode("ascii"))
+            # drop consumed bytes; keep a tail short enough to never lose
+            # a status marker split across chunks, long enough to hold one
+            buf = buf[last_end:]
+            if len(buf) > 16:
+                buf = buf[-16:]
+            done[0] += n
+            if bad:
+                break
+        got_all.set()
+
+    rt = threading.Thread(target=reader, daemon=True)
+    rt.start()
     interval = 1.0 / rate
     t0 = time.perf_counter()
     next_t = t0
     behind_max = 0.0
+    sent = 0
     for i in range(count):
-        body = f"{head}{prefix}-{i:06d}{tail}"
-        conn.request("POST", path, body=body,
-                     headers={"Content-Type": "application/json"})
-        resp = conn.getresponse()
-        resp.read()
-        if resp.status >= 300:
-            print(json.dumps({"error": f"create failed: {resp.status}",
-                              "created": i}), flush=True)
-            return 1
+        body = f"{head}{prefix}-{i:06d}{tail}".encode()
+        req = (b"POST " + path.encode() + b" HTTP/1.1\r\n"
+               b"Host: a\r\nContent-Type: application/json\r\n"
+               b"Content-Length: " + str(len(body)).encode() +
+               b"\r\n\r\n" + body)
+        while sent - done[0] >= depth and not bad:
+            time.sleep(0.0005)
+        if bad:
+            break
+        try:
+            sock.sendall(req)
+        except OSError as e:
+            with lock:
+                bad.append(f"send: {e}")
+            break
+        sent += 1
         next_t += interval
         now = time.perf_counter()
         behind_max = max(behind_max, now - next_t)
         if next_t > now:
             time.sleep(next_t - now)
+    # drain the remaining in-flight responses
+    drained = got_all.wait(timeout=120.0)
     dt = time.perf_counter() - t0
+    sock.close()
+    if bad:
+        print(json.dumps({"error": f"create failed: {bad[:3]}",
+                          "created": done[0]}), flush=True)
+        return 1
+    if not drained or done[0] < count:
+        print(json.dumps({"error": f"server acknowledged only {done[0]}"
+                          f"/{count} creates", "created": done[0]}),
+              flush=True)
+        return 1
     print(json.dumps({"created": count, "seconds": round(dt, 3),
                       "rate": round(count / dt, 1),
                       "behind_max_s": round(behind_max, 3)}), flush=True)
     return 0
+
+
+def _scrape_wave_raw(port: int) -> dict:
+    """-> {which: (sorted [(le, cumcount)], sum, count)} from /metrics."""
+    raw = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+    out = {}
+    for which in ("encode", "solve"):
+        base = f"scheduler_wave_{which}_seconds"
+        buckets, total, count = [], 0.0, 0.0
+        for line in raw.splitlines():
+            if line.startswith(base + "_bucket"):
+                le = line.split('le="', 1)[1].split('"', 1)[0]
+                buckets.append((float("inf") if le == "+Inf" else float(le),
+                                float(line.rsplit(None, 1)[1])))
+            elif line.startswith(base + "_sum"):
+                total = float(line.rsplit(None, 1)[1])
+            elif line.startswith(base + "_count"):
+                count = float(line.rsplit(None, 1)[1])
+        out[which] = (sorted(buckets), total, count)
+    return out
+
+
+def _wave_stats_delta(start: dict, end: dict) -> dict:
+    """Steady-state per-wave stats: END minus the post-warmup BASELINE, so
+    the once-per-bucket XLA compiles paid during warmup don't pollute the
+    timed phase's mean/median."""
+    out = {}
+    for which in ("encode", "solve"):
+        b0 = dict(start.get(which, ([], 0, 0))[0])
+        b1, s1, c1 = end.get(which, ([], 0, 0))
+        _, s0, c0 = start.get(which, ([], 0, 0))
+        count = c1 - c0
+        total = s1 - s0
+        if count <= 0:
+            continue
+        buckets = sorted((le, n - b0.get(le, 0.0)) for le, n in b1)
+
+        def quantile(q: float) -> float:
+            target = q * count
+            prev_le, prev_n = 0.0, 0.0
+            for le, n in buckets:
+                if n >= target:
+                    if le == float("inf"):
+                        return prev_le
+                    span = n - prev_n
+                    frac = (target - prev_n) / span if span else 1.0
+                    return prev_le + (le - prev_le) * frac
+                prev_le, prev_n = le, n
+            return prev_le
+
+        out[which] = {
+            "waves": int(count),
+            "mean_ms": round(total / count * 1000, 2),
+            "p50_ms": round(quantile(0.5) * 1000, 2),
+            "p95_ms": round(quantile(0.95) * 1000, 2),
+        }
+    return out
 
 
 def main(argv=None) -> int:
@@ -169,20 +291,54 @@ def main(argv=None) -> int:
                 spec=api.NodeSpec(capacity={"cpu": Quantity("64"),
                                             "memory": Quantity("256Gi")})))
 
+        sched_metrics_port = args.port + 9
         spawn("scheduler", PY, "-m", "kubernetes_tpu.cmd.scheduler",
               "--master", master, "--algorithm", "tpu-batch",
-              "--wave-period", "0.1")
+              "--wave-period", "0.1",
+              "--metrics-port", str(sched_metrics_port))
 
-        def unbound():
-            lst = client.pods().list(field_selector="spec.host=")
-            return len(lst.items)
+        # Bind counting rides a WATCH, not list polling: a full
+        # field-selected LIST costs O(all pods) server CPU per poll
+        # (~0.6s at 50k pods — the monitor would eat the core it is
+        # trying to measure). A pod transitioning into the
+        # spec.host!= filter emits one ADDED frame; counting frames on
+        # the raw chunked stream costs the server one cached frame
+        # encode and this process a substring scan.
+        import socket as socketlib
+        import threading as threadinglib
+        bound_count = [0]
+
+        MARK = b'"type": "ADDED"'
+
+        def bind_counter():
+            s = socketlib.create_connection(("127.0.0.1", args.port))
+            s.sendall(b"GET /api/v1/pods?watch=1&fieldSelector="
+                      b"spec.host%21%3D HTTP/1.1\r\nHost: a\r\n\r\n")
+            tail = b""
+            while True:
+                try:
+                    chunk = s.recv(1 << 16)
+                except OSError:
+                    return
+                if not chunk:
+                    return
+                buf = tail + chunk
+                n = buf.count(MARK)
+                if n:
+                    bound_count[0] += n
+                    # drop everything through the last counted marker so
+                    # the kept tail can never be re-counted
+                    buf = buf[buf.rfind(MARK) + len(MARK):]
+                tail = buf[-(len(MARK) - 1):]  # split marker survives
+
+        threadinglib.Thread(target=bind_counter, daemon=True).start()
 
         def wait_all_bound(total_created, timeout=180.0):
             deadline = time.monotonic() + timeout
             while time.monotonic() < deadline:
-                if unbound() == 0:
+                if bound_count[0] >= total_created:
                     return True
-                time.sleep(0.5)
+                time.sleep(0.05)
             return False
 
         # warmup: every pow-2 wave bucket compiles before the clock starts
@@ -197,6 +353,10 @@ def main(argv=None) -> int:
                 raise RuntimeError(f"warmup bucket {size} did not bind")
             size //= 2
 
+        try:
+            waves_baseline = _scrape_wave_raw(sched_metrics_port)
+        except Exception:
+            waves_baseline = {}
         print(f"[churn-mp] offering {args.pods} pods at {args.rate:.0f}/s "
               f"via {args.feeders} feeder processes", file=sys.stderr,
               flush=True)
@@ -222,10 +382,19 @@ def main(argv=None) -> int:
                 with open(args.out, "w") as f:
                     f.write(json.dumps(record, indent=1) + "\n")
             return 1
-        ok = wait_all_bound(args.pods)
+        ok = wait_all_bound(warm_total + args.pods)
         total_s = time.perf_counter() - t0
         offered = sum(s["created"] for s in stats) / feed_s
         sustained = args.pods / total_s if ok else 0.0
+        # per-wave encode/solve stats from the scheduler's /metrics —
+        # the incremental-encoder cost under churn, measured in the live
+        # topology (ref: the MapPodsToMachines rebuild being designed
+        # away, pkg/scheduler/predicates.go:354-375)
+        try:
+            wave_stats = _wave_stats_delta(waves_baseline,
+                                           _scrape_wave_raw(sched_metrics_port))
+        except Exception as e:
+            wave_stats = {"error": f"metrics scrape failed: {e}"}
         record = {
             "config": f"churn multi-process: {args.pods} pods at "
                       f"{args.rate:.0f}/s onto {args.nodes} nodes",
@@ -240,6 +409,7 @@ def main(argv=None) -> int:
             "feed_s": round(feed_s, 2),
             "total_s": round(total_s, 2),
             "feeder_behind_max_s": max(s["behind_max_s"] for s in stats),
+            "scheduler_waves": wave_stats,
         }
         out = json.dumps(record, indent=1)
         print(out)

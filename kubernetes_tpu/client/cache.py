@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from kubernetes_tpu import watch as watchpkg
@@ -35,24 +36,61 @@ def meta_namespace_key_func(obj: Any) -> str:
 
 
 class Store:
-    """Threadsafe keyed store (ref: cache.Store)."""
+    """Threadsafe keyed store (ref: cache.Store).
+
+    Beyond the reference's interface the store keeps a bounded CHANGELOG
+    of mutations so consumers can stay O(changed-objects) per cycle
+    instead of re-reading O(all-objects) — the seam the wave scheduler's
+    incremental encoder rides under churn (the reference's analog cost is
+    MapPodsToMachines rebuilding the full host map every cycle,
+    ref: pkg/scheduler/predicates.go:354-375). ``delta_since(token)``
+    returns the (op, obj) events after ``token``; a relist (replace) or a
+    fallen-behind token yields None — resync by reading ``list()``."""
+
+    # ~16s of events at 1k-churn rates — consumers poll every wave, and a
+    # fallen-behind token just triggers a list() resync; a bigger window
+    # would pin that many dead object versions in memory for nothing
+    _LOG_MAX = 1 << 14
 
     def __init__(self, key_func: Callable[[Any], str] = meta_namespace_key_func):
         self._lock = threading.RLock()
         self._items: Dict[str, Any] = {}
         self.key_func = key_func
+        self._version = 0
+        self._log: deque = deque(maxlen=self._LOG_MAX)  # (ver, op, obj)
 
     def add(self, obj: Any) -> None:
         with self._lock:
             self._items[self.key_func(obj)] = obj
+            self._version += 1
+            self._log.append((self._version, "set", obj))
 
     def update(self, obj: Any) -> None:
-        with self._lock:
-            self._items[self.key_func(obj)] = obj
+        self.add(obj)
 
     def delete(self, obj: Any) -> None:
         with self._lock:
-            self._items.pop(self.key_func(obj), None)
+            prev = self._items.pop(self.key_func(obj), None)
+            if prev is not None:
+                self._version += 1
+                self._log.append((self._version, "delete", prev))
+
+    def token(self) -> int:
+        """Current changelog position for a later delta_since."""
+        with self._lock:
+            return self._version
+
+    def delta_since(self, token: int):
+        """-> (events, new_token) with events = [(op, obj), ...] in order,
+        or None when the token predates the retained window (log overflow
+        or a replace()) — the caller must resync via list()."""
+        with self._lock:
+            if token == self._version:
+                return [], token
+            if not self._log or self._log[0][0] > token + 1:
+                return None
+            return ([(op, obj) for ver, op, obj in self._log if ver > token],
+                    self._version)
 
     def get(self, obj: Any) -> Optional[Any]:
         return self.get_by_key(self.key_func(obj))
@@ -70,9 +108,13 @@ class Store:
             return list(self._items.keys())
 
     def replace(self, objs: List[Any]) -> None:
-        """Atomically reset contents (ref: store.go Replace — used by relist)."""
+        """Atomically reset contents (ref: store.go Replace — used by relist).
+        Clears the changelog: every outstanding delta token is invalidated
+        (delta_since returns None -> consumers resync)."""
         with self._lock:
             self._items = {self.key_func(o): o for o in objs}
+            self._version += 1
+            self._log.clear()
 
     def __len__(self):
         with self._lock:

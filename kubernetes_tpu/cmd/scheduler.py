@@ -30,7 +30,50 @@ def build_parser() -> argparse.ArgumentParser:
                    help="client-side event rate limit (successor "
                         "codebases' --event-qps; 0 disables)")
     p.add_argument("--event-burst", "--event_burst", type=int, default=100)
+    p.add_argument("--metrics-port", "--metrics_port", type=int, default=0,
+                   help="serve /metrics, /healthz and /debug/pprof on this "
+                        "port (0 disables; ref: the reference's healthz+"
+                        "pprof mounts on every binary, master.go:431-435)")
     return p
+
+
+def _serve_debug(port: int) -> None:
+    """Minimal observability server for the scheduler binary."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from kubernetes_tpu.util import pprof as pprof_util
+    from kubernetes_tpu.util.metrics import default_registry
+
+    class H(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet
+            pass
+
+        def do_GET(self):
+            if self.path.startswith("/debug/pprof"):
+                import urllib.parse
+                parsed = urllib.parse.urlsplit(self.path)
+                which = parsed.path[len("/debug/pprof"):].strip("/")
+                q = dict(urllib.parse.parse_qsl(parsed.query))
+                body = pprof_util.handle(which, q.get("seconds", ""))
+                code = 200 if body is not None else 404
+                body = body if body is not None else "not found"
+            elif self.path == "/healthz":
+                code, body = 200, "ok"
+            elif self.path == "/metrics":
+                code, body = 200, default_registry().render_text()
+            else:
+                code, body = 404, "not found"
+            raw = body.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "text/plain; charset=utf-8")
+            self.send_header("Content-Length", str(len(raw)))
+            self.end_headers()
+            self.wfile.write(raw)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", port), H)
+    srv.daemon_threads = True
+    threading.Thread(target=srv.serve_forever, daemon=True,
+                     name="scheduler-debug-http").start()
 
 
 def build_scheduler(opts):
@@ -86,6 +129,8 @@ def scheduler_server(argv: List[str],
         print(f"error: {e}", file=sys.stderr)
         return 2
     factory, sched = build_scheduler(opts)
+    if getattr(opts, "metrics_port", 0):
+        _serve_debug(opts.metrics_port)
     sched.run()
     print(f"kube-scheduler running ({opts.algorithm})", file=sys.stderr)
     if ready is not None:

@@ -390,7 +390,51 @@ class IncrementalEncoder:
                                                           self._N):
                     self._remove_pod(u)   # host changed: re-account
                     self._add_pod(p)
+        return self._build(existing_pods, pending_pods, pad_pods)
 
+    def encode_delta(self, nodes: Sequence[api.Node],
+                     upserted: Sequence[api.Pod],
+                     removed: Sequence[api.Pod],
+                     pending_pods: Sequence[api.Pod],
+                     services: Sequence[api.Service] = (),
+                     pad_pods: bool = True) -> Optional[ClusterSnapshot]:
+        """O(changed + pending) wave encode: apply a SimpleModeler.delta
+        (upserts first, then removes — see its contract) instead of
+        re-walking the whole existing-pod list. Returns None — caller must
+        fall back to encode() with the full list — when the node/service
+        planes changed, or when some node's usage exceeds its capacity:
+        the greedy fit accumulators are existing-LIST-order exact there
+        (snapshot.greedy_fit_accumulators), and only the full walk carries
+        that order."""
+        services = list(services)
+        if self._nodes_key is None or self._nodes_changed(nodes) \
+                or self._services_changed(services):
+            return None
+        for p in upserted:
+            rec = self._pods.get(p.metadata.uid)
+            host = self._node_index.get(p.status.host, self._N)
+            if rec is None:
+                self._add_pod(p)
+            elif rec.host_idx != host:
+                self._remove_pod(p.metadata.uid)
+                self._add_pod(p)
+        for p in removed:
+            if p.metadata.uid in self._pods:
+                self._remove_pod(p.metadata.uid)
+        # overflow anywhere -> the order-exact slow path is required
+        R = self._score_used.shape[1]
+        cap = self._cap if self._cap.shape[1] == R else \
+            np.pad(self._cap, ((0, 0), (0, R - self._cap.shape[1])))
+        unconstrained = (cap == 0) & (np.arange(R) < 2)[None, :]
+        if not (unconstrained | (self._score_used <= cap)).all():
+            return None
+        return self._build(None, pending_pods, pad_pods)
+
+    def _build(self, existing_pods, pending_pods, pad_pods) -> ClusterSnapshot:
+        """The pending-pod pass + snapshot assembly over the resident
+        planes. ``existing_pods`` feeds the greedy overflow walk; None
+        (delta path) is only legal when no node overflows — encode_delta
+        checked before calling."""
         N = self._N
         P = len(pending_pods)
         Ppad = _pow2_pad(P, minimum=1) if pad_pods else max(P, 0)
@@ -497,8 +541,11 @@ class IncrementalEncoder:
             score_used = np.pad(score_used, ((0, 0), (0, R - score_used.shape[1])))
             self._score_used = score_used
         def recs_in_list_order():
-            # current list order == what the oracle's full encode would see
-            for p in existing_pods:
+            # current list order == what the oracle's full encode would see.
+            # The delta path passes existing_pods=None: legal because it
+            # bailed to the full path before any node overflowed, and
+            # greedy_fit_accumulators only consumes this on overflow.
+            for p in existing_pods or ():
                 rec = self._pods.get(p.metadata.uid)
                 if rec is None:
                     continue

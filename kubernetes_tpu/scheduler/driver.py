@@ -78,6 +78,42 @@ class SimpleModeler:
         assumed = StorePodLister(self.assumed).list(selector)
         return scheduled + assumed
 
+    # -- O(changed) view -----------------------------------------------------
+    def token(self):
+        """Changelog position over both stores; pair with delta()."""
+        return (self.scheduled.token(), self.assumed.token())
+
+    def delta(self, token):
+        """Events on the COMBINED (scheduled + assumed) pod set since
+        ``token``: -> (upserted_pods, removed_pods, new_token), or None
+        when a store relisted / the log window was exceeded (resync via
+        list()). Consumers MUST apply upserts before removes. A delete
+        event is suppressed while the pod's key is live in either store —
+        an assumed pod disappearing because the reflector caught its
+        binding (prune) is a migration, and a delete+set pair inside one
+        window is a resurrection, not a removal."""
+        self._prune_assumed()
+        ds = self.scheduled.delta_since(token[0])
+        da = self.assumed.delta_since(token[1])
+        if ds is None or da is None:
+            return None
+        upserted, removed = [], []
+        for events in (ds[0], da[0]):
+            for op, pod in events:
+                if op == "set":
+                    upserted.append(pod)
+                else:
+                    key = meta_namespace_key_func(pod)
+                    live = self.scheduled.get_by_key(key) \
+                        or self.assumed.get_by_key(key)
+                    # suppress only when the SAME uid is still live: a
+                    # delete + recreate of the name inside one window is a
+                    # new pod — the old uid must still be removed or its
+                    # resources leak in the encoder
+                    if live is None or live.metadata.uid != pod.metadata.uid:
+                        removed.append(pod)
+        return upserted, removed, (ds[1], da[1])
+
     def pod_lister(self):
         return self
 

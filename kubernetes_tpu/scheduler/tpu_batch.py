@@ -40,6 +40,39 @@ __all__ = ["BatchScheduler"]
 _log = logging.getLogger("kubernetes_tpu.scheduler.tpu_batch")
 
 
+class _WaveMetrics:
+    """Per-wave instrumentation (the kubelet-metrics analog for the wave
+    loop, ref: pkg/kubelet/metrics/metrics.go — instrumented, no targets).
+    Scraped via the scheduler binary's --metrics-port; the churn harness
+    reads encode quantiles from here (the MapPodsToMachines
+    rebuild-per-cycle cost being designed away, ref:
+    pkg/scheduler/predicates.go:354-375)."""
+
+    _singleton = None
+
+    def __init__(self):
+        reg = metrics.default_registry()
+        buckets = (0.001, 0.0025, 0.005, 0.01, 0.02, 0.05, 0.1, 0.25,
+                   0.5, 1.0, 2.5)
+        self.encode = reg.histogram(
+            "scheduler_wave_encode_seconds",
+            "Snapshot encode time per wave", buckets=buckets)
+        self.solve = reg.histogram(
+            "scheduler_wave_solve_seconds",
+            "Solver time per wave", buckets=buckets)
+        self.pods = reg.counter(
+            "scheduler_wave_pods_total", "Pods drained into waves")
+        self.resyncs = reg.counter(
+            "scheduler_wave_encode_resyncs_total",
+            "Full-list encoder syncs (vs O(changed) delta waves)")
+
+
+def _wave_metrics() -> _WaveMetrics:
+    if _WaveMetrics._singleton is None:
+        _WaveMetrics._singleton = _WaveMetrics()
+    return _WaveMetrics._singleton
+
+
 class BatchScheduler:
     """Wave-based driver over SchedulerConfig plumbing.
 
@@ -59,6 +92,9 @@ class BatchScheduler:
         self.client = client
         self.wave_size = wave_size
         self.wave_linger_s = wave_linger_s
+        # flag, not identity: `self._default_solve` creates a fresh bound
+        # method on every attribute access, so `is` can never match it
+        self._using_default_solve = solve_fn is None
         self.solve_fn = solve_fn or self._default_solve
         self.batch_policy = batch_policy or batch_policy_from(
             getattr(config, "provider", None), getattr(config, "policy", None))
@@ -71,6 +107,9 @@ class BatchScheduler:
             # CheckServiceAffinity policies are arrival-order dependent;
             # full re-encode per wave stays authoritative
             self._encoder = None
+        # modeler changelog cursor for the O(changed) wave path; None
+        # until the first full sync establishes the resident planes
+        self._delta_token = None
         self._stop = threading.Event()
 
     # -- wave assembly ------------------------------------------------------
@@ -89,16 +128,52 @@ class BatchScheduler:
 
     # -- solving ------------------------------------------------------------
     def _default_solve(self, nodes, existing, pending, services):
+        get_existing = existing if callable(existing) else lambda: existing
+        t0 = time.perf_counter()
         if self._encoder is not None:
-            snap = self._encoder.encode(nodes, existing, pending, services)
+            snap = self._encode_incremental(nodes, pending, services,
+                                            get_existing)
         else:
-            snap = encode_snapshot(nodes, existing, pending, services,
+            snap = encode_snapshot(nodes, get_existing(), pending, services,
                                    policy=self.batch_policy)
+        t1 = time.perf_counter()
         chosen, _ = solve(snap)  # includes the gang all-or-nothing post-pass
+        t2 = time.perf_counter()
+        _wave_metrics().encode.observe(t1 - t0)
+        _wave_metrics().solve.observe(t2 - t1)
+        _wave_metrics().pods.inc(by=len(pending))
         return decisions_to_names(snap, chosen)
 
+    def _encode_incremental(self, nodes, pending, services, get_existing):
+        """O(changed + pending) when the modeler's changelog covers the
+        gap; full list sync otherwise (first wave, relist, node-plane
+        change, or capacity overflow — see IncrementalEncoder.encode_delta).
+        The resync token is always taken BEFORE the list it pairs with
+        (get_existing records its own pre-token at materialization) so an
+        event racing the list is re-delivered rather than lost
+        (re-applying an upsert or remove is a no-op in the encoder)."""
+        modeler = self.config.modeler
+        if self._delta_token is not None and hasattr(modeler, "delta"):
+            d = modeler.delta(self._delta_token)
+            if d is not None:
+                upserted, removed, token = d
+                snap = self._encoder.encode_delta(nodes, upserted, removed,
+                                                  pending, services)
+                if snap is not None:
+                    self._delta_token = token
+                    return snap
+        if hasattr(modeler, "token"):
+            fallback_token = modeler.token()
+            existing = get_existing()
+            pre = getattr(get_existing, "pre_token", lambda: None)()
+            self._delta_token = pre if pre is not None else fallback_token
+            _wave_metrics().resyncs.inc()
+        else:
+            existing = get_existing()
+        return self._encoder.encode(nodes, existing, pending, services)
+
     def _gate_gang_quorum(self, pods: List[api.Pod],
-                          existing: List[api.Pod] = ()
+                          get_existing=()
                           ) -> tuple[List[api.Pod], List[api.Pod]]:
         """Split the wave into (schedulable, quorum-failed): a gang whose
         membership is below its declared min-members fails its present
@@ -122,6 +197,7 @@ class BatchScheduler:
                 quorum[k] = max(quorum.get(k, 0), gang.gang_min_members(p))
         if not present or not any(quorum.values()):
             return list(pods), []  # gang-free wave: skip the O(cluster) scan
+        existing = get_existing() if callable(get_existing) else get_existing
         for p in existing:
             k = gang.gang_key(p)
             if k in present and (p.status.host or p.spec.host):
@@ -140,16 +216,32 @@ class BatchScheduler:
         """Drain, solve, commit. Returns the number of pods bound."""
         c = self.config
         pending = self._drain_wave(timeout)
+        # the full existing-pod list is only materialized when something
+        # actually needs it (gang quorum, or an encoder resync) — the
+        # steady-state delta path stays O(changed), not O(cluster)
+        memo: dict = {}
+
+        def get_existing():
+            if "list" not in memo:
+                # token BEFORE list: an event racing the list is
+                # re-delivered by the next delta (idempotent in the
+                # encoder) rather than lost forever
+                if hasattr(c.modeler, "token"):
+                    memo["token"] = c.modeler.token()
+                memo["list"] = c.modeler.list()
+            return memo["list"]
+
+        get_existing.pre_token = lambda: memo.get("token")
+
         try:
             nodes = c.minion_lister.list().items
-            existing = c.modeler.list()
             services = self.factory.service_store.list()
+            pending, starved = self._gate_gang_quorum(pending, get_existing)
         except Exception as e:
             for pod in pending:
                 self._record(pod, "FailedScheduling", "Error scheduling wave: %s", e)
                 c.error(pod, e)
             return 0
-        pending, starved = self._gate_gang_quorum(pending, existing)
         for pod in starved:
             err = FitError(pod, {})
             self._record(pod, "FailedScheduling",
@@ -159,7 +251,13 @@ class BatchScheduler:
             return 0
         pending = gang.order_wave(pending)
         try:
-            decisions = self.solve_fn(nodes, existing, pending, services)
+            if self._using_default_solve:
+                # the default solve resolves `existing` lazily (delta path)
+                decisions = self._default_solve(nodes, get_existing,
+                                                pending, services)
+            else:
+                decisions = self.solve_fn(nodes, get_existing(), pending,
+                                          services)
         except Exception as e:
             # a failed solve must not drop the drained wave: hand every pod
             # to the error handler for backoff+requeue, like the serial

@@ -270,3 +270,116 @@ def test_fuzz_churn_equivalence(seed):
         for _ in range(rng.randint(0, 4)):
             if existing:
                 existing.pop(rng.randrange(len(existing)))
+
+
+# -- O(changed) delta path ---------------------------------------------------
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fuzz_delta_equivalence(seed):
+    """encode_delta fed from churn deltas must match a fresh full encode
+    (and therefore the serial oracle) wave after wave — adds, host
+    changes, removals, service groups, pinned hosts, gangs."""
+    rng = random.Random(7000 + seed)
+    nodes = [mk_node(f"n{i}", cpu_m=rng.choice([1000, 2000]),
+                     labels={"zone": rng.choice(["z1", "z2"])})
+             for i in range(rng.randint(3, 8))]
+    services = [api.Service(
+        metadata=api.ObjectMeta(name="web", namespace="default"),
+        spec=api.ServiceSpec(port=80, selector={"app": "web"}))]
+    enc = IncrementalEncoder()
+    existing = []
+    # first wave establishes planes through the full path
+    snap = enc.encode(nodes, existing, [], services)
+    for wave in range(4):
+        pending = [mk_pod(f"w{wave}p{i}",
+                          cpu_m=rng.choice([0, 100, 400]),
+                          labels={"app": "web"} if rng.random() < 0.5
+                          else {})
+                   for i in range(rng.randint(1, 10))]
+        upserted, removed = [], []
+        # simulate binds from previous waves arriving as deltas
+        for p in list(existing):
+            if rng.random() < 0.15:
+                existing.remove(p)
+                removed.append(p)
+        snap = enc.encode_delta(nodes, upserted, removed, pending, services)
+        assert snap is not None
+        fresh = IncrementalEncoder().encode(nodes, existing, pending,
+                                            services)
+        chosen_d, _ = solve(snap)
+        chosen_f, _ = solve(fresh)
+        assert decisions_to_names(snap, chosen_d) == \
+            decisions_to_names(fresh, chosen_f)
+        # commit this wave's decisions as delta upserts for the next
+        names = decisions_to_names(snap, chosen_d)
+        ups = []
+        for p, h in zip(pending, names):
+            if h:
+                p.status.host = h
+                existing.append(p)
+                ups.append(p)
+        snap2 = enc.encode_delta(nodes, ups, [], [], services)
+        assert snap2 is not None
+
+
+def test_delta_bails_to_full_on_overflow_and_node_change():
+    enc = IncrementalEncoder()
+    nodes = [mk_node("n1", cpu_m=500)]
+    enc.encode(nodes, [], [], [])
+    # capacity overflow: two 400m pods on a 500m node
+    over = []
+    for i in range(2):
+        p = mk_pod(f"e{i}", cpu_m=400)
+        p.status.host = "n1"
+        over.append(p)
+    assert enc.encode_delta(nodes, over, [], [], []) is None
+    # full path still encodes (order-exact greedy walk)
+    snap = enc.encode(nodes, over, [], [])
+    assert snap is not None
+    # node-set change: delta refuses
+    enc2 = IncrementalEncoder()
+    enc2.encode(nodes, [], [], [])
+    assert enc2.encode_delta([mk_node("n2")], [], [], [], []) is None
+
+
+def test_store_changelog_and_modeler_delta():
+    from kubernetes_tpu.client.cache import FIFO, Store
+    from kubernetes_tpu.scheduler.driver import SimpleModeler
+
+    s = Store()
+    t0 = s.token()
+    a, b = mk_pod("a"), mk_pod("b")
+    s.add(a); s.add(b); s.delete(a)
+    events, t1 = s.delta_since(t0)
+    assert [op for op, _ in events] == ["set", "set", "delete"]
+    assert s.delta_since(t1) == ([], t1)
+    s.replace([b])
+    assert s.delta_since(t1) is None  # relist invalidates tokens
+
+    m = SimpleModeler(FIFO(), Store())
+    tok = m.token()
+    p = mk_pod("p1"); p.status.host = "n1"
+    m.assume_pod(p)
+    ups, rms, tok = m.delta(tok)
+    assert [x.metadata.name for x in ups] == ["p1"] and rms == []
+    # the reflector catches the bind: assumed -> scheduled is a
+    # migration, never a removal
+    m.scheduled.add(p)
+    ups, rms, tok = m.delta(tok)   # prune fires inside delta
+    assert rms == [] and [x.metadata.name for x in ups] == ["p1"]
+    # true deletion: gone from both stores
+    m.scheduled.delete(p)
+    ups, rms, tok = m.delta(tok)
+    assert ups == [] and [x.metadata.name for x in rms] == ["p1"]
+    # delete + recreate of the same NAME with a new uid inside one
+    # window: the old uid must surface as removed (else its resources
+    # leak in the encoder) and the new one as upserted
+    old = mk_pod("p2"); old.metadata.uid = "uid-old"
+    m.scheduled.add(old)
+    ups, rms, tok = m.delta(tok)
+    m.scheduled.delete(old)
+    new = mk_pod("p2"); new.metadata.uid = "uid-new"
+    m.scheduled.add(new)
+    ups, rms, tok = m.delta(tok)
+    assert [x.metadata.uid for x in ups] == ["uid-new"]
+    assert [x.metadata.uid for x in rms] == ["uid-old"]
