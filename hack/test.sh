@@ -2,8 +2,36 @@
 # Run the unit/integration suite (ref: hack/test-go.sh). Like the
 # reference's KUBE_TEST_API_VERSIONS loop, the suite can be run once per
 # external API version: TEST_API_VERSIONS=v1,v1beta1 hack/test.sh
+#
+# --race: the Go race detector analog (ref: hack/test-go.sh:50). Runs the
+# concurrency-heavy suites RACE_ROUNDS times (default 3) with the
+# interpreter switch interval forced to ~1us (tests/conftest.py), so
+# thread preemption lands between nearly every bytecode and
+# check-then-act races become probable instead of theoretical.
+# Latest full run: hack/race-report.md.
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+RACE=0
+ARGS=()
+for a in "$@"; do  # --race is recognized anywhere in the argument list
+    if [[ "$a" == "--race" ]]; then RACE=1; else ARGS+=("$a"); fi
+done
+set -- ${ARGS+"${ARGS[@]}"}
+
+if [[ "$RACE" == 1 ]]; then
+    ROUNDS="${RACE_ROUNDS:-3}"
+    SUITES=(tests/test_contention.py tests/test_storage.py
+            tests/test_remote_store.py tests/test_cache.py
+            tests/test_http.py tests/test_stale_wave.py
+            tests/test_websocket_pprof.py)
+    rc=0
+    for ((i = 1; i <= ROUNDS; i++)); do
+        echo "=== race round ${i}/${ROUNDS} (switchinterval=1e-6) ==="
+        KTPU_RACE=1 python -m pytest "${SUITES[@]}" -q "$@" || rc=$?
+    done
+    exit "$rc"
+fi
 
 VERSIONS="${TEST_API_VERSIONS:-v1,v1beta1,v1beta2}"
 rc=0

@@ -29,3 +29,17 @@ Layer map (mirrors SURVEY.md section 1):
 """
 
 __version__ = "0.1.0"
+
+# Race-probe hook — the -race build flag analog (ref: hack/test-go.sh:50).
+# hack/test.sh --race exports KTPU_RACE=1; forcing a ~1us thread switch
+# interval HERE (not only in the test harness) means every spawned
+# component binary (storeserver, apiserver workers, scheduler) that
+# imports this package runs under the same aggressive preemption, so
+# server-side check-then-act races are probed too, not just the client
+# half living in the pytest process. No-op unless KTPU_RACE is set.
+import os as _os
+
+if _os.environ.get("KTPU_RACE"):
+    import sys as _sys
+
+    _sys.setswitchinterval(1e-6)

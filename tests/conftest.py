@@ -35,3 +35,16 @@ if _v:
     from kubernetes_tpu.client import http as _client_http
 
     _client_http.test_version_override = _v
+
+# Race-probe mode (hack/test.sh --race): the Go race detector analog
+# (ref: hack/test-go.sh:50 -race). A near-zero switch interval forces the
+# interpreter to preempt threads between nearly every bytecode, so lock
+# ordering bugs and unsynchronized check-then-act windows in the
+# threading-heavy core (memstore watch fan-out, remote store, proxy, pod
+# workers, keep-alive transport) surface as real failures instead of
+# staying improbable. hack/test.sh --race repeats the concurrency suites
+# under this regime.
+if os.environ.get("KTPU_RACE"):
+    import sys as _sys
+
+    _sys.setswitchinterval(1e-6)

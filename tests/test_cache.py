@@ -1,5 +1,6 @@
 """Reflector/FIFO/Store cache tests (ref: pkg/client/cache/*_test.go)."""
 
+import os
 import threading
 import time
 
@@ -134,7 +135,11 @@ def test_reflector_into_fifo_feeds_consumer():
     r = Reflector(lw, fifo, name="unassigned").run()
     try:
         h.create_obj("/pods/default/w1", _pod("w1"))
-        got = fifo.pop(timeout=2)
+        # --race mode preempts between nearly every bytecode: delivery is
+        # still guaranteed (the reflector watches from the list rv, so
+        # there is no lost-event window) but latency balloons; the
+        # assertion is about delivery, not speed
+        got = fifo.pop(timeout=10 if os.environ.get("KTPU_RACE") else 2)
         assert got.metadata.name == "w1"
     finally:
         r.stop()
