@@ -149,9 +149,10 @@ def suite_secrets(c: Client, master: str):
     c.secrets(NS).delete("e2e-secret")
 
 
-def suite_kubectl(c: Client, master: str):
-    # the CLI finds the server via kubeconfig, like the reference —
-    # build one with the real `kubectl config` verbs
+def make_kubectl(master: str, ctx: str):
+    """A real-kubectl runner bound to a fresh kubeconfig built through
+    the `kubectl config` verbs, like a user would. Returns (kubectl,
+    cleanup); kubectl(*args, check=True) runs the CLI subprocess."""
     import tempfile
     kubeconfig = tempfile.mktemp(suffix=".kubeconfig")
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -159,23 +160,40 @@ def suite_kubectl(c: Client, master: str):
                PYTHONPATH=repo + (os.pathsep + os.environ["PYTHONPATH"]
                                   if os.environ.get("PYTHONPATH") else ""))
 
-    def kubectl(*args):
-        return subprocess.run(
+    def kubectl(*args, check=True, timeout=60):
+        out = subprocess.run(
             [sys.executable, "-m", "kubernetes_tpu.cmd.kubectl", *args],
-            capture_output=True, text=True, env=env, timeout=60)
+            capture_output=True, text=True, env=env, timeout=timeout)
+        if check:
+            assert out.returncode == 0, f"kubectl {args}: {out.stderr}"
+        return out
 
-    for args in (("config", "set-cluster", "e2e", f"--server={master}"),
-                 ("config", "set-context", "e2e", "--cluster=e2e"),
-                 ("config", "use-context", "e2e")):
-        out = kubectl(*args)
-        assert out.returncode == 0, out.stderr
-    out = kubectl("get", "nodes")
-    assert out.returncode == 0, out.stderr
-    assert "node" in out.stdout.lower(), out.stdout
-    out = kubectl("-n", NS, "get", "pods", "-o", "json")
-    assert out.returncode == 0, out.stderr
-    json.loads(out.stdout)
-    os.unlink(kubeconfig)
+    def cleanup():
+        if os.path.exists(kubeconfig):
+            os.unlink(kubeconfig)
+
+    try:
+        for args in (("config", "set-cluster", ctx, f"--server={master}"),
+                     ("config", "set-context", ctx, f"--cluster={ctx}"),
+                     ("config", "use-context", ctx)):
+            kubectl(*args)
+    except BaseException:
+        cleanup()
+        raise
+    return kubectl, cleanup
+
+
+def suite_kubectl(c: Client, master: str):
+    # the CLI finds the server via kubeconfig, like the reference —
+    # build one with the real `kubectl config` verbs
+    kubectl, cleanup = make_kubectl(master, "e2e")
+    try:
+        out = kubectl("get", "nodes")
+        assert "node" in out.stdout.lower(), out.stdout
+        out = kubectl("-n", NS, "get", "pods", "-o", "json")
+        json.loads(out.stdout)
+    finally:
+        cleanup()
 
 
 def suite_watch(c: Client, master: str):
@@ -204,6 +222,64 @@ def suite_watch(c: Client, master: str):
         c.pods(NS).delete("e2e-watch")
 
 
+def suite_guestbook(c: Client, master: str):
+    """The examples/guestbook walkthrough, executed exactly as the README
+    tells a user to: every step through the real kubectl binary with
+    `create -f` on the checked-in manifest files
+    (ref: examples/guestbook/README.md in the reference)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    gb = os.path.join(repo, "examples", "guestbook")
+    run_kubectl, cleanup = make_kubectl(master, "gb")
+
+    def kubectl(*args):
+        return run_kubectl(*args).stdout
+
+    pods = c.pods("default")
+    try:
+        # 1-3: master, slaves, frontend — controllers then services
+        for m in ("redis-master-controller", "redis-master-service",
+                  "redis-slave-controller", "redis-slave-service",
+                  "frontend-controller", "frontend-service"):
+            kubectl("create", "-f", os.path.join(gb, m + ".json"))
+
+        def tier_running(selector, n):
+            items = [p for p in pods.list(selector).items
+                     if p.status.phase == "Running" and p.spec.host]
+            return len(items) == n
+        wait_for(lambda: tier_running("name=redis-master", 1),
+                 desc="redis master running")
+        wait_for(lambda: tier_running("name=redis-slave", 2),
+                 desc="2 redis slaves running")
+        wait_for(lambda: tier_running("name=frontend", 3),
+                 desc="3 frontends running")
+
+        # endpoints follow the pods (the endpoints controller's job)
+        def master_endpoints():
+            ep = c.endpoints("default").get("redis-master")
+            return len(ep.endpoints or []) == 1
+        wait_for(master_endpoints, desc="redis-master endpoints")
+
+        # transcript step 4: resize the frontend
+        kubectl("resize", "rc", "frontend", "--replicas=5")
+        wait_for(lambda: tier_running("name=frontend", 5),
+                 desc="frontend resized to 5")
+
+        # the CLI sees what the README claims it sees
+        out = kubectl("get", "rc")
+        assert "frontend" in out and "redis-master" in out, out
+        out = kubectl("get", "pods", "-l", "app=guestbook")
+        assert out.count("Running") >= 5, out
+    finally:
+        # transcript step 5: teardown (best-effort: check=False)
+        for rc_name in ("frontend", "redis-slave", "redis-master"):
+            run_kubectl("stop", "rc", rc_name, check=False, timeout=120)
+            run_kubectl("delete", "services", rc_name, check=False)
+        cleanup()
+    wait_for(lambda: not pods.list("app=redis").items
+             and not pods.list("app=guestbook").items,
+             desc="guestbook drained")
+
+
 SUITES = [
     ("pods", suite_pods),
     ("replication", suite_replication),
@@ -212,6 +288,7 @@ SUITES = [
     ("secrets", suite_secrets),
     ("watch", suite_watch),
     ("kubectl", suite_kubectl),
+    ("guestbook", suite_guestbook),
 ]
 
 
@@ -235,7 +312,7 @@ def main(argv=None) -> int:
                                       if os.environ.get("PYTHONPATH") else ""))
         proc = subprocess.Popen(
             [sys.executable, "-m", "kubernetes_tpu.cmd.standalone",
-             "--port", str(args.port)],
+             "--port", str(args.port), "--nodes", "3"],
             env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
         wait_for(lambda: urllib.request.urlopen(
             f"{master}/healthz", timeout=1).status == 200,
