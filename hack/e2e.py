@@ -36,7 +36,9 @@ from kubernetes_tpu.client.http import HTTPTransport           # noqa: E402
 NS = "e2e"
 
 
-def wait_for(fn, timeout=30.0, interval=0.25, desc="condition"):
+def wait_for(fn, timeout=60.0, interval=0.25, desc="condition"):
+    # generous default: suites assert CONVERGENCE of live control loops;
+    # on a loaded one-core box (e.g. the full pytest run) 30s flaked
     deadline = time.monotonic() + timeout
     last = None
     while time.monotonic() < deadline:
