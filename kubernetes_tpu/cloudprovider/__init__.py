@@ -11,6 +11,9 @@ object or None, exactly like the reference's (T, bool) pairs. Providers:
   config-driven pattern); registered as "inventory"
 - ``ProbeCloud``     — discovery-command provider (the GCE-metadata /
   live-query pattern) with Clusters support; registered as "probe"
+- ``LocalLBCloud``   — a TCPLoadBalancer facet that actually balances:
+  real listeners forwarding round-robin to the registered hosts (the
+  GCE forwarding-rule pattern in software); registered as "locallb"
 
 The registry (``register_provider``/``get_provider``) mirrors
 pkg/cloudprovider/plugins.go; importing this package registers the
@@ -23,4 +26,5 @@ from kubernetes_tpu.cloudprovider.cloud import (Clusters, FakeCloud,  # noqa: F4
                                                 Zone, Zones, get_provider,
                                                 register_provider)
 from kubernetes_tpu.cloudprovider.inventory import InventoryCloud  # noqa: F401,E402
+from kubernetes_tpu.cloudprovider.locallb import LocalLBCloud  # noqa: F401,E402
 from kubernetes_tpu.cloudprovider.probe import ProbeCloud  # noqa: F401,E402
