@@ -228,6 +228,27 @@ class TestWatchStreaming:
         finally:
             w.stop()
 
+    def test_watch_frames_born_complete_selflink(self, client, server):
+        """The shared-read contract (storage/helper.py): decoded objects
+        are decorated at decode-cache insertion, so a watch frame carries
+        selfLink REGARDLESS of whether any list/get ran first — wire
+        output must never be order-dependent on other channels."""
+        w = client.pods().watch()
+        try:
+            # no list/get has touched this pod before its watch event
+            client.pods().create(make_pod("fresh"))
+            ev = w.next_event(timeout=5)
+            assert ev.type == watchpkg.ADDED
+            assert ev.object.metadata.self_link == \
+                "/api/v1/namespaces/default/pods/fresh"
+        finally:
+            w.stop()
+        # and a list sees the same selfLink, not a different stamping
+        item = [p for p in client.pods().list().items
+                if p.metadata.name == "fresh"][0]
+        assert item.metadata.self_link == \
+            "/api/v1/namespaces/default/pods/fresh"
+
     def test_watch_from_resource_version(self, client):
         client.pods().create(make_pod("rv1"))
         lst = client.pods().list()
