@@ -282,6 +282,80 @@ def suite_guestbook(c: Client, master: str):
              desc="guestbook drained")
 
 
+def suite_update_demo(c: Client, master: str):
+    """The examples/update-demo walkthrough: create the nautilus RC, roll
+    it to kitten with the real `kubectl rollingupdate` against the live
+    stack, sampling the availability invariant the demo exists to show —
+    the combined name=update-demo group keeps at least desired-1 pods at
+    every instant of the roll (one replica in flight at a time; ref:
+    examples/update-demo/README.md in the reference;
+    pkg/kubectl/rolling_updater.go)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ud = os.path.join(repo, "examples", "update-demo")
+    run_kubectl, cleanup = make_kubectl(master, "ud")
+    pods = c.pods("default")
+
+    def running(selector):
+        return [p for p in pods.list(selector).items
+                if p.status.phase == "Running" and p.spec.host]
+    try:
+        run_kubectl("create", "-f", os.path.join(ud, "nautilus-rc.json"))
+        wait_for(lambda: len(running("version=nautilus")) == 2,
+                 desc="2 nautilus pods running")
+
+        # sample the availability invariant WHILE the roll runs: one
+        # replica moves at a time, so the combined group never drops
+        # below desired-1 pods (2 replicas -> floor 1)
+        import threading
+        floor_violations = []
+        stop_sampling = threading.Event()
+
+        def sample():
+            while not stop_sampling.is_set():
+                try:
+                    n = len(pods.list("name=update-demo").items)
+                    if n < 1:
+                        floor_violations.append(n)
+                except Exception:
+                    pass
+                time.sleep(0.1)
+
+        sampler = threading.Thread(target=sample, daemon=True)
+        sampler.start()
+        try:
+            out = run_kubectl("rollingupdate", "update-demo-nautilus",
+                              "-f", os.path.join(ud, "kitten-rc.json"),
+                              "--timeout=120", timeout=150)
+        finally:
+            stop_sampling.set()
+            sampler.join(timeout=5)
+        assert "update-demo-kitten" in out.stdout, out.stdout
+        assert not floor_violations, \
+            f"group dropped below desired-1 pods mid-roll: {floor_violations}"
+
+        wait_for(lambda: len(running("version=kitten")) == 2,
+                 desc="2 kitten pods running")
+        # the old controller is gone, the new one owns the group
+        names = [rc.metadata.name
+                 for rc in c.replication_controllers("default").list().items]
+        assert "update-demo-nautilus" not in names, names
+        assert "update-demo-kitten" in names, names
+        assert not running("version=nautilus"), "nautilus pods survived roll"
+
+        # transcript step 3: the rolled group is an ordinary rc
+        run_kubectl("resize", "rc", "update-demo-kitten", "--replicas=4")
+        wait_for(lambda: len(running("version=kitten")) == 4,
+                 desc="kitten resized to 4")
+    finally:
+        run_kubectl("stop", "rc", "update-demo-kitten",
+                    check=False, timeout=120)
+        run_kubectl("stop", "rc", "update-demo-nautilus",
+                    check=False, timeout=120)
+        cleanup()
+    wait_for(lambda: not pods.list("name=update-demo").items,
+             desc="update-demo drained")
+
+
 SUITES = [
     ("pods", suite_pods),
     ("replication", suite_replication),
@@ -291,6 +365,7 @@ SUITES = [
     ("watch", suite_watch),
     ("kubectl", suite_kubectl),
     ("guestbook", suite_guestbook),
+    ("update-demo", suite_update_demo),
 ]
 
 
@@ -327,11 +402,18 @@ def main(argv=None) -> int:
     except Exception:
         pass  # already exists
 
+    selected = [(n, f) for n, f in SUITES
+                if not args.focus or args.focus in n]
+    if not selected:
+        print(f"error: --focus {args.focus!r} matches no suite "
+              f"(have: {', '.join(n for n, _ in SUITES)})")
+        if proc is not None:
+            proc.terminate()
+        return 2
+
     failed = []
     try:
-        for name, fn in SUITES:
-            if args.focus and args.focus not in name:
-                continue
+        for name, fn in selected:
             t0 = time.perf_counter()
             try:
                 fn(client, master)

@@ -148,8 +148,10 @@ def reaper_for(resource: str, client):
 
 class RollingUpdater:
     """ref: rolling_updater.go RollingUpdater.Update — one replica at a
-    time: newRc +1, wait ready, oldRc -1, repeat; then delete oldRc and
-    (optionally) rename newRc to the old name."""
+    time: newRc +1, wait ready, oldRc -1, repeat; then delete oldRc
+    (rolling_updater.go:144-145 — the new controller KEEPS its new name,
+    as the update-demo transcript shows: `stop rc update-demo-kitten`).
+    rename=True is an opt-in convenience for same-name image rolls."""
 
     def __init__(self, client, namespace: str,
                  sleep: Callable[[float], None] = time.sleep):
@@ -159,7 +161,7 @@ class RollingUpdater:
 
     def update(self, old_name: str, new_rc: api.ReplicationController,
                update_period: float = 0.0, interval: float = 0.1,
-               timeout: float = 60.0, rename: bool = True) -> api.ReplicationController:
+               timeout: float = 60.0, rename: bool = False) -> api.ReplicationController:
         rcs = self.client.resource("replicationcontrollers", self.namespace)
         old_rc = rcs.get(old_name)
         if new_rc.metadata.name == old_name:

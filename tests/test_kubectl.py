@@ -405,6 +405,12 @@ def test_rolling_update(cluster, tmp_path):
     finally:
         stop.set()
         t.join(timeout=1)
-    final = client.replication_controllers("default").get("web")
+    # the new controller KEEPS its name; the old one is deleted
+    # (ref: rolling_updater.go:144-145; examples/update-demo transcript
+    # ends with `stop rc update-demo-kitten`)
+    final = client.replication_controllers("default").get("web-v2")
     assert final.spec.template.spec.containers[0].image == "nginx:2.0"
     assert final.spec.replicas == 2
+    names = [rc.metadata.name
+             for rc in client.replication_controllers("default").list().items]
+    assert "web" not in names, names
