@@ -12,6 +12,7 @@ from __future__ import annotations
 import re
 from typing import List, Optional, Set, Tuple
 
+from kubernetes_tpu import capabilities
 from kubernetes_tpu.api import labels as labels_pkg
 from kubernetes_tpu.api import types as api
 
@@ -161,6 +162,12 @@ def _validate_containers(containers: List[api.Container], volume_names: Set[str]
         names.add(c.name)
         if not c.image:
             errs.append(_required(f"{fld}.image"))
+        if c.privileged and not capabilities.get().allow_privileged:
+            # ref: validation.go:612-613 — privileged mode is a per-binary
+            # capability (--allow_privileged), off by default
+            errs.append(ValidationError(
+                "forbidden", f"{fld}.privileged", True,
+                "privileged mode is disallowed (start with --allow-privileged)"))
         port_names: Set[str] = set()
         for pi, p in enumerate(c.ports):
             pfld = f"{fld}.ports[{pi}]"

@@ -36,6 +36,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "of an in-process store (the --etcd_servers "
                         "analog); lets several apiserver workers share one "
                         "store")
+    p.add_argument("--allow-privileged", "--allow_privileged",
+                   action="store_true",
+                   help="if set, allow containers to request privileged "
+                        "mode (ref: the reference's --allow_privileged)")
     p.add_argument("--reuse-port", "--reuse_port", action="store_true",
                    help="bind with SO_REUSEPORT so several apiserver "
                         "worker processes share one listen port")
@@ -48,6 +52,11 @@ def build_server(opts, ready_event: Optional[threading.Event] = None):
     from kubernetes_tpu.cloudprovider import get_provider
 
     from kubernetes_tpu import auth as authpkg
+    from kubernetes_tpu import capabilities
+
+    # per-binary capability gate (ref: cmd server.go:186 + capabilities.go):
+    # validation consults it when admitting privileged containers
+    capabilities.setup(getattr(opts, "allow_privileged", False))
 
     authenticators = []
     if opts.token_auth_file:

@@ -29,6 +29,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="create our Node object on startup")
     p.add_argument("--node-cpu", default="4")
     p.add_argument("--node-memory", default="8Gi")
+    p.add_argument("--allow-privileged", "--allow_privileged",
+                   action="store_true",
+                   help="if set, allow containers to request privileged "
+                        "mode (ref: the reference's --allow_privileged)")
     p.add_argument("--container-runtime", "--container_runtime",
                    default="process", choices=["process", "fake"],
                    help="process = real local process groups with the "
@@ -52,6 +56,11 @@ def build_kubelet(opts):
                                                RefusingDiskManager,
                                                new_default_plugin_mgr)
 
+    from kubernetes_tpu import capabilities
+
+    # ref: cmd/kubelet/app/server.go:333 SetupCapabilities
+    capabilities.setup(getattr(opts, "allow_privileged", False))
+
     hostname = opts.hostname_override or socket.gethostname()
     client = Client(HTTPTransport(opts.api_servers))
     recorder = EventRecorder(client, api.EventSource(component="kubelet",
@@ -71,9 +80,18 @@ def build_kubelet(opts):
     volume_mgr = new_default_plugin_mgr(opts.root_dir, kubelet_client=client,
                                         mounter=ExecMounter(),
                                         disk_manager=RefusingDiskManager())
+    # service env var injection (ref: cmd/kubelet/app/server.go wiring a
+    # cache.NewListWatchFromClient("services") into kl.serviceLister):
+    # a reflector-backed cache so pod starts never block on the apiserver
+    from kubernetes_tpu.client.cache import Reflector, Store
+
+    svc_store = Store()
+    Reflector(client.services(api.NamespaceAll).list_watch(), svc_store,
+              name="kubelet-services").run()
+
     kubelet = Kubelet(hostname, runtime, client=client, recorder=recorder,
                       resync_period=opts.sync_frequency,
-                      volume_mgr=volume_mgr)
+                      volume_mgr=volume_mgr, service_lister=svc_store.list)
 
     pod_config = PodConfig()
     sources = [ApiserverSource(pod_config, client, hostname)]

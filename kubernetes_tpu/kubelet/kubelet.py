@@ -13,15 +13,18 @@ probes, and a status push.
 
 from __future__ import annotations
 
+import dataclasses
 import datetime
 import queue
 import threading
 import time
 from typing import Dict, List, Optional
 
+from kubernetes_tpu import capabilities
 from kubernetes_tpu import probe as probe_pkg
 from kubernetes_tpu.api import errors
 from kubernetes_tpu.api import types as api
+from kubernetes_tpu.kubelet import envvars
 from kubernetes_tpu.kubelet.config import ConfigSourceAnnotation, PodConfig
 from kubernetes_tpu.kubelet.gc import ContainerGC, GCPolicy
 from kubernetes_tpu.kubelet.pod_workers import PodWorkers
@@ -50,12 +53,18 @@ class Kubelet:
                  client=None, recorder=None,
                  resync_period: float = 2.0,
                  gc_policy: Optional[GCPolicy] = None,
-                 volume_mgr=None):
+                 volume_mgr=None, service_lister=None,
+                 master_service_namespace: str = "default"):
         self.hostname = hostname
         self.runtime = runtime
         self.client = client
         self.recorder = recorder
         self.resync_period = resync_period
+        # service discovery env vars (ref: kubelet.go makeEnvironmentVariables
+        # + pkg/kubelet/envvars): a callable returning every Service, fed by
+        # a reflector cache; None disables injection (pure-fake tests)
+        self.service_lister = service_lister
+        self.master_service_namespace = master_service_namespace
         self.status_manager = StatusManager(client)
         self.pod_workers = PodWorkers(self.sync_pod)
         self.container_gc = ContainerGC(runtime, gc_policy or GCPolicy())
@@ -278,6 +287,15 @@ class Kubelet:
 
     def _start_container(self, pod: api.Pod, container: api.Container,
                          attempt: int) -> None:
+        if container.privileged and not capabilities.get().allow_privileged:
+            # ref: kubelet.go:797-802 — belt-and-braces behind validation:
+            # the node refuses even if an unvalidated source asked. Checked
+            # BEFORE the pull so a forbidden pod doesn't re-pull its image
+            # on every resync.
+            self._reject(pod, "PrivilegedDisallowed",
+                         "container requested privileged mode, "
+                         "but it is disallowed globally")
+            return
         # pull policy (ref: :1101-1120): PullAlways, or IfNotPresent+missing
         policy = container.image_pull_policy or (
             api.PullAlways if container.image.endswith(":latest")
@@ -290,11 +308,33 @@ class Kubelet:
             self._reject(pod, "ErrImageNeverPull",
                          f"image {container.image} not present with PullNever")
             return
+        container = self._with_service_env(pod, container)
         cid = self.runtime.create_container(pod, container, attempt)
         self.runtime.start_container(cid)
         if self.recorder is not None:
             self.recorder.eventf(pod, "Started", "Started container %s",
                                  container.name)
+
+    def _with_service_env(self, pod: api.Pod,
+                          container: api.Container) -> api.Container:
+        """Prepend service-discovery env vars (ref: kubelet.go:896-920
+        makeEnvironmentVariables) — the container's own declared env wins
+        on name collision, which the runtimes guarantee by applying env
+        in order (later entries overwrite)."""
+        if self.service_lister is None:
+            return container
+        try:
+            all_svcs = self.service_lister()
+        except Exception:
+            return container  # discovery must never block a pod start
+        visible = envvars.visible_services(
+            all_svcs, pod.metadata.namespace or "default",
+            master_ns=self.master_service_namespace)
+        svc_env = envvars.from_services(visible)
+        if not svc_env:
+            return container
+        return dataclasses.replace(
+            container, env=svc_env + list(container.env))
 
     # ------------------------------------------------------------------
     # probes (ref: probe.go + pkg/probe/)

@@ -1,0 +1,60 @@
+"""The per-binary privileged-mode gate (ref: pkg/capabilities +
+validation.go:612-613 + kubelet.go:797-802)."""
+
+import pytest
+
+from kubernetes_tpu import capabilities
+from kubernetes_tpu.api import types as api, validation
+from kubernetes_tpu.kubelet.kubelet import Kubelet
+from kubernetes_tpu.kubelet.runtime import FakeRuntime
+
+
+@pytest.fixture(autouse=True)
+def _reset_caps():
+    capabilities.set_for_tests(None)
+    yield
+    capabilities.set_for_tests(None)
+
+
+def priv_pod():
+    return api.Pod(
+        metadata=api.ObjectMeta(name="p", namespace="default"),
+        spec=api.PodSpec(containers=[
+            api.Container(name="c", image="img", privileged=True)]))
+
+
+def test_initialize_first_call_wins():
+    capabilities.setup(True)
+    capabilities.initialize(capabilities.Capabilities(allow_privileged=False))
+    assert capabilities.get().allow_privileged  # later call ignored
+
+
+def test_validation_rejects_privileged_by_default():
+    errs = validation.validate_pod(priv_pod())
+    assert any("privileged" in e.field for e in errs), errs
+
+
+def test_validation_allows_privileged_when_enabled():
+    capabilities.set_for_tests(
+        capabilities.Capabilities(allow_privileged=True))
+    assert not validation.validate_pod(priv_pod())
+
+
+def test_kubelet_refuses_privileged_globally():
+    # belt-and-braces at the node: an unvalidated source (file manifest)
+    # asking for privileged mode is rejected, not started
+    rt = FakeRuntime()
+    rt.pull_image("img")
+    kl = Kubelet("n1", rt)
+    kl._start_container(priv_pod(), priv_pod().spec.containers[0], attempt=0)
+    assert not rt.list_containers()
+
+
+def test_kubelet_starts_privileged_when_allowed():
+    capabilities.set_for_tests(
+        capabilities.Capabilities(allow_privileged=True))
+    rt = FakeRuntime()
+    rt.pull_image("img")
+    kl = Kubelet("n1", rt)
+    kl._start_container(priv_pod(), priv_pod().spec.containers[0], attempt=0)
+    assert len(rt.list_containers()) == 1

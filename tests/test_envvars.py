@@ -1,0 +1,103 @@
+"""Service-discovery env var injection (ref: pkg/kubelet/envvars +
+kubelet.go getServiceEnvVarMap/makeEnvironmentVariables)."""
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.kubelet import envvars
+from kubernetes_tpu.kubelet.kubelet import Kubelet
+from kubernetes_tpu.kubelet.runtime import FakeRuntime
+
+
+def svc(name, ns="default", ip="10.0.0.5", port=8080, protocol=""):
+    return api.Service(
+        metadata=api.ObjectMeta(name=name, namespace=ns),
+        spec=api.ServiceSpec(port=port, portal_ip=ip,
+                             **({"protocol": protocol} if protocol else {})))
+
+
+def as_map(evs):
+    return {e.name: e.value for e in evs}
+
+
+def test_from_services_var_family():
+    m = as_map(envvars.from_services([svc("redis-master")]))
+    # the SERVICE_* pair
+    assert m["REDIS_MASTER_SERVICE_HOST"] == "10.0.0.5"
+    assert m["REDIS_MASTER_SERVICE_PORT"] == "8080"
+    # the docker-links family (envvars.go makeLinkVariables)
+    assert m["REDIS_MASTER_PORT"] == "tcp://10.0.0.5:8080"
+    assert m["REDIS_MASTER_PORT_8080_TCP"] == "tcp://10.0.0.5:8080"
+    assert m["REDIS_MASTER_PORT_8080_TCP_PROTO"] == "tcp"
+    assert m["REDIS_MASTER_PORT_8080_TCP_PORT"] == "8080"
+    assert m["REDIS_MASTER_PORT_8080_TCP_ADDR"] == "10.0.0.5"
+
+
+def test_from_services_skips_portal_less():
+    # no portal IP -> nothing routable to advertise (envvars.go:36-40)
+    assert envvars.from_services([svc("s", ip="")]) == []
+    assert envvars.from_services([svc("s", ip="None")]) == []
+
+
+def test_from_services_udp_protocol():
+    m = as_map(envvars.from_services([svc("dns", protocol="UDP", port=53)]))
+    assert m["DNS_PORT"] == "udp://10.0.0.5:53"
+    assert m["DNS_PORT_53_UDP_PROTO"] == "udp"
+
+
+def test_visible_services_namespace_scoping():
+    # ref kubelet.go:857-893 — own namespace, plus unshadowed master services
+    all_svcs = [
+        svc("app", ns="prod", ip="10.0.0.1"),
+        svc("app", ns="dev", ip="10.0.0.2"),
+        svc("kubernetes", ns="default", ip="10.0.0.3"),
+        svc("kubernetes-ro", ns="default", ip="10.0.0.4"),
+        svc("other", ns="default", ip="10.0.0.9"),
+    ]
+    vis = {s.metadata.name: s for s in
+           envvars.visible_services(all_svcs, "prod")}
+    assert vis["app"].spec.portal_ip == "10.0.0.1"
+    assert set(vis) == {"app", "kubernetes", "kubernetes-ro"}
+
+    # a local service SHADOWS a same-named master service
+    shadowed = all_svcs + [svc("kubernetes", ns="prod", ip="10.9.9.9")]
+    vis = {s.metadata.name: s for s in
+           envvars.visible_services(shadowed, "prod")}
+    assert vis["kubernetes"].spec.portal_ip == "10.9.9.9"
+
+
+def test_kubelet_merges_service_env_container_wins():
+    lister = lambda: [svc("redis")]  # noqa: E731
+    kl = Kubelet("n1", FakeRuntime(), service_lister=lister)
+    pod = api.Pod(metadata=api.ObjectMeta(name="p", namespace="default"))
+    container = api.Container(
+        name="c", image="img",
+        env=[api.EnvVar(name="REDIS_SERVICE_HOST", value="override"),
+             api.EnvVar(name="MINE", value="1")])
+    merged = kl._with_service_env(pod, container)
+    # service vars are PREPENDED so the container's own env wins when the
+    # runtime applies entries in order (later overwrites)
+    names = [e.name for e in merged.env]
+    assert names.index("REDIS_SERVICE_HOST") < names.index("MINE")
+    applied = {}
+    for e in merged.env:
+        applied[e.name] = e.value
+    assert applied["REDIS_SERVICE_HOST"] == "override"
+    assert applied["REDIS_SERVICE_PORT"] == "8080"
+    assert applied["MINE"] == "1"
+    # the original container object is untouched (no aliasing surprises)
+    assert len(container.env) == 2
+
+
+def test_kubelet_without_lister_is_noop():
+    kl = Kubelet("n1", FakeRuntime())
+    pod = api.Pod(metadata=api.ObjectMeta(name="p"))
+    c = api.Container(name="c", image="img")
+    assert kl._with_service_env(pod, c) is c
+
+
+def test_kubelet_lister_failure_never_blocks_start():
+    def boom():
+        raise RuntimeError("apiserver down")
+    kl = Kubelet("n1", FakeRuntime(), service_lister=boom)
+    pod = api.Pod(metadata=api.ObjectMeta(name="p"))
+    c = api.Container(name="c", image="img")
+    assert kl._with_service_env(pod, c) is c
