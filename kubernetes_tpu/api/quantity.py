@@ -101,11 +101,16 @@ class Quantity:
     # -- arithmetic ---------------------------------------------------------
     def __add__(self, other):
         other = Quantity(other)
-        return Quantity(self.value + other.value, self.format)
+        # a zero accumulator adopts the operand's format so that
+        # Quantity("0") + Quantity("64Mi") prints "64Mi", not raw bytes
+        # (quota usage strings stay human-canonical)
+        fmt = self.format if self.value else other.format
+        return Quantity(self.value + other.value, fmt)
 
     def __sub__(self, other):
         other = Quantity(other)
-        return Quantity(self.value - other.value, self.format)
+        fmt = self.format if self.value else other.format
+        return Quantity(self.value - other.value, fmt)
 
     def __neg__(self):
         return Quantity(-self.value, self.format)

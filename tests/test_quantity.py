@@ -72,3 +72,13 @@ def test_int_value_rounds_up():
 def test_parse_errors(bad):
     with pytest.raises(QuantityError):
         Quantity(bad)
+
+
+def test_zero_accumulator_adopts_operand_format():
+    # quota usage starts from Quantity("0"); summing binary-suffix
+    # quantities must stay human-canonical, not decay to raw bytes
+    assert str(Quantity("0") + Quantity("64Mi")) == "64Mi"
+    assert str(Quantity("0") + Quantity("100m")) == "100m"
+    assert str(Quantity("128Mi") - Quantity("64Mi")) == "64Mi"
+    # a non-zero accumulator keeps its own format
+    assert str(Quantity("1Gi") + Quantity("512Mi")) == "1536Mi"
