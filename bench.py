@@ -536,6 +536,34 @@ def timed_wave(nodes, existing, pending, services, batch_policy=None,
         jax.block_until_ready(out)
         compile_s = time.perf_counter() - t0
 
+    def one_wave():
+        """The FULL wave pipeline, exactly as a live scheduler runs it:
+        encode, then ship with no sync between transfer and solve (the
+        dispatch pipelines the uploads into the device call — one tunnel
+        round-trip per wave instead of two; the decision readback is the
+        sync), then the gang post-pass. Returns (snap, decisions,
+        encode_end_t)."""
+        snap = encode_snapshot(nodes, existing, pending, services,
+                               policy=batch_policy)
+        t_enc = time.perf_counter()
+        inp = ship_inputs(snapshot_to_host_inputs(snap), plan.device)
+        chosen, _scores = solve_device(inp, snap.policy, gangs, peer_bound,
+                                       force_scan=force_scan)
+        chosen_np = np.asarray(chosen)      # device->host readback (sync)
+        if gangs:
+            chosen_np = gang_mod.apply_all_or_nothing(snap.pod_rid, chosen_np)
+        return snap, chosen_np, t_enc
+
+    # -- one untimed COLD pipelined pass ------------------------------------
+    # The pipelined dispatch shape has its own one-time settling on the
+    # tunnel, distinct from the sequential warmup above — measured at ~6s
+    # on the first north-star wave while every later wave is ~0.3s. A live
+    # scheduler pays it once per process; pay and log it here so the timed
+    # distribution is pure steady state.
+    t0 = time.perf_counter()
+    one_wave()
+    cold_pipeline_s = time.perf_counter() - t0
+
     # -- timed steady-state runs: the whole pipeline in the clock -----------
     if profile:
         jax.profiler.start_trace(profile)
@@ -543,19 +571,7 @@ def timed_wave(nodes, existing, pending, services, batch_policy=None,
     chosen_np = None
     for _ in range(runs):
         t0 = time.perf_counter()
-        snap = encode_snapshot(nodes, existing, pending, services,
-                               policy=batch_policy)
-        t1 = time.perf_counter()
-        # no sync between transfer and solve: dispatch pipelines the
-        # uploads into the device call (one tunnel round-trip per wave
-        # instead of two — exactly what a live scheduler does); the
-        # decision readback is the sync
-        inp = ship_inputs(snapshot_to_host_inputs(snap), plan.device)
-        chosen, scores = solve_device(inp, snap.policy, gangs, peer_bound,
-                                      force_scan=force_scan)
-        chosen_np = np.asarray(chosen)      # device->host readback (sync)
-        if gangs:
-            chosen_np = gang_mod.apply_all_or_nothing(snap.pod_rid, chosen_np)
+        snap, chosen_np, t1 = one_wave()
         t2 = time.perf_counter()
         wave_runs.append(t2 - t0)
         parts.append((t1 - t0, t2 - t1))
@@ -592,11 +608,12 @@ def timed_wave(nodes, existing, pending, services, batch_policy=None,
         "device_s": round(device_s, 4),
         "scheduled": int((chosen_np[:n] >= 0).sum()),
     }
+    res["cold_pipeline_s"] = round(cold_pipeline_s, 3)
     if calibrated:
         res["router_host_s"] = round(plan.host_s, 4)
         res["router_device_s"] = round(plan.device_s, 4)
         res["router_cal_s"] = round(router_s, 2)
-        res["cold_pipeline_s"] = round(plan.cold_s, 3)
+        res["router_cold_s"] = round(plan.cold_s, 3)
     else:
         res["compile_s"] = round(compile_s, 3)
         res["shape_setup_s"] = round(shape_setup_s, 3)
