@@ -536,17 +536,22 @@ def timed_wave(nodes, existing, pending, services, batch_policy=None,
         jax.block_until_ready(out)
         compile_s = time.perf_counter() - t0
 
-    def one_wave():
+    def one_wave(pre=None):
         """The FULL wave pipeline, exactly as a live scheduler runs it:
         encode, then ship with no sync between transfer and solve (the
         dispatch pipelines the uploads into the device call — one tunnel
         round-trip per wave instead of two; the decision readback is the
-        sync), then the gang post-pass. Returns (snap, decisions,
-        encode_end_t)."""
-        snap = encode_snapshot(nodes, existing, pending, services,
-                               policy=batch_policy)
+        sync), then the gang post-pass. ``pre=(snap, host_inputs)`` skips
+        the encode (the double-buffered loop encodes on a side thread).
+        Returns (snap, decisions, encode_end_t)."""
+        if pre is None:
+            snap = encode_snapshot(nodes, existing, pending, services,
+                                   policy=batch_policy)
+            host = snapshot_to_host_inputs(snap)
+        else:
+            snap, host = pre
         t_enc = time.perf_counter()
-        inp = ship_inputs(snapshot_to_host_inputs(snap), plan.device)
+        inp = ship_inputs(host, plan.device)
         chosen, _scores = solve_device(inp, snap.policy, gangs, peer_bound,
                                        force_scan=force_scan)
         chosen_np = np.asarray(chosen)      # device->host readback (sync)
@@ -579,6 +584,34 @@ def timed_wave(nodes, existing, pending, services, batch_policy=None,
         jax.profiler.stop_trace()
         log(f"jax.profiler trace written to {profile}")
 
+    # -- double-buffered throughput: encode wave k+1 WHILE wave k solves ----
+    # A live batch scheduler's waves are independent snapshots, so the host
+    # can encode the next wave while the device (and the tunnel) work on
+    # the current one — steady-state cost per wave becomes
+    # max(encode, transfer+solve+readback) instead of their sum. The device
+    # wait releases the GIL inside jax, so one encode-ahead thread is
+    # enough. Decisions are identical (same snapshot per wave); this
+    # measures THROUGHPUT, while wave_s/p99 above remain the per-wave
+    # LATENCY a single decision observes.
+    import concurrent.futures as _cf
+
+    def encode_next():
+        snap = encode_snapshot(nodes, existing, pending, services,
+                               policy=batch_policy)
+        return snap, snapshot_to_host_inputs(snap)
+
+    pipelined_wave_s = None
+    if plan.path == "device":
+        with _cf.ThreadPoolExecutor(max_workers=1) as ex:
+            fut = ex.submit(encode_next)
+            t_start = time.perf_counter()
+            for k in range(runs):
+                pre = fut.result()
+                if k + 1 < runs:                  # overlaps the solve below
+                    fut = ex.submit(encode_next)
+                one_wave(pre=pre)
+            pipelined_wave_s = (time.perf_counter() - t_start) / runs
+
     srt = sorted(wave_runs)
     p50, p95, p99 = (float(v) for v in
                      np.percentile(wave_runs, [50.0, 95.0, 99.0]))
@@ -609,6 +642,14 @@ def timed_wave(nodes, existing, pending, services, batch_policy=None,
         "scheduled": int((chosen_np[:n] >= 0).sum()),
     }
     res["cold_pipeline_s"] = round(cold_pipeline_s, 3)
+    if pipelined_wave_s is not None:
+        # throughput headroom under double-buffering, reported alongside —
+        # `value` stays the median sequential wave (the shipped
+        # BatchScheduler runs waves sequentially today; the pipelined rate
+        # becomes claimable as `value` only when the driver itself
+        # double-buffers)
+        res["pipelined_wave_s"] = round(pipelined_wave_s, 4)
+        res["pipelined_pods_per_sec"] = round(n / pipelined_wave_s, 1)
     if calibrated:
         res["router_host_s"] = round(plan.host_s, 4)
         res["router_device_s"] = round(plan.device_s, 4)
@@ -728,12 +769,15 @@ def run_solver_config(tag, n_nodes, n_pods, gate_nodes=0, gate_pods=0,
         log(f"[{tag}] all-or-nothing invariant OK: "
             f"{placed}/{gang_groups} groups fully placed")
 
+    pipe = (f"; pipelined {res['pipelined_wave_s']:.3f}s/wave = "
+            f"{res['pipelined_pods_per_sec']:.0f} pods/s"
+            if "pipelined_wave_s" in res else "")
     log(f"[{tag}] wave {res['wave_s']:.3f}s over {res['runs']} runs "
         f"(p95 {res['wave_s_p95']:.3f} p99 {res['wave_s_p99']:.3f} "
         f"max {res['wave_s_max']:.3f}; path={res['path']}) "
         f"= encode {res['encode_s']:.3f} "
         f"+ device(transfer+solve+readback) {res['device_s']:.4f}; "
-        f"{res['value']:.0f} pods/s; "
+        f"{res['value']:.0f} pods/s{pipe}; "
         f"scheduled {res['scheduled']}/{res['pods']}")
     return res
 
