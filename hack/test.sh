@@ -24,7 +24,8 @@ if [[ "$RACE" == 1 ]]; then
     SUITES=(tests/test_contention.py tests/test_storage.py
             tests/test_remote_store.py tests/test_cache.py
             tests/test_http.py tests/test_stale_wave.py
-            tests/test_websocket_pprof.py tests/test_cloudprovider.py)
+            tests/test_websocket_pprof.py tests/test_cloudprovider.py
+            tests/test_envvars.py tests/test_capabilities.py)
     rc=0
     for ((i = 1; i <= ROUNDS; i++)); do
         echo "=== race round ${i}/${ROUNDS} (switchinterval=1e-6) ==="
