@@ -71,3 +71,57 @@ def test_manifest_decodes_validates_roundtrips(path):
     reencoded = latest.scheme.encode_to_wire(obj, "v1")
     back = latest.scheme.decode_from_wire(reencoded)
     assert back == obj, f"{path}: v1 round-trip changed the object"
+
+
+def _mutate(v):
+    if isinstance(v, bool):
+        return not v
+    if isinstance(v, (int, float)):
+        return v + 1
+    if isinstance(v, str):
+        return v + "x"
+    return None
+
+
+@pytest.mark.parametrize("path", MANIFESTS,
+                         ids=[os.path.relpath(p, REPO) for p in MANIFESTS])
+def test_every_manifest_field_is_load_bearing(path):
+    """Round-trip equality can't see a field DROPPED at decode (the object
+    simply never had it). Probe instead: flip each user-written leaf and
+    assert the decoded object changes (or decode rejects the mutant) —
+    every field in a shipped example must actually reach the API object."""
+    if os.path.basename(path) == "inventory.json":
+        pytest.skip("cloud-provider inventory, not an API object")
+    with open(path) as f:
+        wire = json.load(f)
+    base = latest.scheme.decode_from_wire(wire)
+
+    def walk(node, breadcrumbs=()):
+        """Yield (breadcrumbs, leaf) pairs, one per scalar leaf."""
+        if isinstance(node, dict):
+            for k, v in node.items():
+                yield from walk(v, breadcrumbs + (k,))
+        elif isinstance(node, list):
+            for i, v in enumerate(node):
+                yield from walk(v, breadcrumbs + (i,))
+        else:
+            yield breadcrumbs, node
+
+    for crumbs, leaf in walk(wire):
+        if crumbs[-1] in ("apiVersion", "kind"):
+            continue  # scheme routing, not object fields
+        flipped = _mutate(leaf)
+        if flipped is None:
+            continue
+        mutant = json.loads(json.dumps(wire))
+        cur = mutant
+        for c in crumbs[:-1]:
+            cur = cur[c]
+        cur[crumbs[-1]] = flipped
+        try:
+            got = latest.scheme.decode_from_wire(mutant)
+        except Exception:
+            continue  # rejected: the field was certainly read
+        assert got != base, (
+            f"{path}: field {'.'.join(map(str, crumbs))} is silently "
+            f"dropped at decode (mutating it changed nothing)")
