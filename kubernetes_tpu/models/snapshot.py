@@ -218,19 +218,35 @@ def encode_snapshot(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
     CPU = api.ResourceCPU
 
     def container_rows(pods):
+        # Derived rows cache on the spec object: a PodSpec's containers are
+        # immutable once stored (the repo-wide read-only-store-objects
+        # invariant — mutations go through deep_clone, which drops
+        # undeclared attributes), so the (resource, value) rows and host
+        # ports are computed once per pod LIFETIME, not once per wave. A
+        # live scheduler re-encodes the same reflector-store objects every
+        # wave, so this is exactly the hit rate production sees. The
+        # per-wave resource-universe bookkeeping (seen/request_only) still
+        # runs over the cached rows — it is wave-local.
         limits, ports = [], []
         for p in pods:
-            lr, pr = [], []
-            for c in p.spec.containers:
-                for name, q in c.resources.limits.items():
-                    if name not in seen:
-                        seen.add(name)
-                        request_only.append(name)
-                    lr.append((name, q.milli_value() if name == CPU
-                               else q.int_value()))
-                for cp in c.ports:
-                    if cp.host_port:
-                        pr.append(cp.host_port)
+            spec = p.spec
+            cached = spec.__dict__.get("_ktpu_rows")
+            if cached is None:
+                lr, pr = [], []
+                for c in spec.containers:
+                    for name, q in c.resources.limits.items():
+                        lr.append((name, q.milli_value() if name == CPU
+                                   else q.int_value()))
+                    for cp in c.ports:
+                        if cp.host_port:
+                            pr.append(cp.host_port)
+                cached = (lr, pr)
+                spec.__dict__["_ktpu_rows"] = cached
+            lr, pr = cached
+            for name, _v in lr:
+                if name not in seen:
+                    seen.add(name)
+                    request_only.append(name)
             limits.append(lr)
             ports.append(pr)
         return limits, ports
