@@ -197,6 +197,15 @@ class ListWatch:
         self.watch_fn = watch_fn
 
 
+def _join_thread(t: Optional[threading.Thread],
+                 timeout: Optional[float]) -> bool:
+    """True once the thread is down (or was never started)."""
+    if t is None:
+        return True
+    t.join(timeout)
+    return not t.is_alive()
+
+
 class Reflector:
     """Mirrors a resource into a Store via list+watch (ref: reflector.go:43-91).
 
@@ -223,6 +232,13 @@ class Reflector:
 
     def stop(self) -> None:
         self._stop.set()
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        """Wait for the run loop to exit after stop(). Returns True once the
+        thread is down — after which no further event can be applied to the
+        store (the graceful-shutdown contract callers need to freeze a
+        cache deterministically)."""
+        return _join_thread(self._thread, timeout)
 
     def _run_loop(self) -> None:
         while not self._stop.is_set():
@@ -283,10 +299,12 @@ class Poller:
         self.period = period
         self.store = store
         self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
 
     def run(self) -> "Poller":
         self._run_once()
         t = threading.Thread(target=self._loop, daemon=True, name="poller")
+        self._thread = t
         t.start()
         return self
 
@@ -303,6 +321,10 @@ class Poller:
 
     def stop(self):
         self._stop.set()
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        """Wait for the poll loop to exit after stop() (see Reflector.join)."""
+        return _join_thread(self._thread, timeout)
 
 
 # -- typed listers (ref: listers.go) ---------------------------------------

@@ -332,9 +332,21 @@ class ConfigFactory:
             policy=policy,
         )
 
-    def stop(self) -> None:
+    def stop(self, join: bool = False, timeout: float = 2.0) -> bool:
+        """Stop every reflector/poller. With ``join=True``, wait for their
+        threads to exit so no in-flight watch delivery can land in the
+        stores afterwards — the deterministic-freeze contract the
+        stale-wave tests rely on. Returns False iff a join timed out
+        (the freeze is then NOT guaranteed)."""
         for r in self._runners:
             r.stop()
+        frozen = True
+        if join:
+            for r in self._runners:
+                joiner = getattr(r, "join", None)
+                if joiner is not None and not joiner(timeout):
+                    frozen = False
+        return frozen
 
     def _next_pod(self, timeout: Optional[float] = None) -> api.Pod:
         """ref: factory.go:164-168 — blocking FIFO pop."""

@@ -84,9 +84,13 @@ def test_stale_wave_overcommit_rejected_by_kubelet_readmission(stack):
         assert wait_for(lambda: len(fb.pod_queue.list()) == 2
                         and len(fb.node_store.list()) == 1)
 
-        # freeze B on that snapshot (reflectors stop; stores stay stale),
-        # and steer its wave to p2 by draining p1 from its queue
-        fb.stop()
+        # freeze B on that snapshot DETERMINISTICALLY: stop + JOIN the
+        # reflector threads, so no in-flight watch delivery (e.g. A's
+        # bind of p1, below) can land in B's stores afterwards — without
+        # the join, B could observe the bind, correctly refuse p2 for
+        # capacity, and break the staleness premise. Then steer B's wave
+        # to p2 by draining p1 from its queue.
+        assert fb.stop(join=True), "reflector threads did not stop in time"
         drained = fb.pod_queue.pop(timeout=1.0)
         assert drained.metadata.name == "p1"
 
@@ -144,12 +148,11 @@ def test_cas_loser_is_not_requeued_when_pod_already_scheduled(stack):
                         and len(fa.node_store.list()) == 1)
         assert wait_for(lambda: len(fb.pod_queue.list()) == 1
                         and len(fb.node_store.list()) == 1)
-        # snapshot B's stale view of q1 BEFORE the bind; fb.stop() stops
-        # the reflectors, but an already-in-flight watch delivery may
-        # still drain B's queue, so the stale pod is re-injected below to
-        # pin the scenario deterministically
+        # snapshot B's stale view of q1 BEFORE the bind; stop+join freezes
+        # the stores deterministically, and the stale pod is re-injected
+        # below in case the drain landed before the join
         stale_q1 = fb.pod_queue.list()[0]
-        fb.stop()
+        assert fb.stop(join=True), "reflector threads did not stop in time"
 
         assert sa.schedule_wave(timeout=1.0) == 1
         assert client.pods().get("q1").spec.host == "node-1"
