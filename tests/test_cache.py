@@ -202,3 +202,27 @@ def test_pod_and_service_listers():
     slister = StoreServiceLister(services)
     got = slister.get_pod_services(_pod("a", labels={"app": "web"}))
     assert [s.metadata.name for s in got] == ["web"]  # namespace-scoped
+
+
+def test_reflector_stop_join_freezes_store():
+    """The post-join freeze contract: once stop()+join() returns True the
+    run loop has exited, so no event written to the source afterwards can
+    ever land in the store (what the stale-wave tests rely on to freeze a
+    scheduler's view deterministically)."""
+    h, lw = _cluster_source()
+    store = Store()
+    r = Reflector(lw, store, name="pods").run()
+    try:
+        h.create_obj("/pods/default/a", _pod("a"))
+        assert _wait_for(lambda: store.get_by_key("default/a") is not None)
+    finally:
+        r.stop()
+    assert r.join(5.0), "reflector thread did not exit"
+    # join(True) means the thread is DEAD — a write after it can never be
+    # applied, no grace sleep needed
+    h.create_obj("/pods/default/late", _pod("late"))
+    assert store.get_by_key("default/late") is None
+    assert store.get_by_key("default/a") is not None
+    # join is idempotent and True on a never-started reflector too
+    assert r.join(0.1)
+    assert Reflector(lw, Store(), name="never-run").join(0.1)
