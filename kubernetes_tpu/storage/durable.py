@@ -187,7 +187,11 @@ class DurableStore(MemStore):
         if os.path.exists(snap_path):
             with open(snap_path, encoding="utf-8") as f:
                 snap = json.load(f)
-            self._index = snap["index"]
+            # clamp to the base-1 floor: a snapshot written by a pre-base-1
+            # tree while empty carries index 0, which would reinstate the
+            # bootstrap lost-event window (index 0 is the reserved
+            # "from now" watch token — see MemStore.__init__)
+            self._index = max(1, snap["index"])
             self._snap_index_guard = snap["index"]
             for d in snap["kvs"]:
                 kv = KV(d["k"], d["v"], d["c"], d["m"],

@@ -100,7 +100,16 @@ class MemStore:
         # costs O(1) instead of a full-store scan (entries may be stale
         # after rewrites; validated against the live KV when popped)
         self._ttl_heap: List[Tuple[float, str]] = []
-        self._index = 0
+        # Index 0 is RESERVED as the "from now" watch token (rv '0'/'' —
+        # parse_watch_resource_version). Starting the store at 1 means an
+        # empty-store LIST returns 1, a true resume token: watch(1)
+        # replays any write that raced between the list and the watch
+        # registration. Starting at 0 had a lost-event window at cluster
+        # bootstrap — list on the fresh store returned 0, watch(0) meant
+        # "from now", and a write landing between them vanished (found by
+        # hack/test.sh --race: the reflector-into-FIFO probe timing out
+        # with the pump parked on an empty raw queue).
+        self._index = 1
         self._history: List[StoreEvent] = []
         self._clock = clock
         # test error injection: (op, key) -> exception to raise, one-shot list

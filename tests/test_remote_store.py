@@ -35,20 +35,20 @@ def remote():
 
 def test_crud_and_errors(remote):
     kv = remote.create("/r/a", "1")
-    assert (kv.key, kv.value, kv.modified_index) == ("/r/a", "1", 1)
+    assert (kv.key, kv.value, kv.modified_index) == ("/r/a", "1", 2)
     with pytest.raises(ErrKeyExists):
         remote.create("/r/a", "x")
     kv2 = remote.compare_and_swap("/r/a", "2", kv.modified_index)
-    assert kv2.modified_index == 2 and kv2.created_index == 1
+    assert kv2.modified_index == 3 and kv2.created_index == 2
     with pytest.raises(ErrCASConflict):
-        remote.compare_and_swap("/r/a", "x", 1)
+        remote.compare_and_swap("/r/a", "x", 2)
     with pytest.raises(ErrKeyNotFound):
         remote.get("/r/missing")
     with pytest.raises(ErrKeyNotFound):
         remote.delete("/r/missing")
     kvs, index = remote.list("/r")
-    assert [k.value for k in kvs] == ["2"] and index == 2
-    assert remote.index == 2
+    assert [k.value for k in kvs] == ["2"] and index == 3
+    assert remote.index == 3
     assert remote.delete("/r/a").value == "2"
 
 
@@ -62,7 +62,7 @@ def test_get_many_and_cas_many(remote):
         ("/m/b", "2", 999),          # stale -> conflict
         ("/m/c", "2", 1),            # absent -> not found
     ])
-    assert out[0].modified_index == 3
+    assert out[0].modified_index == 4
     assert isinstance(out[1], ErrCASConflict)
     assert isinstance(out[2], ErrKeyNotFound)
 
@@ -77,9 +77,9 @@ def test_watch_stream_and_resume(remote):
     assert e2.object.action == "set" and e2.object.prev_kv.value == "1"
     w.stop()
     # resume from index replays history after that index
-    w2 = remote.watch("/w", from_index=1)
+    w2 = remote.watch("/w", from_index=e1.object.index)
     e = next(iter(w2))
-    assert e.object.index == 2 and e.object.kv.value == "2"
+    assert e.object.index == e1.object.index + 1 and e.object.kv.value == "2"
     w2.stop()
 
 

@@ -27,13 +27,13 @@ from kubernetes_tpu.storage.memstore import (
 def test_create_get_list_delete():
     s = MemStore()
     kv = s.create("/pods/default/a", "1")
-    assert kv.modified_index == 1
+    assert kv.modified_index == 2  # index 1 is the fresh store's reserved base
     assert s.get("/pods/default/a").value == "1"
     s.create("/pods/default/b", "2")
     s.create("/pods/other/c", "3")
     kvs, index = s.list("/pods/default")
     assert [k.value for k in kvs] == ["1", "2"]
-    assert index == 3
+    assert index == 4
     s.delete("/pods/default/a")
     with pytest.raises(ErrKeyNotFound):
         s.get("/pods/default/a")
@@ -62,8 +62,8 @@ def test_index_monotonic_across_keys():
     a = s.create("/a", "1")
     b = s.create("/b", "1")
     c = s.set("/a", "2")
-    assert (a.modified_index, b.modified_index, c.modified_index) == (1, 2, 3)
-    assert s.index == 3
+    assert (a.modified_index, b.modified_index, c.modified_index) == (2, 3, 4)
+    assert s.index == 4
 
 
 def test_ttl_expiry():
@@ -78,9 +78,9 @@ def test_ttl_expiry():
 
 def test_watch_from_now_and_replay():
     s = MemStore()
-    s.create("/p/a", "1")
-    # from_index: replay history after index 1
-    w = s.watch("/p", from_index=1)
+    kv = s.create("/p/a", "1")
+    # from_index: replay history after the create
+    w = s.watch("/p", from_index=kv.modified_index)
     s.set("/p/a", "2")
     ev = w.next_event(timeout=1)
     assert ev.type == "set" and ev.object.kv.value == "2"
@@ -146,10 +146,10 @@ def _pod(name="p", ns="default", host=""):
 def test_helper_create_and_extract():
     h = _helper()
     out = h.create_obj("/pods/default/p", _pod())
-    assert out.metadata.resource_version == "1"
+    assert out.metadata.resource_version == "2"  # first write on a base-1 store
     got = h.extract_obj("/pods/default/p")
     assert got.metadata.name == "p"
-    assert got.metadata.resource_version == "1"
+    assert got.metadata.resource_version == "2"
     with pytest.raises(errors.StatusError) as ei:
         h.create_obj("/pods/default/p", _pod())
     assert errors.is_already_exists(ei.value)
@@ -177,7 +177,7 @@ def test_helper_extract_to_list():
     h.create_obj("/pods/default/b", _pod("b"))
     lst = h.extract_to_list("/pods/default", api.PodList)
     assert [p.metadata.name for p in lst.items] == ["a", "b"]
-    assert lst.metadata.resource_version == "2"
+    assert lst.metadata.resource_version == "3"
 
 
 def test_atomic_update_retries_on_conflict():
@@ -241,10 +241,11 @@ def test_helper_watch_decoded_stream():
 def test_helper_watch_resume_from_rv():
     h = _helper()
     out = h.create_obj("/pods/default/a", _pod("a"))
+    created_rv = str(out.metadata.resource_version)
     out.status.phase = api.PodRunning
     h.set_obj("/pods/default/a", out)
     # resume after create: must deliver the MODIFIED event
-    w = h.watch("/pods", resource_version="1")
+    w = h.watch("/pods", resource_version=created_rv)
     ev = w.next_event(timeout=1)
     assert ev.type == watchpkg.MODIFIED
     assert ev.object.status.phase == api.PodRunning
