@@ -290,3 +290,27 @@ def test_concurrent_atomic_updates():
     for t in threads:
         t.join()
     assert h.extract_obj("/rc").spec.replicas == 10
+
+
+def test_empty_store_list_rv_is_a_true_resume_token():
+    """The bootstrap lost-event window, pinned deterministically: a write
+    landing BETWEEN a reflector's LIST and its WATCH registration must be
+    replayed when watching from the list's rv — including on a fresh,
+    empty store. Before the base-1 index fix, an empty store listed at 0,
+    watch(0) meant "from now", and the write vanished (found by
+    hack/test.sh --race; see hack/race-report.md)."""
+    s = MemStore()
+    kvs, index = s.list("/pods")
+    assert kvs == []
+    # simulate the race: the write lands after the list, before the watch
+    s.create("/pods/default/first", "x")
+    w = s.watch("/pods", from_index=index)
+    ev = w.next_event(timeout=1)
+    assert ev.type == "create" and ev.object.kv.value == "x"
+    w.stop()
+    # and index 0 still means "from now": no replay
+    w2 = s.watch("/pods", from_index=0)
+    s.set("/pods/default/first", "y")
+    ev2 = w2.next_event(timeout=1)
+    assert ev2.type == "set" and ev2.object.kv.value == "y"
+    w2.stop()
