@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import json
 import logging
+import re
 import socket
 import threading
 import time
@@ -261,6 +262,69 @@ class _Handler(BaseHTTPRequestHandler):
     def do_DELETE(self):
         self._route("DELETE")
 
+    def do_OPTIONS(self):
+        # CORS preflight (ref: handlers.go:140-144): an allowed origin gets
+        # its headers and stops at 204; anything else keeps the pre-CORS
+        # behavior — a plain 501 Unsupported method, never dispatched
+        apisrv = self.server.api  # type: ignore[attr-defined]
+        started = time.monotonic()
+        resource = ([p for p in self.path.split("/") if p] + ["", "", ""])[2]
+        self._read_body()  # keep-alive hygiene, like _route
+        if self._cors_check():
+            code = 204
+            self.send_response(code)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+        else:
+            code = 501
+            self.send_error(code, "Unsupported method ('OPTIONS')")
+        # preflights are real traffic: browsers send one before every
+        # non-simple request — record them like every other response
+        apisrv.metric_requests.inc("options", resource,
+                                   self.client_address[0], str(code))
+        apisrv.metric_latency.observe(time.monotonic() - started,
+                                      "options", resource)
+        _httplog.log(logging.DEBUG, "OPTIONS %s -> %d from %s",
+                     self.path, code, self.client_address[0])
+
+    # ----- CORS (ref: pkg/apiserver/handlers.go CORS) ---------------------
+
+    _CORS_METHODS = "POST, GET, OPTIONS, PUT, DELETE"
+    _CORS_HEADERS = ("Content-Type, Content-Length, Accept-Encoding, "
+                     "X-CSRF-Token, Authorization, X-Requested-With, "
+                     "If-Modified-Since")
+
+    def _cors_check(self) -> bool:
+        """Remember the request Origin when it matches the allow-list; the
+        end_headers hook then stamps the CORS headers on whatever response
+        the handler writes."""
+        self._cors_origin = None
+        patterns = self.server.api.cors_patterns  # type: ignore[attr-defined]
+        self._cors_enabled = bool(patterns)
+        if not patterns:
+            return False
+        origin = self.headers.get("Origin") or ""
+        if origin and any(p.search(origin) for p in patterns):
+            self._cors_origin = origin
+            return True
+        return False
+
+    def end_headers(self):
+        if getattr(self, "_cors_enabled", False):
+            # responses differ by Origin whenever CORS is on (headers
+            # present vs absent, and the reflected origin value): caches
+            # must key on it or one origin's variant poisons another's
+            self.send_header("Vary", "Origin")
+            self._cors_enabled = False
+        origin = getattr(self, "_cors_origin", None)
+        if origin:
+            self.send_header("Access-Control-Allow-Origin", origin)
+            self.send_header("Access-Control-Allow-Methods", self._CORS_METHODS)
+            self.send_header("Access-Control-Allow-Headers", self._CORS_HEADERS)
+            self.send_header("Access-Control-Allow-Credentials", "true")
+            self._cors_origin = None  # once per response
+        super().end_headers()
+
     # ----- routing --------------------------------------------------------
 
     def _route(self, method: str):
@@ -277,6 +341,7 @@ class _Handler(BaseHTTPRequestHandler):
             if k not in query:
                 query[k] = v
         parts = [p for p in parsed.path.split("/") if p]
+        self._cors_check()   # stamps headers on the response if allowed
         code = 200
         verb_label = method.lower()
         self._metric_resource = (parts + ["", "", ""])[2]
@@ -645,8 +710,11 @@ class APIServer:
                  authenticator=None, request_log=None, ssl_context=None,
                  metrics_registry: Optional[metrics_pkg.Registry] = None,
                  node_locator=None, kubelet_port: int = 10250,
-                 reuse_port: bool = False):
+                 reuse_port: bool = False, cors_allowed_origins=()):
         self.master = master
+        # CORS origin allow-list, each entry a regex (ref: handlers.go CORS
+        # + --cors_allowed_origins; empty list = CORS disabled)
+        self.cors_patterns = [re.compile(p) for p in cors_allowed_origins]
         self.node_locator = node_locator
         self.kubelet_port = kubelet_port
         self.scheme = master.scheme

@@ -40,6 +40,12 @@ def build_parser() -> argparse.ArgumentParser:
                    action="store_true",
                    help="if set, allow containers to request privileged "
                         "mode (ref: the reference's --allow_privileged)")
+    p.add_argument("--cors-allowed-origins", "--cors_allowed_origins",
+                   default="",
+                   help="comma-separated allowed CORS origins; each entry "
+                        "may be a regular expression (subdomain matching). "
+                        "Empty disables CORS (ref: the reference's "
+                        "--cors_allowed_origins)")
     p.add_argument("--reuse-port", "--reuse_port", action="store_true",
                    help="bind with SO_REUSEPORT so several apiserver "
                         "worker processes share one listen port")
@@ -91,10 +97,13 @@ def build_server(opts, ready_event: Optional[threading.Event] = None):
         event_ttl_seconds=opts.event_ttl,
         cloud=get_provider(opts.cloud_provider) if opts.cloud_provider else None,
     ))
+    cors = [o for o in
+            getattr(opts, "cors_allowed_origins", "").split(",") if o]
     return APIServer(master, host=opts.address, port=opts.port,
                      authenticator=authenticator,
                      kubelet_port=opts.kubelet_port,
-                     reuse_port=getattr(opts, "reuse_port", False))
+                     reuse_port=getattr(opts, "reuse_port", False),
+                     cors_allowed_origins=cors)
 
 
 def apiserver_server(argv: List[str],

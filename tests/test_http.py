@@ -441,3 +441,77 @@ class TestAuth:
             assert ei.value.code == 403
         finally:
             srv.stop()
+
+
+# -- CORS (ref: pkg/apiserver/handlers.go CORS + --cors_allowed_origins) ----
+
+class TestCORS:
+    @pytest.fixture()
+    def cors_server(self):
+        srv = APIServer(Master(MasterConfig()),
+                        cors_allowed_origins=[r"^http://localhost(:\d+)?$",
+                                              r"//.*\.example\.com$"]).start()
+        yield srv
+        srv.stop()
+
+    def _get(self, srv, path, origin=None, method="GET"):
+        req = urllib.request.Request(srv.base_url + path, method=method)
+        if origin:
+            req.add_header("Origin", origin)
+        return urllib.request.urlopen(req, timeout=5)
+
+    def test_allowed_origin_gets_cors_headers(self, cors_server):
+        r = self._get(cors_server, "/api/v1/namespaces/default/pods",
+                      origin="http://localhost:3000")
+        assert r.headers["Access-Control-Allow-Origin"] == "http://localhost:3000"
+        assert "GET" in r.headers["Access-Control-Allow-Methods"]
+        assert r.headers["Access-Control-Allow-Credentials"] == "true"
+
+    def test_regex_subdomain_match(self, cors_server):
+        r = self._get(cors_server, "/healthz",
+                      origin="https://ui.example.com")
+        assert r.headers["Access-Control-Allow-Origin"] == "https://ui.example.com"
+
+    def test_disallowed_origin_gets_no_cors_headers(self, cors_server):
+        r = self._get(cors_server, "/healthz", origin="http://evil.test")
+        assert r.headers.get("Access-Control-Allow-Origin") is None
+
+    def test_no_origin_header_gets_no_cors_headers(self, cors_server):
+        r = self._get(cors_server, "/healthz")
+        assert r.headers.get("Access-Control-Allow-Origin") is None
+
+    def test_preflight_options_short_circuits(self, cors_server):
+        r = self._get(cors_server, "/api/v1/namespaces/default/pods",
+                      origin="http://localhost:8000", method="OPTIONS")
+        assert r.status == 204
+        assert r.headers["Access-Control-Allow-Origin"] == "http://localhost:8000"
+        assert "OPTIONS" in r.headers["Access-Control-Allow-Methods"]
+
+    def test_cors_disabled_by_default(self, server):
+        # the plain fixture has no allow-list: even a localhost origin
+        # gets nothing (handlers.go: empty list = CORS off)
+        req = urllib.request.Request(
+            server.base_url + "/healthz")
+        req.add_header("Origin", "http://localhost:3000")
+        r = urllib.request.urlopen(req, timeout=5)
+        assert r.headers.get("Access-Control-Allow-Origin") is None
+
+    def test_vary_origin_when_cors_enabled(self, cors_server):
+        # present on matches AND non-matches: the response varies by
+        # Origin either way, so caches must key on it
+        r = self._get(cors_server, "/healthz", origin="http://localhost:1")
+        assert "Origin" in (r.headers.get("Vary") or "")
+        r2 = self._get(cors_server, "/healthz", origin="http://evil.test")
+        assert "Origin" in (r2.headers.get("Vary") or "")
+
+    def test_options_stays_501_when_not_preflight(self, cors_server, server):
+        import urllib.error
+        for srv, origin in ((cors_server, "http://evil.test"),
+                            (server, "http://localhost:3000")):
+            req = urllib.request.Request(
+                srv.base_url + "/api/v1/namespaces/default/pods",
+                method="OPTIONS")
+            req.add_header("Origin", origin)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=5)
+            assert ei.value.code == 501  # the pre-CORS behavior, preserved
