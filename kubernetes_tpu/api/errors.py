@@ -114,6 +114,11 @@ def new_internal_error(message: str) -> StatusError:
     return _status(500, api.ReasonInternalError, message)
 
 
+def new_too_many_requests(message: str = "rate limit exceeded") -> StatusError:
+    """ref: handlers.go RateLimit — the read-only port's 429."""
+    return _status(429, api.ReasonTooManyRequests, message)
+
+
 def new_expired(message: str) -> StatusError:
     """410 Gone — the requested resourceVersion fell out of the watch window
     (ref: errors.go NewResourceExpired); clients respond by relisting."""

@@ -104,6 +104,7 @@ ReasonUnauthorized = "Unauthorized"
 ReasonMethodNotAllowed = "MethodNotAllowed"
 ReasonInternalError = "InternalError"
 ReasonExpired = "Expired"
+ReasonTooManyRequests = "TooManyRequests"
 
 # Session affinity
 AffinityNone = "None"

@@ -232,14 +232,15 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
-    def _send_status_error(self, e: errors.StatusError, version: str):
+    def _send_status_error(self, e: errors.StatusError, version: str,
+                           extra_headers=()):
         apisrv = self.server.api  # type: ignore[attr-defined]
         try:
             payload = apisrv.scheme.encode(e.status, version)
         except Exception:
             payload = json.dumps({"kind": "Status", "status": "Failure",
                                   "message": str(e), "code": e.code})
-        self._send_json(e.code, payload)
+        self._send_json(e.code, payload, extra_headers=extra_headers)
 
     def _read_body(self) -> bytes:
         length = int(self.headers.get("Content-Length") or 0)
@@ -349,6 +350,18 @@ class _Handler(BaseHTTPRequestHandler):
         # keep-alive connection (next request parses them as a request line).
         raw_body = self._read_body()
         try:
+            # read-only / rate-limit serving modes (ref: handlers.go
+            # ReadOnly + RateLimit, the kubernetes-ro port's wrappers)
+            rl = apisrv.rate_limiter
+            if rl is not None and not rl.can_accept():
+                code = 429
+                self._send_status_error(errors.new_too_many_requests(),
+                                        self._version_of(parts),
+                                        extra_headers=(("Retry-After", "1"),))
+                return
+            if apisrv.read_only and method != "GET":
+                raise errors.new_forbidden(
+                    "", "", "this is a read-only endpoint")
             user = self._authenticate(apisrv)
             code = self._dispatch_path(method, parts, query, user, raw_body)
         except errors.StatusError as e:
@@ -710,11 +723,17 @@ class APIServer:
                  authenticator=None, request_log=None, ssl_context=None,
                  metrics_registry: Optional[metrics_pkg.Registry] = None,
                  node_locator=None, kubelet_port: int = 10250,
-                 reuse_port: bool = False, cors_allowed_origins=()):
+                 reuse_port: bool = False, cors_allowed_origins=(),
+                 read_only: bool = False, rate_limiter=None):
         self.master = master
         # CORS origin allow-list, each entry a regex (ref: handlers.go CORS
         # + --cors_allowed_origins; empty list = CORS disabled)
         self.cors_patterns = [re.compile(p) for p in cors_allowed_origins]
+        # the kubernetes-ro serving mode (ref: handlers.go ReadOnly +
+        # RateLimit; wired by cmd/kube-apiserver onto --read_only_port):
+        # GETs only, optionally throttled by a token bucket
+        self.read_only = read_only
+        self.rate_limiter = rate_limiter
         self.node_locator = node_locator
         self.kubelet_port = kubelet_port
         self.scheme = master.scheme

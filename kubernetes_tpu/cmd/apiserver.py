@@ -46,6 +46,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "may be a regular expression (subdomain matching). "
                         "Empty disables CORS (ref: the reference's "
                         "--cors_allowed_origins)")
+    p.add_argument("--read-only-port", "--read_only_port", type=int,
+                   default=0,
+                   help="serve a GET-only, unauthenticated, rate-limited "
+                        "companion port (the kubernetes-ro backend; the "
+                        "reference defaults it to 7080). 0 disables.")
+    p.add_argument("--api-rate", "--api_rate", type=float, default=10.0,
+                   help="read-only port rate limit, QPS")
+    p.add_argument("--api-burst", "--api_burst", type=int, default=200,
+                   help="read-only port burst size")
     p.add_argument("--reuse-port", "--reuse_port", action="store_true",
                    help="bind with SO_REUSEPORT so several apiserver "
                         "worker processes share one listen port")
@@ -99,11 +108,25 @@ def build_server(opts, ready_event: Optional[threading.Event] = None):
     ))
     cors = [o for o in
             getattr(opts, "cors_allowed_origins", "").split(",") if o]
-    return APIServer(master, host=opts.address, port=opts.port,
-                     authenticator=authenticator,
-                     kubelet_port=opts.kubelet_port,
-                     reuse_port=getattr(opts, "reuse_port", False),
-                     cors_allowed_origins=cors)
+    srv = APIServer(master, host=opts.address, port=opts.port,
+                    authenticator=authenticator,
+                    kubelet_port=opts.kubelet_port,
+                    reuse_port=getattr(opts, "reuse_port", False),
+                    cors_allowed_origins=cors)
+    ro_port = getattr(opts, "read_only_port", 0)
+    if ro_port:
+        # the kubernetes-ro companion (ref: cmd server.go:267-276):
+        # GET-only, unauthenticated, token-bucket throttled, same master
+        from kubernetes_tpu.util.throttle import TokenBucketRateLimiter
+        srv.read_only_server = APIServer(
+            master, host=opts.address, port=ro_port,
+            kubelet_port=opts.kubelet_port,
+            cors_allowed_origins=cors,
+            reuse_port=getattr(opts, "reuse_port", False),
+            read_only=True,
+            rate_limiter=TokenBucketRateLimiter(opts.api_rate,
+                                                opts.api_burst))
+    return srv
 
 
 def apiserver_server(argv: List[str],
@@ -117,6 +140,11 @@ def apiserver_server(argv: List[str],
     srv = build_server(opts)
     srv.start()
     print(f"kube-apiserver listening on {srv.base_url}", file=sys.stderr)
+    ro = getattr(srv, "read_only_server", None)
+    if ro is not None:
+        ro.start()
+        print(f"read-only (kubernetes-ro) listening on {ro.base_url}",
+              file=sys.stderr)
     if ready is not None:
         ready.set()
     stop = stop or threading.Event()
@@ -124,6 +152,8 @@ def apiserver_server(argv: List[str],
         stop.wait()
     except KeyboardInterrupt:
         pass
+    if ro is not None:
+        ro.stop()
     srv.stop()
     return 0
 

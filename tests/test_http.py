@@ -515,3 +515,56 @@ class TestCORS:
             with pytest.raises(urllib.error.HTTPError) as ei:
                 urllib.request.urlopen(req, timeout=5)
             assert ei.value.code == 501  # the pre-CORS behavior, preserved
+
+
+# -- read-only port + rate limit (ref: handlers.go ReadOnly/RateLimit) ------
+
+class TestReadOnlyAndRateLimit:
+    def test_read_only_serves_get_rejects_writes(self):
+        import urllib.error
+        srv = APIServer(Master(MasterConfig()), read_only=True).start()
+        try:
+            r = urllib.request.urlopen(
+                srv.base_url + "/api/v1/namespaces/default/pods", timeout=5)
+            assert r.status == 200
+            req = urllib.request.Request(
+                srv.base_url + "/api/v1/namespaces/default/pods",
+                data=b"{}", headers={"Content-Type": "application/json"},
+                method="POST")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=5)
+            assert ei.value.code == 403
+            assert "read-only" in ei.value.read().decode()
+        finally:
+            srv.stop()
+
+    def test_rate_limit_429_with_retry_after(self):
+        from kubernetes_tpu.util.throttle import TokenBucketRateLimiter
+        # tiny bucket: 2 requests then dry (qps so low it can't refill)
+        rl = TokenBucketRateLimiter(qps=0.001, burst=2)
+        import urllib.error
+        srv = APIServer(Master(MasterConfig()), read_only=True,
+                        rate_limiter=rl).start()
+        try:
+            for _ in range(2):
+                assert urllib.request.urlopen(
+                    srv.base_url + "/healthz", timeout=5).status == 200
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(srv.base_url + "/healthz", timeout=5)
+            assert ei.value.code == 429
+            assert ei.value.headers["Retry-After"] == "1"
+            body = json.loads(ei.value.read())
+            # one Status-encoding path for every error (scheme-encoded)
+            assert body["reason"] == "TooManyRequests", body
+        finally:
+            srv.stop()
+
+    def test_token_bucket_refills_at_qps(self):
+        from kubernetes_tpu.util.throttle import TokenBucketRateLimiter
+        now = [0.0]
+        rl = TokenBucketRateLimiter(qps=2.0, burst=3, clock=lambda: now[0])
+        assert [rl.can_accept() for _ in range(4)] == [True, True, True, False]
+        now[0] = 1.0          # 2 tokens refilled at 2 qps
+        assert rl.can_accept() and rl.can_accept() and not rl.can_accept()
+        now[0] = 100.0        # capped at burst, never beyond
+        assert [rl.can_accept() for _ in range(4)] == [True, True, True, False]
