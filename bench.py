@@ -782,10 +782,14 @@ def run_solver_config(tag, n_nodes, n_pods, gate_nodes=0, gate_pods=0,
     return res
 
 
-def run_churn_config(tag, n_nodes, n_pods, rate_pods_per_s, wave_size=1024):
+def run_churn_config(tag, n_nodes, n_pods, rate_pods_per_s, wave_size=1024,
+                     solver_addr=""):
     """Churn replay through the REAL BatchScheduler: in-process apiserver,
     reflectors, FIFO, incremental encoder, Binding writes — pods offered at
-    a fixed rate, sustained bind throughput measured."""
+    a fixed rate, sustained bind throughput measured. With ``solver_addr``
+    the waves solve on a shared kube-solverd daemon (cmd/solverd) instead
+    of in-process — the record then carries the remote/fallback wave
+    split so a silently-down daemon can't pass as a solverd measurement."""
     import threading
 
     from kubernetes_tpu.api import types as api
@@ -796,7 +800,8 @@ def run_churn_config(tag, n_nodes, n_pods, rate_pods_per_s, wave_size=1024):
     from kubernetes_tpu.scheduler.tpu_batch import BatchScheduler
 
     log(f"[{tag}] {n_pods} pods at {rate_pods_per_s}/s onto {n_nodes} nodes "
-        f"through the live scheduler stack")
+        f"through the live scheduler stack"
+        + (f" (solverd at {solver_addr})" if solver_addr else ""))
     m = Master()
     client = Client(InProcessTransport(m))
     for i in range(n_nodes):
@@ -805,7 +810,7 @@ def run_churn_config(tag, n_nodes, n_pods, rate_pods_per_s, wave_size=1024):
             spec=api.NodeSpec(capacity={"cpu": Quantity("64"),
                                         "memory": Quantity("256Gi")})))
     factory = ConfigFactory(client, node_poll_period=0.5)
-    config = factory.create()
+    config = factory.create(solver_addr=solver_addr)
     sched = BatchScheduler(config, factory, client, wave_size=wave_size,
                            wave_linger_s=0.1).run()
     try:
@@ -951,6 +956,12 @@ def run_churn_config(tag, n_nodes, n_pods, rate_pods_per_s, wave_size=1024):
             "total_s": round(total_s, 2),
             "gate": "all-bound-via-live-stack",
         }
+        if solver_addr:
+            rs = sched.solver
+            rec["solver_addr"] = solver_addr
+            rec["solverd_remote_waves"] = rs.remote_waves
+            rec["solverd_fallback_waves"] = rs.fallback_waves
+            rec["solverd_busy_waves"] = rs.busy_waves
         if sat_bound >= sat_total:
             rec["saturation_pods_per_s"] = round(sat_value, 1)
             rec["saturation_offered_pods_per_s"] = round(
@@ -981,6 +992,12 @@ def _child_parser() -> argparse.ArgumentParser:
     ap.add_argument("--runs", type=int, default=None,
                     help="timed steady-state waves per config (default: 30 "
                          "on TPU, 12 on the CPU fallback, 5 for --smoke)")
+    ap.add_argument("--solver-addr", "--solver_addr", default="",
+                    help="HOST:PORT of a running kube-solverd daemon "
+                         "(cmd/solverd); the churn config then solves its "
+                         "waves there instead of in-process. The "
+                         "multi-process analog is hack/churn_mp.py "
+                         "--solverd, which spawns the daemon itself.")
     return ap
 
 
@@ -1106,7 +1123,8 @@ def child(argv) -> int:
         runs=runs, **({"gang_groups": 20, "gang_size": 8} if s else g_kw))
     run("churn", run_churn_config,
         20 if s else 500, 300 if s else 8_000,
-        rate_pods_per_s=300 if s else 1_000)
+        rate_pods_per_s=300 if s else 1_000,
+        solver_addr=args.solver_addr)
 
     record = build_record()
     if not configs and not failed:

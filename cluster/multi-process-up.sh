@@ -15,12 +15,29 @@ PIDS=()
 cleanup() { kill "${PIDS[@]}" 2>/dev/null || true; }
 trap cleanup EXIT INT TERM
 
+SOLVERD_PORT="${SOLVERD_PORT:-10450}"
+
 python -m kubernetes_tpu.cmd.apiserver --port "${PORT}" &
 PIDS+=($!)
 sleep 1
 python -m kubernetes_tpu.cmd.controller_manager --master "${MASTER}" &
 PIDS+=($!)
-python -m kubernetes_tpu.cmd.scheduler --master "${MASTER}" &
+# the shared solver daemon: every tpu-batch scheduler worker points at it
+# (waves coalesce into batched solves in one hot runtime); schedulers fall
+# back to in-process solving automatically if it dies
+python -m kubernetes_tpu.cmd.solverd --port "${SOLVERD_PORT}" &
+PIDS+=($!)
+# the daemon must own its socket before the scheduler's first wave, or
+# the RemoteSolver starts out in its unhealthy-fallback cooldown
+for _ in $(seq 1 60); do
+    if (exec 3<>"/dev/tcp/127.0.0.1/${SOLVERD_PORT}") 2>/dev/null; then
+        exec 3>&- 3<&-
+        break
+    fi
+    sleep 0.5
+done
+python -m kubernetes_tpu.cmd.scheduler --master "${MASTER}" \
+    --algorithm tpu-batch --solver-addr "127.0.0.1:${SOLVERD_PORT}" &
 PIDS+=($!)
 python -m kubernetes_tpu.cmd.kubelet --api-servers "${MASTER}" \
     --hostname-override "$(hostname)" --register-node --port 10250 \
@@ -35,6 +52,7 @@ python -m kubernetes_tpu.cmd.logging --master "${MASTER}" --port 10252 &
 PIDS+=($!)
 
 echo "control plane up: ${MASTER} (Ctrl-C to stop)"
+echo "  solverd:    tcp://127.0.0.1:${SOLVERD_PORT}  (shared wave solver)"
 echo "  dns:        udp://127.0.0.1:10053  (<svc>.<ns>.cluster.local)"
 echo "  monitoring: http://127.0.0.1:10251/api/v1/model"
 echo "  logging:    http://127.0.0.1:10252/logs?namespace=default"

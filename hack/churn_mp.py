@@ -181,6 +181,25 @@ def _scrape_wave_raw(port: int) -> dict:
     return out
 
 
+def _scrape_solverd(port: int) -> dict:
+    """Coalescing evidence from the daemon's /metrics: device solves vs
+    waves served -> the measured coalesce factor."""
+    raw = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+    vals = {}
+    for line in raw.splitlines():
+        for key in ("solverd_device_solves_total",
+                    "solverd_coalesced_waves_total"):
+            if line.startswith(key + " ") or line.startswith(key + "{"):
+                vals[key] = float(line.rsplit(None, 1)[1])
+    solves = vals.get("solverd_device_solves_total", 0.0)
+    waves = vals.get("solverd_coalesced_waves_total", 0.0)
+    out = {"device_solves": int(solves), "waves_served": int(waves)}
+    if solves:
+        out["coalesce_factor"] = round(waves / solves, 2)
+    return out
+
+
 def _wave_stats_delta(start: dict, end: dict) -> dict:
     """Steady-state per-wave stats: END minus the post-warmup BASELINE, so
     the once-per-bucket XLA compiles paid during warmup don't pollute the
@@ -233,6 +252,14 @@ def main(argv=None) -> int:
                     help="apiserver worker processes sharing the listen "
                     "port (SO_REUSEPORT) and one kube-store process; 1 = "
                     "single apiserver with its own in-process store")
+    ap.add_argument("--schedulers", type=int, default=1,
+                    help="tpu-batch scheduler worker processes; losers of "
+                    "a bind CAS race requeue, so any N is correct")
+    ap.add_argument("--solverd", action="store_true",
+                    help="spawn a shared kube-solverd daemon and point "
+                    "every scheduler worker at it (--solver-addr): waves "
+                    "coalesce into batched solves in ONE hot solver "
+                    "process instead of N cold in-process ones")
     ap.add_argument("--port", type=int, default=18410)
     ap.add_argument("--out", default=None)
     ap.add_argument("--platform", choices=["cpu", "ambient"], default="cpu",
@@ -291,11 +318,38 @@ def main(argv=None) -> int:
                 spec=api.NodeSpec(capacity={"cpu": Quantity("64"),
                                             "memory": Quantity("256Gi")})))
 
-        sched_metrics_port = args.port + 9
-        spawn("scheduler", PY, "-m", "kubernetes_tpu.cmd.scheduler",
-              "--master", master, "--algorithm", "tpu-batch",
-              "--wave-period", "0.1",
-              "--metrics-port", str(sched_metrics_port))
+        solver_addr = ""
+        if args.solverd:
+            solverd_port = args.port + 7
+            solver_addr = f"127.0.0.1:{solverd_port}"
+            solverd_metrics_port = args.port + 8
+            spawn("solverd", PY, "-m", "kubernetes_tpu.cmd.solverd",
+                  "--port", str(solverd_port),
+                  "--metrics-port", str(solverd_metrics_port))
+            # the daemon must own its socket before any worker's first
+            # wave, or every worker starts in the fallback cooldown
+            import socket as _socket
+            sdeadline = time.time() + 30
+            while time.time() < sdeadline:
+                try:
+                    _socket.create_connection(
+                        ("127.0.0.1", solverd_port), timeout=1).close()
+                    break
+                except OSError:
+                    time.sleep(0.2)
+            else:
+                raise RuntimeError("kube-solverd never came up")
+
+        sched_metrics_ports = [args.port + 9 + w
+                               for w in range(args.schedulers)]
+        for w in range(args.schedulers):
+            cmd = [PY, "-m", "kubernetes_tpu.cmd.scheduler",
+                   "--master", master, "--algorithm", "tpu-batch",
+                   "--wave-period", "0.1",
+                   "--metrics-port", str(sched_metrics_ports[w])]
+            if solver_addr:
+                cmd += ["--solver-addr", solver_addr]
+            spawn(f"scheduler{w}", *cmd)
 
         # Bind counting rides a WATCH, not list polling: a full
         # field-selected LIST costs O(all pods) server CPU per poll
@@ -354,9 +408,10 @@ def main(argv=None) -> int:
             size //= 2
 
         try:
-            waves_baseline = _scrape_wave_raw(sched_metrics_port)
+            waves_baseline = [_scrape_wave_raw(p)
+                              for p in sched_metrics_ports]
         except Exception:
-            waves_baseline = {}
+            waves_baseline = [{} for _ in sched_metrics_ports]
         print(f"[churn-mp] offering {args.pods} pods at {args.rate:.0f}/s "
               f"via {args.feeders} feeder processes", file=sys.stderr,
               flush=True)
@@ -391,17 +446,25 @@ def main(argv=None) -> int:
         # topology (ref: the MapPodsToMachines rebuild being designed
         # away, pkg/scheduler/predicates.go:354-375)
         try:
-            wave_stats = _wave_stats_delta(waves_baseline,
-                                           _scrape_wave_raw(sched_metrics_port))
+            ends = [_scrape_wave_raw(p) for p in sched_metrics_ports]
+            per_worker = [_wave_stats_delta(b, e)
+                          for b, e in zip(waves_baseline, ends)]
+            wave_stats = per_worker[0] if len(per_worker) == 1 \
+                else {"workers": per_worker}
         except Exception as e:
             wave_stats = {"error": f"metrics scrape failed: {e}"}
+        sched_desc = ("tpu-batch scheduler"
+                      if args.schedulers == 1 else
+                      f"{args.schedulers} tpu-batch scheduler workers")
+        if solver_addr:
+            sched_desc += " -> shared kube-solverd (wave coalescing)"
         record = {
             "config": f"churn multi-process: {args.pods} pods at "
                       f"{args.rate:.0f}/s onto {args.nodes} nodes",
             "topology": (f"{args.apiservers} apiserver workers "
                          "(SO_REUSEPORT) + kube-store + "
                          if args.apiservers > 1 else "apiserver + ")
-                        + "tpu-batch scheduler + "
+                        + sched_desc + " + "
                         f"{args.feeders} feeders, separate processes, HTTP",
             "offered_pods_per_s": round(offered, 1),
             "sustained_pods_per_s": round(sustained, 1),
@@ -411,6 +474,11 @@ def main(argv=None) -> int:
             "feeder_behind_max_s": max(s["behind_max_s"] for s in stats),
             "scheduler_waves": wave_stats,
         }
+        if solver_addr:
+            try:
+                record["solverd"] = _scrape_solverd(solverd_metrics_port)
+            except Exception as e:
+                record["solverd"] = {"error": f"scrape failed: {e}"}
         out = json.dumps(record, indent=1)
         print(out)
         if args.out:

@@ -19,6 +19,12 @@ for a in "$@"; do  # --race is recognized anywhere in the argument list
 done
 set -- ${ARGS+"${ARGS[@]}"}
 
+# Collection smoke: a single SyntaxError anywhere silently disabled 13
+# test modules once (util/metrics.py f-string, seed state). compileall is
+# ~2s and makes that class of failure loud before any suite runs.
+echo "=== compile smoke (python -m compileall) ==="
+python -m compileall -q kubernetes_tpu tests bench.py hack
+
 if [[ "$RACE" == 1 ]]; then
     ROUNDS="${RACE_ROUNDS:-3}"
     SUITES=(tests/test_contention.py tests/test_storage.py

@@ -271,11 +271,34 @@ class _Handler(BaseHTTPRequestHandler):
         started = time.monotonic()
         resource = ([p for p in self.path.split("/") if p] + ["", "", ""])[2]
         self._read_body()  # keep-alive hygiene, like _route
+        rl = apisrv.rate_limiter
         if self._cors_check():
+            # allowed-origin preflight: answered WITHOUT consuming a
+            # rate-limit token. A preflight is browser-generated, touches
+            # no store state, and costs one header block — metering it
+            # would let anonymous OPTIONS bursts starve the throttled
+            # port's reads of tokens, while refusing it (on the read-only
+            # port) would break the non-simple GETs (Authorization,
+            # X-Requested-With, ...) whose headers this server itself
+            # advertises in _CORS_HEADERS
             code = 204
             self.send_response(code)
             self.send_header("Content-Length", "0")
             self.end_headers()
+        elif apisrv.read_only:
+            # ReadOnly(RateLimit(handler)) nesting for everything else: a
+            # non-preflight OPTIONS is a write-shaped method and the
+            # GET-only gate rejects it BEFORE the limiter, so it can never
+            # drain tokens legitimate reads need
+            code = 403
+            self._send_status_error(
+                errors.new_forbidden("", "", "this is a read-only endpoint"),
+                apisrv.default_version)
+        elif rl is not None and not rl.can_accept():
+            code = 429
+            self._send_status_error(errors.new_too_many_requests(),
+                                    apisrv.default_version,
+                                    extra_headers=(("Retry-After", "1"),))
         else:
             code = 501
             self.send_error(code, "Unsupported method ('OPTIONS')")
@@ -305,7 +328,12 @@ class _Handler(BaseHTTPRequestHandler):
         if not patterns:
             return False
         origin = self.headers.get("Origin") or ""
-        if origin and any(p.search(origin) for p in patterns):
+        # fullmatch, not search: these responses carry Allow-Credentials,
+        # and an unanchored pattern like "https://example.com" would also
+        # grant a lookalike origin ("https://example.com.evil.net") the
+        # browser's credentialed trust. Patterns are anchored at both ends;
+        # authors who want subdomains say so explicitly (".*\.example\.com")
+        if origin and any(p.fullmatch(origin) for p in patterns):
             self._cors_origin = origin
             return True
         return False
@@ -350,8 +378,14 @@ class _Handler(BaseHTTPRequestHandler):
         # keep-alive connection (next request parses them as a request line).
         raw_body = self._read_body()
         try:
-            # read-only / rate-limit serving modes (ref: handlers.go
-            # ReadOnly + RateLimit, the kubernetes-ro port's wrappers)
+            # read-only / rate-limit serving modes. The reference nests
+            # ReadOnly(RateLimit(handler)) (handlers.go, wired by
+            # cmd/kube-apiserver onto the ro port), so the GET-only check
+            # runs FIRST: a rejected write must not consume a token that a
+            # legitimate read could have used.
+            if apisrv.read_only and method != "GET":
+                raise errors.new_forbidden(
+                    "", "", "this is a read-only endpoint")
             rl = apisrv.rate_limiter
             if rl is not None and not rl.can_accept():
                 code = 429
@@ -359,9 +393,6 @@ class _Handler(BaseHTTPRequestHandler):
                                         self._version_of(parts),
                                         extra_headers=(("Retry-After", "1"),))
                 return
-            if apisrv.read_only and method != "GET":
-                raise errors.new_forbidden(
-                    "", "", "this is a read-only endpoint")
             user = self._authenticate(apisrv)
             code = self._dispatch_path(method, parts, query, user, raw_body)
         except errors.StatusError as e:

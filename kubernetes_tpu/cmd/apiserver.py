@@ -43,7 +43,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--cors-allowed-origins", "--cors_allowed_origins",
                    default="",
                    help="comma-separated allowed CORS origins; each entry "
-                        "may be a regular expression (subdomain matching). "
+                        "is a regular expression matched against the ENTIRE "
+                        "Origin header (anchored fullmatch — "
+                        "'https://example\\.com' does NOT admit "
+                        "'https://example.com.evil.net'; use an explicit "
+                        "'.*\\.example\\.com' style pattern for subdomains). "
                         "Empty disables CORS (ref: the reference's "
                         "--cors_allowed_origins)")
     p.add_argument("--read-only-port", "--read_only_port", type=int,

@@ -50,6 +50,11 @@ def _version_change(argv):
     return version_change(argv)
 
 
+def _solverd(argv):
+    from kubernetes_tpu.cmd.solverd import solverd_server
+    return solverd_server(argv)
+
+
 def _dns(argv):
     from kubernetes_tpu.cmd.dns import dns_server
     return dns_server(argv)
@@ -75,6 +80,8 @@ SERVERS = {
     "kubernetes": _standalone,
     "version-change": _version_change,
     "kube-version-change": _version_change,
+    "solverd": _solverd,
+    "kube-solverd": _solverd,
     "dns": _dns,
     "cluster-dns": _dns,
     "monitoring": _monitoring,

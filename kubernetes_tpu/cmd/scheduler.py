@@ -26,6 +26,13 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["serial", "tpu-batch"])
     p.add_argument("--wave-period", type=float, default=0.05,
                    help="tpu-batch: max wait to accumulate a wave")
+    p.add_argument("--solver-addr", "--solver_addr", default="",
+                   help="tpu-batch: HOST:PORT of a shared kube-solverd "
+                        "daemon (cmd/solverd). Waves solve there — many "
+                        "scheduler workers share one hot solver runtime — "
+                        "with automatic in-process fallback when the "
+                        "daemon is absent, busy, or unhealthy. Empty = "
+                        "always solve in-process.")
     p.add_argument("--event-qps", "--event_qps", type=float, default=50.0,
                    help="client-side event rate limit (successor "
                         "codebases' --event-qps; 0 disables)")
@@ -99,7 +106,8 @@ def build_scheduler(opts):
         with open(opts.policy_config_file) as f:
             policy = schedplugins.load_policy(f.read())
     config = factory.create(provider=opts.algorithm_provider,
-                            policy=policy, recorder=recorder)
+                            policy=policy, recorder=recorder,
+                            solver_addr=getattr(opts, "solver_addr", ""))
     if opts.algorithm == "tpu-batch":
         from kubernetes_tpu.models.policy import (UnsupportedPolicy,
                                                   batch_policy_from)

@@ -1,0 +1,91 @@
+"""kube-solverd binary — the shared batch-solver daemon.
+
+The reference has no analog: its scheduler is a per-pod loop with no
+accelerator to share. In this rebuild the solver runtime (JAX + compiled
+wave programs) is the one component that must NOT be replicated per
+scheduler worker — one hot daemon serves them all (see
+docs/design/solver.md and kubernetes_tpu/solver/service.py).
+
+Usage: python -m kubernetes_tpu.cmd.solverd [--port 10450]
+           [--gather-window 0.003] [--max-batch 16] [--max-queue 64]
+           [--metrics-port 0]
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+from typing import List, Optional
+
+__all__ = ["solverd_server", "main"]
+
+DEFAULT_PORT = 10450
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="kube-solverd", exit_on_error=False)
+    p.add_argument("--address", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=DEFAULT_PORT)
+    p.add_argument("--gather-window", "--gather_window", type=float,
+                   default=0.003,
+                   help="seconds to gather concurrent waves into one "
+                        "batched solve (wave coalescing)")
+    p.add_argument("--max-batch", "--max_batch", type=int, default=16,
+                   help="max waves per batched device call")
+    p.add_argument("--max-queue", "--max_queue", type=int, default=64,
+                   help="bounded request queue; beyond this, requests get "
+                        "an immediate BUSY reply (backpressure) instead of "
+                        "unbounded latency")
+    p.add_argument("--metrics-port", "--metrics_port", type=int, default=0,
+                   help="serve /metrics, /healthz and /debug/pprof on this "
+                        "port (0 disables)")
+    return p
+
+
+def solverd_server(argv: List[str],
+                   ready: Optional[threading.Event] = None,
+                   stop: Optional[threading.Event] = None) -> int:
+    try:
+        opts = build_parser().parse_args(argv)
+    except argparse.ArgumentError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    from kubernetes_tpu.solver.service import SolverService
+
+    srv = SolverService(host=opts.address, port=opts.port,
+                        gather_window_s=opts.gather_window,
+                        max_batch=opts.max_batch,
+                        max_queue=opts.max_queue)
+    if opts.metrics_port:
+        from kubernetes_tpu.cmd.scheduler import _serve_debug
+        _serve_debug(opts.metrics_port)
+    print(f"kube-solverd listening on {srv.address} "
+          f"(gather {opts.gather_window * 1000:.1f}ms, "
+          f"batch<= {opts.max_batch}, queue<= {opts.max_queue})",
+          file=sys.stderr, flush=True)
+    if ready is not None:
+        ready.set()
+    if stop is None:
+        try:
+            srv.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            srv.stop()
+        return 0
+    srv.start()
+    stop.wait()
+    srv.stop()
+    return 0
+
+
+def main() -> int:
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
+    return solverd_server(sys.argv[1:])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -174,20 +174,34 @@ class ProcessRuntime(ContainerRuntime):
         # Arbitrary workloads can't be spawned with TERM blocked (most
         # never unblock, which would break graceful stop); they rely on
         # the _refresh spawn-kill heal instead.
+        block_term = p.argv == self.pause_cmd
+        # Own process group so stop() can killpg the whole container.
+        # A fresh pgid within the SAME session — NOT setsid: sandboxed
+        # environments may reap processes that escape the supervisor's
+        # session. On py3.11+ Popen's process_group=0 does this post-fork
+        # without preexec_fn (which CPython documents as unsafe with
+        # threads, and the kubelet spawns from many); preexec only carries
+        # the pause sandbox's TERM-block handshake there. py3.10 has no
+        # process_group kwarg, so the pgid move rides preexec_fn too.
+        kwargs = {}
         preexec = None
-        if p.argv == self.pause_cmd:
+        if sys.version_info >= (3, 11):
+            kwargs["process_group"] = 0
+            if block_term:
+                def preexec():
+                    signal.pthread_sigmask(signal.SIG_BLOCK,
+                                           {signal.SIGTERM, signal.SIGINT})
+        else:
             def preexec():
-                signal.pthread_sigmask(signal.SIG_BLOCK,
-                                       {signal.SIGTERM, signal.SIGINT})
+                os.setpgid(0, 0)
+                if block_term:
+                    signal.pthread_sigmask(signal.SIG_BLOCK,
+                                           {signal.SIGTERM, signal.SIGINT})
         try:
-            # own process group so stop() can killpg the whole container.
-            # process_group (not start_new_session): sandboxed environments
-            # may reap processes that escape the supervisor's session via
-            # setsid; a fresh pgid within the same session suffices.
             p.popen = subprocess.Popen(
                 p.argv, stdout=logf, stderr=subprocess.STDOUT,
                 stdin=subprocess.DEVNULL, env=p.env, cwd=p.cwd,
-                process_group=0, preexec_fn=preexec)
+                preexec_fn=preexec, **kwargs)
         except OSError as e:
             logf.write(f"start failed: {e}\n".encode())
             logf.close()
@@ -352,7 +366,8 @@ class ProcessRuntime(ContainerRuntime):
     def group_stats(self, container_id: str):
         """(cpu_seconds, rss_bytes) summed over the container's whole
         process group via /proc, or None when the group is gone — each
-        container IS a process group (spawned with process_group=0), so
+        container IS a process group (spawned with a fresh pgid, see
+        _spawn), so
         pgrp matching gives the cgroup-equivalent accounting cAdvisor
         would report, including forked children."""
         with self._lock:

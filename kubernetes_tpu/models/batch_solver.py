@@ -706,13 +706,18 @@ class WaveRouter:
 default_router = WaveRouter()
 
 
-def solve(snap: ClusterSnapshot) -> Tuple[np.ndarray, np.ndarray]:
+def solve(snap: ClusterSnapshot,
+          host: Optional[SolverInputs] = None) -> Tuple[np.ndarray, np.ndarray]:
     """Host entry: encode -> device -> solve -> host decisions (including
     the all-or-nothing gang post-pass when the wave has PodGroups).
     Waves route through the measured host-vs-device dispatch (WaveRouter):
     over a tunnel-attached TPU, small waves are round-trip-bound and run
-    faster on the host CPU backend."""
-    host = snapshot_to_host_inputs(snap)
+    faster on the host CPU backend. ``host`` short-circuits the host-side
+    encode when the caller already holds snapshot_to_host_inputs(snap)
+    (the RemoteSolver fallback path, which encoded before learning the
+    daemon couldn't take the wave)."""
+    if host is None:
+        host = snapshot_to_host_inputs(snap)
     has_gangs = snap.has_gangs
     peer_bound = peer_bound_of(snap)
     plan = default_router.plan_for(host, snap.policy, has_gangs, peer_bound)

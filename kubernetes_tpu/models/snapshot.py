@@ -39,6 +39,7 @@ no per-(pod x service) or per-(group x pod) Python loops.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -55,6 +56,11 @@ from kubernetes_tpu.scheduler.generic import (
 )
 
 __all__ = ["ClusterSnapshot", "encode_snapshot", "greedy_fit_accumulators"]
+
+# KTPU_DEBUG=1: recompute every _ktpu_rows cache hit from the object graph
+# and assert it matches — catches in-place PodSpec mutation, which the
+# cache's correctness forbids (see container_rows + runtime/clone.py)
+_DEBUG_VERIFY_ROWS = os.environ.get("KTPU_DEBUG", "") not in ("", "0")
 
 
 def _fnv1a64_batch(keys: List[str]) -> np.ndarray:
@@ -228,20 +234,33 @@ def encode_snapshot(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
         # per-wave resource-universe bookkeeping (seen/request_only) still
         # runs over the cached rows — it is wave-local.
         limits, ports = [], []
+
+        def derive(spec):
+            lr, pr = [], []
+            for c in spec.containers:
+                for name, q in c.resources.limits.items():
+                    lr.append((name, q.milli_value() if name == CPU
+                               else q.int_value()))
+                for cp in c.ports:
+                    if cp.host_port:
+                        pr.append(cp.host_port)
+            return (lr, pr)
+
         for p in pods:
             spec = p.spec
             cached = spec.__dict__.get("_ktpu_rows")
             if cached is None:
-                lr, pr = [], []
-                for c in spec.containers:
-                    for name, q in c.resources.limits.items():
-                        lr.append((name, q.milli_value() if name == CPU
-                                   else q.int_value()))
-                    for cp in c.ports:
-                        if cp.host_port:
-                            pr.append(cp.host_port)
-                cached = (lr, pr)
+                cached = derive(spec)
                 spec.__dict__["_ktpu_rows"] = cached
+            elif _DEBUG_VERIFY_ROWS:
+                fresh = derive(spec)
+                assert fresh == cached, (
+                    f"_ktpu_rows cache stale for pod "
+                    f"{p.metadata.namespace}/{p.metadata.name}: cached "
+                    f"{cached!r} != recomputed {fresh!r} — a PodSpec was "
+                    f"mutated in place after encoding (mutations must go "
+                    f"through runtime.clone.deep_clone, which drops the "
+                    f"cache)")
             lr, pr = cached
             for name, _v in lr:
                 if name not in seen:

@@ -53,6 +53,14 @@ from jax.experimental.pallas import tpu as pltpu
 
 from kubernetes_tpu.models.policy import BatchPolicy
 
+# jax moved the x64-override context manager out of jax.experimental only
+# in newer releases; accept either home so the kernel works across the
+# jax versions the images actually ship
+if hasattr(jax, "enable_x64"):
+    _enable_x64 = jax.enable_x64
+else:  # e.g. jax 0.4.37
+    from jax.experimental import enable_x64 as _enable_x64
+
 __all__ = ["eligible", "solve_pallas"]
 
 LANES = 128
@@ -566,7 +574,7 @@ def solve_pallas(inp, pol: Optional[BatchPolicy] = None,
     if pol is None:
         pol = BatchPolicy()
     limbs = _tie_limbs(inp.tie_hi, inp.tie_lo)
-    with jax.enable_x64(False):
+    with _enable_x64(False):
         return _solve_pallas_x32(
             inp.cap, inp.advertises, inp.fit_used, inp.fit_exceeded,
             inp.score_used, inp.node_ports, inp.node_sel, inp.node_pds,

@@ -60,6 +60,13 @@ def deep_clone(obj):
         new = object.__new__(cls)
         d = obj.__dict__
         nd = new.__dict__
+        # Copy DECLARED fields only, never __dict__ wholesale: undeclared
+        # attributes are derived caches keyed to the original's contents
+        # (models/snapshot.py stashes `_ktpu_rows` on PodSpec), and the
+        # clone is precisely the object callers are allowed to mutate. A
+        # wholesale copy would carry a stale cache onto the mutated clone
+        # and silently corrupt wave encodes — KTPU_DEBUG=1 asserts this
+        # invariant on every cache hit.
         for name in _fields_of(cls):
             nd[name] = deep_clone(d[name])
         return new

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import dataclasses
 import datetime
+import re
 import typing
 from typing import Any, Callable, Dict, Optional, Type, get_args, get_origin, get_type_hints
 
@@ -190,6 +191,14 @@ def _decode_datetime(data: Any) -> datetime.datetime:
         return data
     # RFC3339 in all common shapes: fractional seconds, 'Z' or numeric offset.
     s = data[:-1] + "+00:00" if data.endswith("Z") else data
+    # RFC3339 allows ANY fraction length, and our own encoder right-trims
+    # zeros (".3506" for 350600us) — but py3.10 fromisoformat only accepts
+    # exactly 3 or 6 digits, so ~11% of emitted timestamps failed to parse
+    # (the flaky "Invalid isoformat string" pod-status decode errors). Pad
+    # or truncate the fraction to microsecond precision first.
+    m = re.match(r"^(.*[Tt ]\d{2}:\d{2}:\d{2})\.(\d+)(.*)$", s)
+    if m:
+        s = f"{m.group(1)}.{(m.group(2) + '000000')[:6]}{m.group(3)}"
     dt = datetime.datetime.fromisoformat(s)
     if dt.tzinfo is None:
         dt = dt.replace(tzinfo=datetime.timezone.utc)

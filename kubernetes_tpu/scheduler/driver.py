@@ -161,6 +161,10 @@ class SchedulerConfig:
     # the default-provider problem
     provider: str = schedplugins.DEFAULT_PROVIDER
     policy: Optional[schedplugins.Policy] = None
+    # HOST:PORT of a shared kube-solverd daemon; empty = solve in-process.
+    # Recorded here (not on the driver) so any wave-capable driver built
+    # from this config inherits the cluster's solver topology.
+    solver_addr: str = ""
 
 
 class Scheduler:
@@ -280,7 +284,8 @@ class ConfigFactory:
     def create(self, provider: str = schedplugins.DEFAULT_PROVIDER,
                policy: Optional[schedplugins.Policy] = None,
                algorithm_override=None,
-               recorder: Optional[EventRecorder] = None) -> SchedulerConfig:
+               recorder: Optional[EventRecorder] = None,
+               solver_addr: str = "") -> SchedulerConfig:
         """ref: factory.go:77-172 CreateFromProvider/CreateFromConfig/
         CreateFromKeys."""
         # reflector: unassigned pods -> FIFO (field selector spec.host=)
@@ -330,6 +335,7 @@ class ConfigFactory:
             recorder=recorder,
             provider=provider,
             policy=policy,
+            solver_addr=solver_addr,
         )
 
     def stop(self, join: bool = False, timeout: float = 2.0) -> bool:

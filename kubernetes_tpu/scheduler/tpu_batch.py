@@ -86,7 +86,8 @@ class BatchScheduler:
 
     def __init__(self, config: SchedulerConfig, factory: ConfigFactory,
                  client, wave_size: int = 1024, wave_linger_s: float = 0.02,
-                 solve_fn=None, batch_policy: BatchPolicy = None):
+                 solve_fn=None, batch_policy: BatchPolicy = None,
+                 solver=None):
         self.config = config
         self.factory = factory
         self.client = client
@@ -98,6 +99,14 @@ class BatchScheduler:
         self.solve_fn = solve_fn or self._default_solve
         self.batch_policy = batch_policy or batch_policy_from(
             getattr(config, "provider", None), getattr(config, "policy", None))
+        # shared-solver seam: an explicit RemoteSolver, or one built from
+        # the config's recorded solver topology (cmd/scheduler
+        # --solver-addr). None = solve in-process, the reference shape.
+        addr = getattr(config, "solver_addr", "")
+        if solver is None and addr:
+            from kubernetes_tpu.solver.client import RemoteSolver
+            solver = RemoteSolver(addr)
+        self.solver = solver
         try:
             # delta-maintained node planes + sticky vocabularies: per-wave
             # encode cost is O(changed pods), and pow-2 bucketing keeps the
@@ -137,7 +146,12 @@ class BatchScheduler:
             snap = encode_snapshot(nodes, get_existing(), pending, services,
                                    policy=self.batch_policy)
         t1 = time.perf_counter()
-        chosen, _ = solve(snap)  # includes the gang all-or-nothing post-pass
+        # both paths include the gang all-or-nothing post-pass; RemoteSolver
+        # falls back to the in-process solve when the daemon is absent/busy
+        if self.solver is not None:
+            chosen, _ = self.solver.solve(snap)
+        else:
+            chosen, _ = solve(snap)
         t2 = time.perf_counter()
         _wave_metrics().encode.observe(t1 - t0)
         _wave_metrics().solve.observe(t2 - t1)

@@ -1,0 +1,8 @@
+"""kube-solverd — the batch solver as a shared service.
+
+One accelerator-grade solver process (``service.SolverService``, the
+``cmd/solverd.py`` binary) serves solve requests from any number of
+scheduler workers over a local socket (``client.RemoteSolver``), merging
+concurrent waves into one padded batched device call (wave coalescing).
+See docs/design/solver.md for the design.
+"""

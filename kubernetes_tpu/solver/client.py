@@ -1,0 +1,204 @@
+"""RemoteSolver — a kube-solverd client with graceful in-process fallback.
+
+Drop-in for the in-process solve path: ``RemoteSolver.solve(snap)``
+returns exactly what ``models.batch_solver.solve(snap)`` returns (chosen
+node indices + winning scores, gang post-pass applied), so the
+BatchScheduler's wave loop cannot tell which solver ran — except by the
+wave latency. Recovery discipline mirrors the store client
+(storage/remote.RemoteStore): one pooled connection per thread; a failure
+the daemon never saw the frame for (refused connect, send error, any
+death of a REUSED pooled connection) retries once on a fresh connection,
+while a post-send failure on a fresh connection raises — the daemon may
+be mid-solve, and re-sending would double its load exactly when it is
+slow (see _call).
+
+Degradation ladder, worst case first:
+
+- daemon replies BUSY (bounded queue full): solve this wave in-process,
+  do NOT mark the daemon unhealthy — backpressure is it working as
+  designed;
+- connection refused / timed out / died twice: solve in-process and mark
+  the daemon unhealthy for ``cooldown_s`` so a dead daemon costs one
+  connect attempt per cooldown, not per wave;
+- protocol/version errors: same as above (a version-skewed daemon will
+  never start working mid-run).
+
+With ``fallback=False`` the failures raise instead (tests, and deploys
+that would rather crash than silently run N CPU solvers again).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+from kubernetes_tpu.models.policy import BatchPolicy
+from kubernetes_tpu.solver import protocol
+
+__all__ = ["RemoteSolver", "SolverBusy", "SolverUnavailable"]
+
+
+class SolverUnavailable(Exception):
+    """No healthy kube-solverd behind the configured address."""
+
+
+class SolverBusy(Exception):
+    """The daemon's bounded queue is full (the 429 analog)."""
+
+
+class RemoteSolver:
+    # the reply deadline must clear a COLD solve: the daemon's first wave
+    # of a new shape bucket pays an XLA compile (seconds on CPU, tens of
+    # seconds over a TPU tunnel), and treating that as a dead connection
+    # would re-send the wave and solve it twice
+    def __init__(self, address: str, timeout_s: float = 180.0,
+                 connect_timeout_s: float = 2.0, fallback: bool = True,
+                 cooldown_s: float = 5.0):
+        host, _, port = address.rpartition(":")
+        self._addr = (host or "127.0.0.1", int(port))
+        self._timeout_s = timeout_s
+        self._connect_timeout_s = connect_timeout_s
+        self.fallback = fallback
+        self.cooldown_s = cooldown_s
+        self._local = threading.local()
+        self._lock = threading.Lock()
+        self._unhealthy_until = 0.0
+        # visible in tests and the scheduler's /metrics narrative
+        self.remote_waves = 0
+        self.fallback_waves = 0
+        self.busy_waves = 0
+
+    # -- plumbing ----------------------------------------------------------
+    def _connect(self) -> socket.socket:
+        sock = socket.create_connection(self._addr,
+                                        timeout=self._connect_timeout_s)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.settimeout(self._timeout_s)
+        return sock
+
+    def _call(self, header: dict, arrays=()):
+        """Request/response on the pooled per-thread connection. Retry-once
+        covers failures the daemon never saw the frame for: a refused
+        connect, a send error, or any failure on a REUSED pooled
+        connection (a daemon restart between waves half-closes the pool;
+        the send "succeeds" into the dead socket and the recv gets EOF).
+        A failure after a send on a FRESH connection does NOT retry: the
+        daemon very likely has the frame and may be solving it, and a
+        retry after a merely-slow reply would make it solve the same wave
+        twice — exactly when it is most loaded. (Pure solves keep the
+        caller's fallback safe either way, just not free.)"""
+        last_err: Optional[Exception] = None
+        for attempt in (0, 1):
+            sock = getattr(self._local, "sock", None)
+            reused = sock is not None
+            sent = False
+            try:
+                if sock is None:
+                    sock = self._local.sock = self._connect()
+                protocol.send_msg(sock, header, arrays)
+                sent = True
+                resp = protocol.recv_msg(sock)
+                if resp is None:
+                    raise protocol.SolverProtocolError(
+                        "daemon closed the connection mid-call")
+                return resp
+            except (OSError, protocol.SolverProtocolError) as e:
+                last_err = e
+                self._local.sock = None
+                try:
+                    if sock is not None:
+                        sock.close()
+                except OSError:
+                    pass
+                if sent and not reused:
+                    break
+        raise SolverUnavailable(
+            f"kube-solverd at {self._addr[0]}:{self._addr[1]} "
+            f"unreachable: {last_err}")
+
+    # -- health ------------------------------------------------------------
+    def _in_cooldown(self) -> bool:
+        with self._lock:
+            return time.monotonic() < self._unhealthy_until
+
+    def _mark_unhealthy(self) -> None:
+        with self._lock:
+            self._unhealthy_until = time.monotonic() + self.cooldown_s
+
+    def ping(self) -> dict:
+        """Daemon health + version handshake; raises SolverUnavailable."""
+        header, _ = self._call({"op": "ping", "v": protocol.PROTOCOL_VERSION})
+        if "err" in header:
+            raise SolverUnavailable(header.get("msg", header["err"]))
+        if header.get("v") != protocol.PROTOCOL_VERSION:
+            raise SolverUnavailable(
+                f"daemon protocol v{header.get('v')} != "
+                f"client v{protocol.PROTOCOL_VERSION}")
+        return header
+
+    # -- the solve seam ----------------------------------------------------
+    def solve_remote(self, host_inputs, pol: BatchPolicy, gangs: bool
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+        """Ship one wave's host-side SolverInputs; returns (chosen, scores)
+        for the shipped pod axis. Raises SolverBusy / SolverUnavailable /
+        SolverProtocolError — no fallback at this layer."""
+        header = {
+            "op": "solve", "v": protocol.PROTOCOL_VERSION,
+            "fp": protocol.solver_fingerprint(pol, gangs),
+            "policy": protocol.policy_to_wire(pol),
+            "gangs": bool(gangs),
+        }
+        resp_header, arrays = self._call(header, tuple(host_inputs))
+        if resp_header.get("busy"):
+            raise SolverBusy("kube-solverd queue full")
+        if "err" in resp_header:
+            raise protocol.SolverProtocolError(
+                f"{resp_header['err']}: {resp_header.get('msg', '')}")
+        if len(arrays) != 2:
+            raise protocol.SolverProtocolError(
+                f"solve reply carried {len(arrays)} arrays, expected 2")
+        return arrays[0], arrays[1]
+
+    def solve(self, snap) -> Tuple[np.ndarray, np.ndarray]:
+        """The batch_solver.solve twin over the wire: encode-side inputs
+        from ``snap``, remote solve, gang post-pass — falling back to the
+        full in-process path whenever the daemon can't take the wave."""
+        from kubernetes_tpu.models import gang
+        from kubernetes_tpu.models.batch_solver import (
+            NEG,
+            snapshot_to_host_inputs,
+            solve as solve_in_process,
+        )
+
+        if self._in_cooldown():
+            if not self.fallback:
+                raise SolverUnavailable("kube-solverd in unhealthy cooldown")
+            self.fallback_waves += 1
+            return solve_in_process(snap)
+        pol = snap.policy or BatchPolicy()
+        gangs = snap.has_gangs
+        host = snapshot_to_host_inputs(snap)
+        try:
+            chosen, scores = self.solve_remote(host, pol, gangs)
+        except SolverBusy:
+            # BUSY is the designed overload response: reuse the encode the
+            # wave already paid instead of re-deriving it while saturated
+            self.busy_waves += 1
+            if not self.fallback:
+                raise
+            return solve_in_process(snap, host=host)
+        except (SolverUnavailable, protocol.SolverProtocolError):
+            self._mark_unhealthy()
+            if not self.fallback:
+                raise
+            self.fallback_waves += 1
+            return solve_in_process(snap, host=host)
+        self.remote_waves += 1
+        if gangs:
+            chosen = gang.apply_all_or_nothing(snap.pod_rid, chosen)
+            scores = np.where(chosen < 0, np.int32(NEG), scores)
+        return chosen, scores

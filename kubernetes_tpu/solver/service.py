@@ -1,0 +1,494 @@
+"""kube-solverd — the shared solver daemon with wave coalescing.
+
+Why this process exists: the multi-process churn topology runs N scheduler
+workers, and each one solved its waves **in-process on CPU** (solve p50
+854 ms/wave at full shape, CHURN_MP_r05_fullshape.json) because worker
+processes cannot share the one accelerator-grade solver runtime — while a
+device that clears bigger waves in ~122 ms sat attached to the same host.
+This daemon owns that runtime and serves every worker over a local socket
+(the same topology move kube-store made for the cluster store).
+
+**Wave coalescing.** Requests arriving within a short gather window are
+merged into ONE padded batched device call and fanned back out
+per-requester:
+
+- each request's SolverInputs is padded (per axis, pow-2 bucketed — the
+  same compile-bounding trick models/incremental.py uses for the pod
+  axis) to the group's target shape. Padding is decision-invariant by
+  construction: pad nodes carry ``node_extra_ok=False`` (never feasible,
+  advertise nothing, zero capacity), pad pods pin to host index -2 with
+  zero requests (never placeable, commit nothing), pad vocabulary/zone
+  columns are all-zero (no conflicts, no violations, zero scores), and
+  the group-counts off-list slot moves with the node axis;
+- requests sharing a solver-config fingerprint (policy + gangs + resource
+  dtype) stack on a new leading batch axis and run through one
+  ``jit(vmap(solve_jit))`` program — every arithmetic op the per-request
+  scan performs is exact (integer, or float32 pinned to HIGHEST
+  precision), so batched results are bit-identical to solo runs;
+- the batch axis itself is pow-2 bucketed by replicating the first
+  request, so the daemon compiles O(log) programs per family, not one
+  per gather-window occupancy.
+
+**Backpressure.** The request queue is bounded: when ``max_queue`` waves
+are already waiting, new requests get an immediate BUSY reply (the
+apiserver's 429 analog) instead of unbounded queueing latency — the
+client falls back to its in-process path for that wave, so a wedged or
+overloaded daemon degrades to exactly the pre-solverd behavior.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import socket
+import struct
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from kubernetes_tpu.models.policy import BatchPolicy
+from kubernetes_tpu.models.snapshot import _pow2_pad
+from kubernetes_tpu.solver import protocol
+from kubernetes_tpu.util import metrics
+
+__all__ = ["SolverService"]
+
+_log = logging.getLogger("kubernetes_tpu.solver.service")
+
+# SolverInputs field -> (axis names, pad fill). Axis names resolve against
+# the per-group target dims; fills are the decision-invariant values the
+# module docstring argues for. N1 (group_counts' node axis) is special:
+# its last column is the off-list slot and must stay last after padding.
+_PAD_SPEC = {
+    "cap":             (("N", "R"), 0),
+    "advertises":      (("N", "R"), False),
+    "fit_used":        (("N", "R"), 0),
+    "fit_exceeded":    (("N",), False),
+    "score_used":      (("N", "R"), 0),
+    "node_ports":      (("N", "Wp"), 0),
+    "node_sel":        (("N", "Ks"), False),
+    "node_pds":        (("N", "Wd"), 0),
+    "node_extra_ok":   (("N",), False),
+    "req":             (("P", "R"), 0),
+    "pod_ports":       (("P", "Wp"), 0),
+    "pod_sel":         (("P", "Ks"), False),
+    "pod_pds":         (("P", "Wd"), 0),
+    "pod_host_idx":    (("P",), -2),
+    "tie_hi":          (("P",), 0),
+    "tie_lo":          (("P",), 0),
+    "pod_gid":         (("P",), -1),
+    "pod_group_member": (("P", "G"), False),
+    "group_counts":    (("G", "N1"), 0),
+    "gang_start":      (("P",), True),
+    "score_static":    (("N",), 0),
+    "node_aff_vals":   (("N", "L"), -1),
+    "pod_aff_static":  (("P", "L"), -2),
+    "anchor_vals0":    (("G", "L"), 0),
+    "has_anchor0":     (("G",), False),
+    "zone_labeled":    (("A", "N"), False),
+    "zone_onehot":     (("A", "N", "V"), 0.0),
+}
+
+
+def _dims_of(inp) -> Dict[str, int]:
+    return {
+        "N": inp.cap.shape[0], "R": inp.cap.shape[1],
+        "Wp": inp.node_ports.shape[1], "Ks": inp.node_sel.shape[1],
+        "Wd": inp.node_pds.shape[1], "P": inp.req.shape[0],
+        "G": inp.group_counts.shape[0], "L": inp.node_aff_vals.shape[1],
+        "A": inp.zone_labeled.shape[0], "V": inp.zone_onehot.shape[2],
+    }
+
+
+def _target_dims(all_dims: List[Dict[str, int]]) -> Dict[str, int]:
+    """Group target: pow-2 bucket of the per-axis max. L and A are fixed by
+    the (shared) policy, so bucketing them is a no-op; everything else
+    genuinely varies wave to wave."""
+    t: Dict[str, int] = {}
+    for k in all_dims[0]:
+        m = max(d[k] for d in all_dims)
+        if k in ("L", "A"):
+            t[k] = m
+        elif k == "G":
+            t[k] = _pow2_pad(m, minimum=8)
+        else:
+            t[k] = _pow2_pad(m, minimum=1)
+    t["N1"] = t["N"] + 1
+    return t
+
+
+def _pad_inputs(inp, target: Dict[str, int]):
+    """Pad one request's SolverInputs to the group target shape with the
+    decision-invariant fills; returns the same NamedTuple type."""
+    out = []
+    for name, arr in zip(inp._fields, inp):
+        axes, fill = _PAD_SPEC[name]
+        want = tuple(target[a] for a in axes)
+        if arr.shape == want:
+            out.append(arr)
+            continue
+        if name == "group_counts":
+            # off-list slot is the LAST column at every size: move it
+            g, n1 = arr.shape
+            grown = np.zeros(want, arr.dtype)
+            grown[:g, :n1 - 1] = arr[:, :n1 - 1]
+            grown[:g, want[1] - 1] = arr[:, n1 - 1]
+            out.append(grown)
+            continue
+        grown = np.full(want, fill, arr.dtype)
+        grown[tuple(slice(0, s) for s in arr.shape)] = arr
+        out.append(grown)
+    return type(inp)(*out)
+
+
+@functools.lru_cache(maxsize=64)
+def _batched_solver(pol: BatchPolicy, gangs: bool):
+    """One compiled program family per (policy, gangs): vmap of the XLA
+    sequential-commit scan over a leading batch axis. solve_jit's per-item
+    semantics are preserved exactly under vmap (all decision arithmetic is
+    integer or HIGHEST-precision f32 — see models/batch_solver.py)."""
+    import jax
+
+    from kubernetes_tpu.models.batch_solver import solve_jit
+
+    return jax.jit(jax.vmap(functools.partial(solve_jit, pol=pol,
+                                              gangs=gangs)))
+
+
+class _SolverdMetrics:
+    _singleton = None
+
+    def __init__(self):
+        reg = metrics.default_registry()
+        self.queue_depth = reg.gauge(
+            "solverd_queue_depth", "Waves waiting for the gather window")
+        self.requests = reg.counter(
+            "solverd_requests_total", "Solve requests by outcome",
+            ("outcome",))
+        self.waves = reg.counter(
+            "solverd_coalesced_waves_total",
+            "Waves folded into batched device solves")
+        self.solves = reg.counter(
+            "solverd_device_solves_total",
+            "Batched device solve calls (coalesce factor = waves/solves)")
+        self.batch = reg.histogram(
+            "solverd_batch_waves", "Waves per batched solve",
+            buckets=(1, 2, 4, 8, 16, 32))
+        self.occupancy = reg.histogram(
+            "solverd_gather_occupancy",
+            "Gather-window fill fraction (waves gathered / max_batch)",
+            buckets=(0.0625, 0.125, 0.25, 0.5, 0.75, 1.0))
+        self.solve_s = reg.histogram(
+            "solverd_solve_seconds", "Batched solve wall time",
+            buckets=(0.001, 0.0025, 0.005, 0.01, 0.02, 0.05, 0.1, 0.25,
+                     0.5, 1.0, 2.5))
+
+
+def _solverd_metrics() -> _SolverdMetrics:
+    if _SolverdMetrics._singleton is None:
+        _SolverdMetrics._singleton = _SolverdMetrics()
+    return _SolverdMetrics._singleton
+
+
+class _Req:
+    __slots__ = ("inp", "pol", "gangs", "p", "conn", "send_lock")
+
+    def __init__(self, inp, pol, gangs, p, conn, send_lock):
+        self.inp = inp          # host-side SolverInputs (numpy)
+        self.pol = pol
+        self.gangs = gangs
+        self.p = p              # requester's pod-axis length (reply slice)
+        self.conn = conn
+        self.send_lock = send_lock
+
+
+class SolverService:
+    """The kube-solverd daemon loop. One thread per connection reads and
+    enqueues requests (replying BUSY itself when the queue is full); ONE
+    solver thread gathers, coalesces, solves, and writes replies."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 gather_window_s: float = 0.003, max_batch: int = 16,
+                 max_queue: int = 64):
+        from kubernetes_tpu.models.batch_solver import ensure_x64
+        ensure_x64()  # spread_score's exact-rounding emulation needs x64
+        self.gather_window_s = gather_window_s
+        self.max_batch = max_batch
+        self.max_queue = max_queue
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(128)
+        self._stopped = threading.Event()
+        self._cond = threading.Condition()
+        self._pending: deque = deque()
+        self._threads: List[threading.Thread] = []
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+        self._m = _solverd_metrics()
+        # device-call / wave counters, exposed for tests and /metrics alike
+        self.solve_calls = 0
+        self.waves_served = 0
+
+    # -- lifecycle ---------------------------------------------------------
+    @property
+    def port(self) -> int:
+        return self._sock.getsockname()[1]
+
+    @property
+    def address(self) -> str:
+        host, port = self._sock.getsockname()[:2]
+        return f"{host}:{port}"
+
+    def start(self) -> "SolverService":
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name="solverd-accept")
+        t.start()
+        self._threads.append(t)
+        t = threading.Thread(target=self._solve_loop, daemon=True,
+                             name="solverd-solve")
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def serve_forever(self) -> None:
+        t = threading.Thread(target=self._solve_loop, daemon=True,
+                             name="solverd-solve")
+        t.start()
+        self._threads.append(t)
+        self._accept_loop()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        with self._cond:
+            self._cond.notify_all()
+        try:
+            # shutdown BEFORE close: close() alone does not wake a thread
+            # blocked in accept(), and while that syscall blocks the
+            # kernel keeps the socket in LISTEN — a restarted daemon then
+            # can't rebind the port
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        # close accepted connections too: their threads are blocked in
+        # recv, and a lingering child socket keeps the port unbindable
+        # for a restarted daemon
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    # -- connection side ---------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # accepted sockets do NOT inherit the listener's SO_REUSEADDR;
+            # without it their FIN_WAIT remnants block a restarted daemon
+            # from rebinding the port
+            conn.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            # Bounded SEND only (not settimeout, which would also kill
+            # idle keep-alive recv): replies are written by the ONE solver
+            # thread, so a stalled client with a full receive buffer would
+            # otherwise wedge every queued wave daemon-wide. On timeout
+            # sendall raises (caught as OSError) and the reply is dropped
+            # — the wedged requester's problem, not the fleet's.
+            conn.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                            struct.pack("ll", 30, 0))
+            with self._conns_lock:
+                self._conns.add(conn)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True, name="solverd-conn").start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        # the reply to an accepted solve is written by the solver thread;
+        # BUSY/error/ping replies by this thread. A client sends one
+        # request at a time per connection, but the lock keeps even a
+        # misbehaving client's frames whole.
+        send_lock = threading.Lock()
+        try:
+            while not self._stopped.is_set():
+                msg = protocol.recv_msg(conn)
+                if msg is None:
+                    return
+                header, arrays = msg
+                op = header.get("op", "")
+                if op == "ping":
+                    with send_lock:
+                        protocol.send_msg(conn, {
+                            "ok": True, "v": protocol.PROTOCOL_VERSION,
+                            "solves": self.solve_calls,
+                            "waves": self.waves_served})
+                    continue
+                if op != "solve":
+                    with send_lock:
+                        protocol.send_msg(conn, {
+                            "err": "SolverProtocolError",
+                            "msg": f"unknown op {op!r}"})
+                    continue
+                self._enqueue_solve(header, arrays, conn, send_lock)
+        except (OSError, protocol.SolverProtocolError, ValueError) as e:
+            _log.debug("solverd connection dropped: %s", e)
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _enqueue_solve(self, header: dict, arrays: List[np.ndarray],
+                       conn: socket.socket,
+                       send_lock: threading.Lock) -> None:
+        from kubernetes_tpu.models.batch_solver import SolverInputs
+
+        def reject(err: str, msg: str) -> None:
+            self._m.requests.inc("error")
+            with send_lock:
+                protocol.send_msg(conn, {"err": err, "msg": msg})
+
+        if header.get("v") != protocol.PROTOCOL_VERSION:
+            reject("SolverProtocolError",
+                   f"protocol version skew: daemon speaks "
+                   f"{protocol.PROTOCOL_VERSION}, request is "
+                   f"{header.get('v')!r}")
+            return
+        if len(arrays) != len(SolverInputs._fields):
+            reject("SolverProtocolError",
+                   f"expected {len(SolverInputs._fields)} arrays, "
+                   f"got {len(arrays)}")
+            return
+        try:
+            pol = protocol.policy_from_wire(header["policy"])
+        except (KeyError, TypeError, ValueError) as e:
+            reject("SolverProtocolError", f"bad policy: {e}")
+            return
+        gangs = bool(header.get("gangs", False))
+        fp = protocol.solver_fingerprint(pol, gangs)
+        if header.get("fp") not in (None, fp):
+            reject("SolverProtocolError",
+                   f"fingerprint mismatch: request {header.get('fp')!r}, "
+                   f"daemon derives {fp!r}")
+            return
+        inp = SolverInputs(*arrays)
+        req = _Req(inp, pol, gangs, int(inp.req.shape[0]), conn, send_lock)
+        with self._cond:
+            if len(self._pending) >= self.max_queue:
+                busy = True
+            else:
+                busy = False
+                self._pending.append(req)
+                self._m.queue_depth.set(len(self._pending))
+                self._cond.notify()
+        if busy:
+            self._m.requests.inc("busy")
+            with send_lock:
+                protocol.send_msg(conn, {"busy": True})
+
+    # -- solver side -------------------------------------------------------
+    def _gather(self) -> List[_Req]:
+        """Block for the first request, then keep gathering until the
+        window closes or the batch is full."""
+        with self._cond:
+            while not self._pending and not self._stopped.is_set():
+                self._cond.wait(0.1)
+            if self._stopped.is_set():
+                return []
+            batch = [self._pending.popleft()]
+        deadline = time.monotonic() + self.gather_window_s
+        while len(batch) < self.max_batch:
+            with self._cond:
+                while self._pending and len(batch) < self.max_batch:
+                    batch.append(self._pending.popleft())
+                if len(batch) >= self.max_batch:
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self._stopped.is_set():
+                    break
+                self._cond.wait(remaining)
+        with self._cond:
+            self._m.queue_depth.set(len(self._pending))
+        return batch
+
+    def _solve_loop(self) -> None:
+        while not self._stopped.is_set():
+            batch = self._gather()
+            if not batch:
+                continue
+            self._m.occupancy.observe(len(batch) / self.max_batch)
+            groups: Dict[tuple, List[_Req]] = {}
+            for r in batch:
+                key = (r.pol, r.gangs, str(r.inp.cap.dtype),
+                       r.inp.node_aff_vals.shape[1],
+                       r.inp.zone_labeled.shape[0])
+                groups.setdefault(key, []).append(r)
+            for reqs in groups.values():
+                try:
+                    self._solve_group(reqs)
+                except Exception as e:  # noqa: BLE001 — must answer anyway
+                    _log.exception("batched solve failed (%d waves)",
+                                   len(reqs))
+                    self._m.requests.inc("error")
+                    for r in reqs:
+                        try:
+                            with r.send_lock:
+                                protocol.send_msg(r.conn, {
+                                    "err": type(e).__name__, "msg": str(e)})
+                        except OSError:
+                            pass
+
+    def _device_solve(self, stacked, pol: BatchPolicy, gangs: bool
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+        """One batched device call. Overridable seam (tests inject slow or
+        counting fakes to drive backpressure deterministically)."""
+        import jax.numpy as jnp
+
+        fn = _batched_solver(pol, gangs)
+        chosen, scores = fn(stacked)
+        # one readback for both planes, like batch_solver.solve
+        both = np.asarray(jnp.stack([chosen, scores]))
+        return both[0], both[1]
+
+    def _solve_group(self, reqs: List[_Req]) -> None:
+        pol, gangs = reqs[0].pol, reqs[0].gangs
+        target = _target_dims([_dims_of(r.inp) for r in reqs])
+        padded = [_pad_inputs(r.inp, target) for r in reqs]
+        B = _pow2_pad(len(padded), minimum=1)
+        # replicate the first wave to fill the pow-2 batch bucket: bounded
+        # wasted lanes instead of one compile per occupancy
+        padded += [padded[0]] * (B - len(padded))
+        stacked = type(padded[0])(*(np.stack(cols)
+                                    for cols in zip(*padded)))
+        t0 = time.perf_counter()
+        chosen, scores = self._device_solve(stacked, pol, gangs)
+        dt = time.perf_counter() - t0
+        self.solve_calls += 1
+        self.waves_served += len(reqs)
+        self._m.solves.inc()
+        self._m.waves.inc(by=len(reqs))
+        self._m.batch.observe(len(reqs))
+        self._m.solve_s.observe(dt)
+        for i, r in enumerate(reqs):
+            self._m.requests.inc("ok")
+            try:
+                with r.send_lock:
+                    protocol.send_msg(
+                        r.conn,
+                        {"ok": True, "coalesced": len(reqs)},
+                        (np.ascontiguousarray(chosen[i, :r.p]),
+                         np.ascontiguousarray(scores[i, :r.p])))
+            except OSError:
+                _log.debug("requester went away before its reply")

@@ -120,10 +120,12 @@ class Histogram(_Metric):
             items = sorted((k, (list(c), n, t)) for k, (c, n, t) in self._series.items())
         for key, (counts, n, total) in items:
             for b, c in zip(self.buckets, counts):
+                le = 'le="' + _num(b) + '"'
                 out.append(f"{self.name}_bucket"
-                           f"{_fmt_labels(self.label_names, key, f'le=\"{_num(b)}\"')} {c}")
+                           f"{_fmt_labels(self.label_names, key, le)} {c}")
+            le_inf = 'le="+Inf"'
             out.append(f"{self.name}_bucket"
-                       f"{_fmt_labels(self.label_names, key, 'le=\"+Inf\"')} {n}")
+                       f"{_fmt_labels(self.label_names, key, le_inf)} {n}")
             out.append(f"{self.name}_sum{_fmt_labels(self.label_names, key)} {_num(total)}")
             out.append(f"{self.name}_count{_fmt_labels(self.label_names, key)} {n}")
         return out

@@ -422,3 +422,30 @@ class TestWaveRouter:
         plan = bs.WaveRouter().plan_for(host, pol, gangs, pb)
         assert plan.path == "device"
         assert plan.host_s != plan.host_s          # no calibration paid
+
+
+# -- the _ktpu_rows derived-row cache ---------------------------------------
+
+class TestEncodeRowCacheDebug:
+    def test_debug_mode_catches_in_place_spec_mutation(self, monkeypatch):
+        """KTPU_DEBUG recomputes every cache hit: mutating a PodSpec in
+        place (instead of going through deep_clone, which drops the
+        cache) must fail loudly instead of silently encoding stale rows."""
+        from kubernetes_tpu.models import snapshot as snapshot_mod
+        monkeypatch.setattr(snapshot_mod, "_DEBUG_VERIFY_ROWS", True)
+        nodes = [mk_node("n0")]
+        pod = mk_pod("p0", cpu_m=100)
+        encode_snapshot(nodes, [], [pod], [])        # populates the cache
+        encode_snapshot(nodes, [], [pod], [])        # verified hit: fine
+        pod.spec.containers[0].resources.limits["cpu"] = Quantity("2")
+        with pytest.raises(AssertionError, match="_ktpu_rows cache stale"):
+            encode_snapshot(nodes, [], [pod], [])
+
+    def test_deep_clone_drops_the_cache(self):
+        from kubernetes_tpu.runtime.clone import deep_clone
+        nodes = [mk_node("n0")]
+        pod = mk_pod("p1", cpu_m=100)
+        encode_snapshot(nodes, [], [pod], [])
+        assert "_ktpu_rows" in pod.spec.__dict__
+        clone = deep_clone(pod)
+        assert "_ktpu_rows" not in clone.spec.__dict__

@@ -449,8 +449,8 @@ class TestCORS:
     @pytest.fixture()
     def cors_server(self):
         srv = APIServer(Master(MasterConfig()),
-                        cors_allowed_origins=[r"^http://localhost(:\d+)?$",
-                                              r"//.*\.example\.com$"]).start()
+                        cors_allowed_origins=[r"http://localhost(:\d+)?",
+                                              r"https?://.*\.example\.com"]).start()
         yield srv
         srv.stop()
 
@@ -475,6 +475,15 @@ class TestCORS:
     def test_disallowed_origin_gets_no_cors_headers(self, cors_server):
         r = self._get(cors_server, "/healthz", origin="http://evil.test")
         assert r.headers.get("Access-Control-Allow-Origin") is None
+
+    def test_lookalike_origin_rejected(self, cors_server):
+        # anchored fullmatch: a pattern admitting *.example.com must NOT
+        # grant credentialed CORS to example.com.evil.net-style lookalikes
+        for origin in ("https://ui.example.com.evil.net",
+                       "http://localhost:3000.evil.net",
+                       "evil-https://ui.example.com"):
+            r = self._get(cors_server, "/healthz", origin=origin)
+            assert r.headers.get("Access-Control-Allow-Origin") is None, origin
 
     def test_no_origin_header_gets_no_cors_headers(self, cors_server):
         r = self._get(cors_server, "/healthz")
@@ -556,6 +565,76 @@ class TestReadOnlyAndRateLimit:
             body = json.loads(ei.value.read())
             # one Status-encoding path for every error (scheme-encoded)
             assert body["reason"] == "TooManyRequests", body
+        finally:
+            srv.stop()
+
+    def test_rejected_write_consumes_no_token(self):
+        # ReadOnly(RateLimit(handler)) ordering: the GET-only gate runs
+        # BEFORE the limiter, so a rejected write can't starve reads
+        from kubernetes_tpu.util.throttle import TokenBucketRateLimiter
+        import urllib.error
+        rl = TokenBucketRateLimiter(qps=0.001, burst=2)
+        srv = APIServer(Master(MasterConfig()), read_only=True,
+                        rate_limiter=rl).start()
+        try:
+            for _ in range(5):
+                req = urllib.request.Request(
+                    srv.base_url + "/api/v1/namespaces/default/pods",
+                    data=b"{}", headers={"Content-Type": "application/json"},
+                    method="POST")
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(req, timeout=5)
+                assert ei.value.code == 403
+            # both tokens still available for the reads
+            for _ in range(2):
+                assert urllib.request.urlopen(
+                    srv.base_url + "/healthz", timeout=5).status == 200
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(srv.base_url + "/healthz", timeout=5)
+            assert ei.value.code == 429
+        finally:
+            srv.stop()
+
+    def test_read_only_port_preflights_work_and_never_eat_tokens(self):
+        """The read-only throttled port must keep serving allowed-origin
+        preflights (non-simple GETs — Authorization etc. — need them)
+        while neither preflights nor non-CORS OPTIONS may consume the
+        tokens legitimate reads need."""
+        from kubernetes_tpu.util.throttle import TokenBucketRateLimiter
+        import urllib.error
+        rl = TokenBucketRateLimiter(qps=0.001, burst=2)
+        srv = APIServer(Master(MasterConfig()), read_only=True,
+                        rate_limiter=rl,
+                        cors_allowed_origins=[r"http://localhost(:\d+)?"],
+                        ).start()
+        try:
+            # allowed-origin preflights: 204 + CORS headers, token-free
+            for _ in range(5):
+                req = urllib.request.Request(
+                    srv.base_url + "/api/v1/namespaces/default/pods",
+                    method="OPTIONS")
+                req.add_header("Origin", "http://localhost:3000")
+                r = urllib.request.urlopen(req, timeout=5)
+                assert r.status == 204
+                assert r.headers["Access-Control-Allow-Origin"] == \
+                    "http://localhost:3000"
+            # non-preflight OPTIONS: the ReadOnly gate rejects it BEFORE
+            # the limiter (no token consumed)
+            for _ in range(5):
+                req = urllib.request.Request(
+                    srv.base_url + "/api/v1/namespaces/default/pods",
+                    method="OPTIONS")
+                req.add_header("Origin", "http://evil.test")
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(req, timeout=5)
+                assert ei.value.code == 403
+            # both tokens still available for the reads
+            for _ in range(2):
+                assert urllib.request.urlopen(
+                    srv.base_url + "/healthz", timeout=5).status == 200
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(srv.base_url + "/healthz", timeout=5)
+            assert ei.value.code == 429
         finally:
             srv.stop()
 

@@ -275,3 +275,22 @@ def test_endpoints_duplicate_addresses_keep_their_refs():
     assert back.endpoints[0].target_ref.name == "pod-a"
     assert back.endpoints[1].target_ref.name == "pod-b"
     assert back.endpoints[2].target_ref is None
+
+
+def test_datetime_wire_roundtrip_any_fraction_length():
+    """The encoder right-trims zero microseconds (".3506" for 350600us) and
+    RFC3339 allows any fraction length — but py3.10 fromisoformat only
+    accepts 3 or 6 digits, so ~11% of emitted timestamps failed to decode
+    until the decoder normalized the fraction (regression: the flaky
+    "Invalid isoformat string" pod-status errors)."""
+    from kubernetes_tpu.runtime.serialize import (_decode_datetime,
+                                                  _encode_datetime)
+    utc = datetime.timezone.utc
+    for us in (350600, 350000, 300000, 123456, 0, 100, 999999, 1):
+        dt = datetime.datetime(2026, 8, 3, 5, 44, 20, us, tzinfo=utc)
+        assert _decode_datetime(_encode_datetime(dt)) == dt, us
+    # foreign shapes: numeric offset, oversized fraction truncates
+    assert _decode_datetime("2026-08-03T05:44:20.3506+00:00").microsecond \
+        == 350600
+    assert _decode_datetime("2026-08-03T05:44:20.123456789Z").microsecond \
+        == 123456
