@@ -200,6 +200,25 @@ def _scrape_solverd(port: int) -> dict:
     return out
 
 
+def _scrape_pipeline(port: int) -> dict:
+    """Speculation counters from a pipelined scheduler worker's /metrics."""
+    raw = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+    out = {"speculation_hits": 0, "speculation_invalidations": 0,
+           "overlap_seconds": 0.0}
+    for line in raw.splitlines():
+        if line.startswith("scheduler_pipeline_speculation_hits_total "):
+            out["speculation_hits"] += int(float(line.rsplit(None, 1)[1]))
+        elif line.startswith(
+                "scheduler_pipeline_speculation_invalidations_total{"):
+            out["speculation_invalidations"] += int(
+                float(line.rsplit(None, 1)[1]))
+        elif line.startswith("scheduler_pipeline_overlap_seconds_total "):
+            out["overlap_seconds"] += float(line.rsplit(None, 1)[1])
+    out["overlap_seconds"] = round(out["overlap_seconds"], 3)
+    return out
+
+
 def _wave_stats_delta(start: dict, end: dict) -> dict:
     """Steady-state per-wave stats: END minus the post-warmup BASELINE, so
     the once-per-bucket XLA compiles paid during warmup don't pollute the
@@ -260,6 +279,12 @@ def main(argv=None) -> int:
                     "every scheduler worker at it (--solver-addr): waves "
                     "coalesce into batched solves in ONE hot solver "
                     "process instead of N cold in-process ones")
+    ap.add_argument("--pipeline", action="store_true",
+                    help="run every scheduler worker with --pipeline "
+                    "(speculative double-buffered waves): the encode and "
+                    "dispatch of wave k+1 overlap the HTTP commit "
+                    "round-trips of wave k — and the solverd round-trip "
+                    "when combined with --solverd")
     ap.add_argument("--port", type=int, default=18410)
     ap.add_argument("--out", default=None)
     ap.add_argument("--platform", choices=["cpu", "ambient"], default="cpu",
@@ -349,6 +374,8 @@ def main(argv=None) -> int:
                    "--metrics-port", str(sched_metrics_ports[w])]
             if solver_addr:
                 cmd += ["--solver-addr", solver_addr]
+            if args.pipeline:
+                cmd += ["--pipeline"]
             spawn(f"scheduler{w}", *cmd)
 
         # Bind counting rides a WATCH, not list polling: a full
@@ -456,6 +483,8 @@ def main(argv=None) -> int:
         sched_desc = ("tpu-batch scheduler"
                       if args.schedulers == 1 else
                       f"{args.schedulers} tpu-batch scheduler workers")
+        if args.pipeline:
+            sched_desc += " (--pipeline speculative double-buffering)"
         if solver_addr:
             sched_desc += " -> shared kube-solverd (wave coalescing)"
         record = {
@@ -479,6 +508,16 @@ def main(argv=None) -> int:
                 record["solverd"] = _scrape_solverd(solverd_metrics_port)
             except Exception as e:
                 record["solverd"] = {"error": f"scrape failed: {e}"}
+        if args.pipeline:
+            try:
+                pipes = [_scrape_pipeline(p) for p in sched_metrics_ports]
+                record["pipeline"] = {
+                    k: (round(sum(p[k] for p in pipes), 3)
+                        if k == "overlap_seconds"
+                        else sum(p[k] for p in pipes))
+                    for k in pipes[0]}
+            except Exception as e:
+                record["pipeline"] = {"error": f"scrape failed: {e}"}
         out = json.dumps(record, indent=1)
         print(out)
         if args.out:

@@ -31,7 +31,10 @@ if [[ "$RACE" == 1 ]]; then
             tests/test_remote_store.py tests/test_cache.py
             tests/test_http.py tests/test_stale_wave.py
             tests/test_websocket_pprof.py tests/test_cloudprovider.py
-            tests/test_envvars.py tests/test_capabilities.py)
+            tests/test_envvars.py tests/test_capabilities.py
+            tests/test_kubelet.py tests/test_process_runtime.py
+            tests/test_controllers.py tests/test_scheduler.py
+            tests/test_integration.py)
     rc=0
     for ((i = 1; i <= ROUNDS; i++)); do
         echo "=== race round ${i}/${ROUNDS} (switchinterval=1e-6) ==="

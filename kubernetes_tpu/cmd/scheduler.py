@@ -33,6 +33,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "with automatic in-process fallback when the "
                         "daemon is absent, busy, or unhealthy. Empty = "
                         "always solve in-process.")
+    p.add_argument("--pipeline", action="store_true",
+                   help="tpu-batch: speculative double-buffered wave "
+                        "scheduling — overlap the encode of wave k+1 "
+                        "(against the predicted post-commit state) and "
+                        "its solve dispatch with the solve/commit of "
+                        "wave k. Committed decisions stay bit-identical "
+                        "to the causal path: every speculation is "
+                        "verified against actual bind outcomes and store "
+                        "deltas before wave k+1 may commit, and "
+                        "divergence re-encodes first. Composes with "
+                        "--solver-addr (the speculative encode overlaps "
+                        "the daemon round-trip).")
     p.add_argument("--event-qps", "--event_qps", type=float, default=50.0,
                    help="client-side event rate limit (successor "
                         "codebases' --event-qps; 0 disables)")
@@ -107,11 +119,19 @@ def build_scheduler(opts):
             policy = schedplugins.load_policy(f.read())
     config = factory.create(provider=opts.algorithm_provider,
                             policy=policy, recorder=recorder,
-                            solver_addr=getattr(opts, "solver_addr", ""))
+                            solver_addr=getattr(opts, "solver_addr", ""),
+                            pipeline=getattr(opts, "pipeline", False))
+    if getattr(opts, "pipeline", False) and opts.algorithm != "tpu-batch":
+        print("kube-scheduler: --pipeline requires --algorithm tpu-batch; "
+              "ignoring", file=sys.stderr)
     if opts.algorithm == "tpu-batch":
         from kubernetes_tpu.models.policy import (UnsupportedPolicy,
                                                   batch_policy_from)
         from kubernetes_tpu.scheduler.tpu_batch import BatchScheduler
+        from kubernetes_tpu.util import warmstart
+        # a restarted scheduler reuses compiled wave programs and router
+        # calibrations instead of re-paying shape_setup_s/compile_s
+        warmstart.enable()
         try:
             batch_policy = batch_policy_from(opts.algorithm_provider, policy)
         except UnsupportedPolicy as e:

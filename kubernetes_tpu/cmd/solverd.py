@@ -54,6 +54,10 @@ def solverd_server(argv: List[str],
         return 2
 
     from kubernetes_tpu.solver.service import SolverService
+    from kubernetes_tpu.util import warmstart
+    # the daemon owns the hottest solver runtime in the topology: reuse
+    # compiled wave programs + router calibrations across restarts
+    warmstart.enable()
 
     srv = SolverService(host=opts.address, port=opts.port,
                         gather_window_s=opts.gather_window,

@@ -51,6 +51,7 @@ changing this module.
 from __future__ import annotations
 
 import functools
+import json
 import math
 import os
 import threading
@@ -634,12 +635,93 @@ _PLAN_DEVICE = WavePlan("device", None, _NAN, _NAN, _NAN)
 class WaveRouter:
     """Measured host-vs-device dispatch, cached per shape bucket (the
     incremental encoder's pow-2 bucketing keeps the bucket set finite, so
-    calibration is a once-per-shape cost like XLA compilation)."""
+    calibration is a once-per-shape cost like XLA compilation).
+
+    Calibrations persist: ``load_calibrations(path)`` (wired by
+    util/warmstart.enable) restores prior measured plans keyed by the
+    same (shapes, policy, gangs, pallas-eligibility) tuple — serialized
+    via its stable repr — so a restarted scheduler skips the O(seconds..
+    minutes) per-shape calibration the same way the JAX persistent
+    compilation cache skips the compile. Timings are machine-local, which
+    is exactly what a repo-local cache dir scopes them to."""
 
     def __init__(self, cal_runs: int = 2):
         self.cal_runs = cal_runs
         self._plans: dict = {}
         self._lock = threading.Lock()
+        self._persisted: dict = {}   # repr(key) -> plan fields
+        self._cal_path: Optional[str] = None
+
+    # -- persistence --------------------------------------------------------
+    def load_calibrations(self, path: str) -> int:
+        """Point the router at a calibration store, loading any prior
+        plans. Returns the number of usable entries. Unreadable or
+        version-skewed files are ignored (calibration is always safe to
+        re-pay)."""
+        with self._lock:
+            self._cal_path = path
+        try:
+            with open(path) as fh:
+                data = json.load(fh)
+        except (OSError, ValueError):
+            return 0
+        if not isinstance(data, dict) or data.get("v") != 1:
+            return 0
+        plans = data.get("plans")
+        if not isinstance(plans, dict):
+            return 0
+        with self._lock:
+            self._persisted.update(plans)
+            return len(plans)
+
+    @staticmethod
+    def _cal_key(key) -> str:
+        """Persisted-store key: the in-memory plan key PLUS the default
+        backend. Calibration timings are a property of the attached
+        device — a 'device' plan measured over a TPU tunnel must never be
+        restored into a CPU-only restart (the tunnel dropping is a
+        recurring condition here), nor vice versa."""
+        return f"{jax.default_backend()}|{key!r}"
+
+    def save_calibrations(self) -> None:
+        """Best-effort atomic write of every known plan (persisted +
+        this process's fresh calibrations) to the configured store."""
+        with self._lock:
+            path = self._cal_path
+            if not path:
+                return
+            merged = dict(self._persisted)
+            for key, plan in self._plans.items():
+                if plan.host_s == plan.host_s:  # calibrated plans only
+                    merged[self._cal_key(key)] = {
+                        "path": plan.path, "host_s": plan.host_s,
+                        "device_s": plan.device_s, "cold_s": plan.cold_s}
+        try:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            tmp = f"{path}.{os.getpid()}.tmp"
+            with open(tmp, "w") as fh:
+                json.dump({"v": 1, "plans": merged}, fh)
+            os.replace(tmp, path)
+        except OSError:
+            pass
+
+    def _from_persisted(self, key, cpu) -> Optional[WavePlan]:
+        with self._lock:
+            rec = self._persisted.get(self._cal_key(key))
+        if not isinstance(rec, dict):
+            return None
+        try:
+            if rec["path"] == "host":
+                plan = WavePlan("host", cpu, float(rec["host_s"]),
+                                float(rec["device_s"]), float(rec["cold_s"]))
+            else:
+                plan = WavePlan("device", None, float(rec["host_s"]),
+                                float(rec["device_s"]), float(rec["cold_s"]))
+        except (KeyError, TypeError, ValueError):
+            return None
+        with self._lock:
+            self._plans[key] = plan
+        return plan
 
     def plan_for(self, host: SolverInputs, pol, gangs: bool,
                  peer_bound: int) -> WavePlan:
@@ -669,9 +751,12 @@ class WaveRouter:
         with self._lock:
             plan = self._plans.get(key)
         if plan is None:
+            plan = self._from_persisted(key, cpu)
+        if plan is None:
             plan = self._calibrate(host, pol, gangs, peer_bound, cpu)
             with self._lock:
                 self._plans[key] = plan
+            self.save_calibrations()
         return plan
 
     def _time_path(self, host, pol, gangs, peer_bound, device):

@@ -165,6 +165,12 @@ class SchedulerConfig:
     # Recorded here (not on the driver) so any wave-capable driver built
     # from this config inherits the cluster's solver topology.
     solver_addr: str = ""
+    # Speculative double-buffered wave scheduling (kube-scheduler
+    # --pipeline): overlap the encode of wave k+1 with the solve/commit of
+    # wave k. Decisions stay bit-identical to the causal path — the
+    # speculative encode is verified against actual commit outcomes before
+    # wave k+1 ever dispatches (scheduler/tpu_batch.py divergence protocol).
+    pipeline: bool = False
 
 
 class Scheduler:
@@ -280,12 +286,20 @@ class ConfigFactory:
         self.modeler = SimpleModeler(self.pod_queue, self.scheduled_pods)
         self.backoff = PodBackoff()
         self._runners = []
+        # backoff-requeue threads (error handler): tracked so stop() can
+        # wake them early (they sleep on this event, not time.sleep) and
+        # join them — a requeue outliving its factory would re-fetch
+        # against a torn-down apiserver and stack-trace in a daemon thread
+        self._stopping = threading.Event()
+        self._requeue_threads: list = []
+        self._requeue_lock = threading.Lock()
 
     def create(self, provider: str = schedplugins.DEFAULT_PROVIDER,
                policy: Optional[schedplugins.Policy] = None,
                algorithm_override=None,
                recorder: Optional[EventRecorder] = None,
-               solver_addr: str = "") -> SchedulerConfig:
+               solver_addr: str = "", pipeline: bool = False
+               ) -> SchedulerConfig:
         """ref: factory.go:77-172 CreateFromProvider/CreateFromConfig/
         CreateFromKeys."""
         # reflector: unassigned pods -> FIFO (field selector spec.host=)
@@ -336,6 +350,7 @@ class ConfigFactory:
             provider=provider,
             policy=policy,
             solver_addr=solver_addr,
+            pipeline=pipeline,
         )
 
     def stop(self, join: bool = False, timeout: float = 2.0) -> bool:
@@ -343,7 +358,13 @@ class ConfigFactory:
         threads to exit so no in-flight watch delivery can land in the
         stores afterwards — the deterministic-freeze contract the
         stale-wave tests rely on. Returns False iff a join timed out
-        (the freeze is then NOT guaranteed)."""
+        (the freeze is then NOT guaranteed).
+
+        Backoff-requeue threads are always woken (they wait on the stop
+        event instead of sleeping) and joined, so a stopped factory never
+        leaves a daemon thread behind to re-fetch from a torn-down
+        apiserver."""
+        self._stopping.set()
         for r in self._runners:
             r.stop()
         frozen = True
@@ -352,6 +373,12 @@ class ConfigFactory:
                 joiner = getattr(r, "join", None)
                 if joiner is not None and not joiner(timeout):
                     frozen = False
+        with self._requeue_lock:
+            requeues = list(self._requeue_threads)
+        for t in requeues:
+            t.join(timeout)
+            if t.is_alive() and join:
+                frozen = False
         return frozen
 
     def _next_pod(self, timeout: Optional[float] = None) -> api.Pod:
@@ -363,20 +390,33 @@ class ConfigFactory:
         if still unscheduled."""
 
         def handle(pod: api.Pod, err: Exception) -> None:
+            if self._stopping.is_set():
+                return
             key = meta_namespace_key_func(pod)
             delay = self.backoff.get_backoff(key)
 
             def requeue():
-                time.sleep(delay)
+                # stop() wakes this immediately — no orphaned sleeper
+                if self._stopping.wait(delay):
+                    return
                 try:
                     fresh = self.client.pods(pod.metadata.namespace).get(pod.metadata.name)
                     if not fresh.spec.host:
                         self.pod_queue.add(fresh)
                 except errors.StatusError:
                     pass  # deleted meanwhile
+                except OSError:
+                    pass  # apiserver unreachable (shutdown race): drop —
+                    #       a live pod relists into the queue on reconnect
                 self.backoff.gc()
 
-            threading.Thread(target=requeue, daemon=True).start()
+            t = threading.Thread(target=requeue, daemon=True,
+                                 name="scheduler-requeue")
+            with self._requeue_lock:
+                self._requeue_threads[:] = [x for x in self._requeue_threads
+                                            if x.is_alive()]
+                self._requeue_threads.append(t)
+            t.start()
 
         return handle
 

@@ -15,6 +15,42 @@ boundary the reference exposes for alternate schedulers.
 
 Bind conflicts (another scheduler won the CAS) invalidate that pod only; the
 error handler requeues it and the next wave re-solves against fresh state.
+
+**Pipelined mode** (``SchedulerConfig.pipeline`` / ``kube-scheduler
+--pipeline``): the causal loop serializes drain -> encode -> solve ->
+commit, so the host sits idle while the device (or the solverd round-trip)
+works and vice versa. The pipelined loop double-buffers:
+
+- wave k's solve runs on a side thread while the loop thread drains wave
+  k+1 (the linger window rides the solve, free);
+- once wave k's decisions exist, its bindings commit on a commit thread
+  while the loop thread encodes wave k+1 against the PREDICTED
+  post-commit state — the incremental encoder's resident planes plus
+  wave k's not-yet-committed placements — and dispatches wave k+1's
+  solve speculatively, so the solve of wave k+1 rides the commit of
+  wave k;
+- when the commit lands, the prediction is verified before anything from
+  wave k+1 may commit: every placed pod must have bound at its chosen
+  host, and the modeler's changelog since the encoder's token must
+  contain exactly those events (watch re-deliveries of already-resident
+  pods are classified benign). Any divergence — a CAS-lost bind, a
+  foreign store delta, a changelog resync — invalidates the speculation:
+  the in-flight speculative solve is discarded unseen, the predicted
+  rows roll back (exact inverse on the resident planes), and the wave
+  re-encodes causally before re-dispatching.
+
+Committed decisions therefore stay bit-identical to the causal path (and
+to the serial oracle): speculation only ever changes WHEN work runs,
+never what state a committed decision was solved against. Steady-state
+wave cost drops from ``drain + encode + solve + commit`` to roughly
+``encode + max(solve, commit + drain)``. Instrumented as the
+``scheduler_pipeline_*`` metric family (speculation hits, invalidations
+by reason, overlapped seconds).
+
+Speculation requires the incremental encoder (delta-maintained planes) and
+the modeler changelog; waves carrying gang members skip speculation (their
+quorum gate needs an authoritative existing-pod list) and encode causally
+— correctness never depends on speculation being available.
 """
 
 from __future__ import annotations
@@ -22,7 +58,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import List, Optional
+from typing import List, NamedTuple, Optional
 
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.client.record import EventRecorder
@@ -31,6 +67,7 @@ from kubernetes_tpu.models.batch_solver import decisions_to_names, solve
 from kubernetes_tpu.models.incremental import IncrementalEncoder
 from kubernetes_tpu.models.policy import BatchPolicy, batch_policy_from
 from kubernetes_tpu.models.snapshot import encode_snapshot
+from kubernetes_tpu.runtime.clone import deep_clone
 from kubernetes_tpu.scheduler.driver import ConfigFactory, SchedulerConfig
 from kubernetes_tpu.scheduler.generic import FitError
 from kubernetes_tpu.util import metrics
@@ -73,6 +110,60 @@ def _wave_metrics() -> _WaveMetrics:
     return _WaveMetrics._singleton
 
 
+class _PipelineMetrics:
+    """The scheduler_pipeline_* family: speculative double-buffering
+    effectiveness. hits/invalidations partition the speculated waves;
+    overlap_seconds_total is the wall time of host work that ran under a
+    solve or a commit instead of after it."""
+
+    _singleton = None
+
+    def __init__(self):
+        reg = metrics.default_registry()
+        self.waves = reg.counter(
+            "scheduler_pipeline_waves_total",
+            "Waves run by the pipelined loop")
+        self.hits = reg.counter(
+            "scheduler_pipeline_speculation_hits_total",
+            "Speculative encodes verified and dispatched without re-encode")
+        self.invalidations = reg.counter(
+            "scheduler_pipeline_speculation_invalidations_total",
+            "Speculative encodes invalidated before dispatch, by divergence "
+            "reason", label_names=("reason",))
+        self.unspeculated = reg.counter(
+            "scheduler_pipeline_unspeculated_waves_total",
+            "Next waves encoded causally without a speculation attempt "
+            "(gang members present, or no resident delta state yet)")
+        self.overlap = reg.counter(
+            "scheduler_pipeline_overlap_seconds_total",
+            "Wall seconds of drain/encode work overlapped with the solve "
+            "and commit of the preceding wave")
+
+
+def _pipeline_metrics() -> _PipelineMetrics:
+    if _PipelineMetrics._singleton is None:
+        _PipelineMetrics._singleton = _PipelineMetrics()
+    return _PipelineMetrics._singleton
+
+
+class _SpecResult(NamedTuple):
+    """Outcome of a speculative encode (see BatchScheduler._speculate)."""
+
+    snap: object           # ClusterSnapshot, or None when speculation failed
+    pending: Optional[list]  # ordered wave pods (None when snap is None)
+    applied: bool          # predicted rows were applied to the encoder
+    reason: str            # "" on success, else the failure class
+    encode_s: float
+
+
+class _Inflight(NamedTuple):
+    """Carry between pipelined cycles: the wave whose solve is running on
+    the solve thread right now."""
+
+    fut: object            # Future -> decision host names
+    pending: list          # the wave's ordered pods (snap row order)
+
+
 class BatchScheduler:
     """Wave-based driver over SchedulerConfig plumbing.
 
@@ -87,7 +178,7 @@ class BatchScheduler:
     def __init__(self, config: SchedulerConfig, factory: ConfigFactory,
                  client, wave_size: int = 1024, wave_linger_s: float = 0.02,
                  solve_fn=None, batch_policy: BatchPolicy = None,
-                 solver=None):
+                 solver=None, pipeline: Optional[bool] = None):
         self.config = config
         self.factory = factory
         self.client = client
@@ -107,6 +198,10 @@ class BatchScheduler:
             from kubernetes_tpu.solver.client import RemoteSolver
             solver = RemoteSolver(addr)
         self.solver = solver
+        # speculative double-buffered wave loop (module docstring); None
+        # inherits the config's recorded --pipeline flag
+        self.pipeline = bool(getattr(config, "pipeline", False)
+                             if pipeline is None else pipeline)
         try:
             # delta-maintained node planes + sticky vocabularies: per-wave
             # encode cost is O(changed pods), and pow-2 bucketing keeps the
@@ -135,9 +230,54 @@ class BatchScheduler:
                 break
         return pods
 
+    def _make_get_existing(self):
+        """Lazy memoized existing-pod list: materialized only when
+        something needs it (gang quorum, encoder resync), so the
+        steady-state delta path stays O(changed), not O(cluster). The
+        token is taken BEFORE the list it pairs with, so an event racing
+        the list is re-delivered by the next delta (idempotent in the
+        encoder) rather than lost."""
+        c = self.config
+        memo: dict = {}
+
+        def get_existing():
+            if "list" not in memo:
+                if hasattr(c.modeler, "token"):
+                    memo["token"] = c.modeler.token()
+                memo["list"] = c.modeler.list()
+            return memo["list"]
+
+        get_existing.pre_token = lambda: memo.get("token")
+        return get_existing
+
+    def _prepare_wave(self, pods: List[api.Pod]):
+        """Admission for a drained wave: node/service listing + gang
+        quorum gate + gang-contiguous ordering. Returns (pending, nodes,
+        services, get_existing), or None when the wave emptied (every pod
+        was evented + handed to the error handler)."""
+        c = self.config
+        get_existing = self._make_get_existing()
+        try:
+            nodes = c.minion_lister.list().items
+            services = self.factory.service_store.list()
+            pending, starved = self._gate_gang_quorum(pods, get_existing)
+        except Exception as e:
+            for pod in pods:
+                self._record(pod, "FailedScheduling",
+                             "Error scheduling wave: %s", e)
+                c.error(pod, e)
+            return None
+        for pod in starved:
+            err = FitError(pod, {})
+            self._record(pod, "FailedScheduling",
+                         "Pod group below min-members quorum")
+            c.error(pod, err)
+        if not pending:
+            return None
+        return gang.order_wave(pending), nodes, services, get_existing
+
     # -- solving ------------------------------------------------------------
-    def _default_solve(self, nodes, existing, pending, services):
-        get_existing = existing if callable(existing) else lambda: existing
+    def _encode_wave(self, nodes, pending, services, get_existing):
         t0 = time.perf_counter()
         if self._encoder is not None:
             snap = self._encode_incremental(nodes, pending, services,
@@ -145,18 +285,28 @@ class BatchScheduler:
         else:
             snap = encode_snapshot(nodes, get_existing(), pending, services,
                                    policy=self.batch_policy)
-        t1 = time.perf_counter()
-        # both paths include the gang all-or-nothing post-pass; RemoteSolver
-        # falls back to the in-process solve when the daemon is absent/busy
+        _wave_metrics().encode.observe(time.perf_counter() - t0)
+        return snap
+
+    def _solve_snap(self, snap, n_pending: int):
+        """One wave's solve (in-process or via the shared daemon) ->
+        decision host names. Thread-safe: runs on the pipelined loop's
+        solve thread; both paths include the gang all-or-nothing post-pass
+        and RemoteSolver falls back in-process when the daemon is
+        absent/busy."""
+        t0 = time.perf_counter()
         if self.solver is not None:
             chosen, _ = self.solver.solve(snap)
         else:
             chosen, _ = solve(snap)
-        t2 = time.perf_counter()
-        _wave_metrics().encode.observe(t1 - t0)
-        _wave_metrics().solve.observe(t2 - t1)
-        _wave_metrics().pods.inc(by=len(pending))
+        _wave_metrics().solve.observe(time.perf_counter() - t0)
+        _wave_metrics().pods.inc(by=n_pending)
         return decisions_to_names(snap, chosen)
+
+    def _default_solve(self, nodes, existing, pending, services):
+        get_existing = existing if callable(existing) else lambda: existing
+        snap = self._encode_wave(nodes, pending, services, get_existing)
+        return self._solve_snap(snap, len(pending))
 
     def _encode_incremental(self, nodes, pending, services, get_existing):
         """O(changed + pending) when the modeler's changelog covers the
@@ -226,71 +376,30 @@ class BatchScheduler:
                 ok.append(p)
         return ok, starved
 
-    def schedule_wave(self, timeout: Optional[float] = None) -> int:
-        """Drain, solve, commit. Returns the number of pods bound."""
+    # -- commit -------------------------------------------------------------
+    def _split_decisions(self, pending, decisions):
+        """(pod, host) pairs for placed pods; unschedulable pods are
+        evented + handed to the error handler (backoff + requeue)."""
         c = self.config
-        pending = self._drain_wave(timeout)
-        # the full existing-pod list is only materialized when something
-        # actually needs it (gang quorum, or an encoder resync) — the
-        # steady-state delta path stays O(changed), not O(cluster)
-        memo: dict = {}
-
-        def get_existing():
-            if "list" not in memo:
-                # token BEFORE list: an event racing the list is
-                # re-delivered by the next delta (idempotent in the
-                # encoder) rather than lost forever
-                if hasattr(c.modeler, "token"):
-                    memo["token"] = c.modeler.token()
-                memo["list"] = c.modeler.list()
-            return memo["list"]
-
-        get_existing.pre_token = lambda: memo.get("token")
-
-        try:
-            nodes = c.minion_lister.list().items
-            services = self.factory.service_store.list()
-            pending, starved = self._gate_gang_quorum(pending, get_existing)
-        except Exception as e:
-            for pod in pending:
-                self._record(pod, "FailedScheduling", "Error scheduling wave: %s", e)
-                c.error(pod, e)
-            return 0
-        for pod in starved:
-            err = FitError(pod, {})
-            self._record(pod, "FailedScheduling",
-                         "Pod group below min-members quorum")
-            c.error(pod, err)
-        if not pending:
-            return 0
-        pending = gang.order_wave(pending)
-        try:
-            if self._using_default_solve:
-                # the default solve resolves `existing` lazily (delta path)
-                decisions = self._default_solve(nodes, get_existing,
-                                                pending, services)
-            else:
-                decisions = self.solve_fn(nodes, get_existing(), pending,
-                                          services)
-        except Exception as e:
-            # a failed solve must not drop the drained wave: hand every pod
-            # to the error handler for backoff+requeue, like the serial
-            # driver does per pod (scheduler.go:96-101)
-            for pod in pending:
-                self._record(pod, "FailedScheduling", "Error scheduling wave: %s", e)
-                c.error(pod, e)
-            return 0
-
         placed = []
         for pod, host in zip(pending, decisions):
             if host is None:
                 err = FitError(pod, {})
-                self._record(pod, "FailedScheduling", "Error scheduling: %s", err)
+                self._record(pod, "FailedScheduling",
+                             "Error scheduling: %s", err)
                 c.error(pod, err)
             else:
                 placed.append((pod, host))
-        if not placed:
-            return 0
+        return placed
+
+    def _commit_wave(self, placed, assumed: Optional[list] = None):
+        """Bind the wave's placements, event every outcome, assume the
+        winners. ``assumed`` optionally supplies the pre-built post-bind
+        clones — the pipelined path shares them with the speculative
+        encode so the encoder and the modeler account the IDENTICAL
+        objects. Returns (outcomes, bound): outcomes[i] is None on
+        success, else the bind error (aligned with ``placed``)."""
+        c = self.config
 
         def mk_binding(pod, host) -> api.Binding:
             return api.Binding(
@@ -327,30 +436,304 @@ class BatchScheduler:
                 except Exception as e:
                     outcomes[idx] = e
 
-        from kubernetes_tpu.runtime.clone import deep_clone
+        if assumed is None:
+            # value copy before mutating (the popped pod may be shared);
+            # deep_clone, not copy.deepcopy — at churn rates the stdlib
+            # deepcopy was the scheduler's single largest CPU sink
+            assumed = []
+            for pod, host in placed:
+                cl = deep_clone(pod)
+                cl.spec.host = host
+                cl.status.host = host
+                assumed.append(cl)
 
         bound = 0
-        for (pod, host), err in zip(placed, outcomes):
+        for (pod, host), cl, err in zip(placed, assumed, outcomes):
             if err is not None:
                 # lost a CAS race: requeue; next wave sees fresh state
-                self._record(pod, "FailedScheduling", "Binding rejected: %s", err)
+                self._record(pod, "FailedScheduling",
+                             "Binding rejected: %s", err)
                 c.error(pod, err)
                 continue
             self._record(pod, "Scheduled", "Successfully assigned %s to %s",
                          pod.metadata.name, host)
-            # value copy before mutating (the popped pod may be shared);
-            # deep_clone, not copy.deepcopy — at churn rates the stdlib
-            # deepcopy was the scheduler's single largest CPU sink
-            assumed = deep_clone(pod)
-            assumed.spec.host = host
-            assumed.status.host = host
-            c.modeler.assume_pod(assumed)
+            c.modeler.assume_pod(cl)
             bound += 1
+        return outcomes, bound
+
+    def schedule_wave(self, timeout: Optional[float] = None) -> int:
+        """Drain, solve, commit — the causal wave. Returns the number of
+        pods bound."""
+        c = self.config
+        pods = self._drain_wave(timeout)
+        prep = self._prepare_wave(pods)
+        if prep is None:
+            return 0
+        pending, nodes, services, get_existing = prep
+        try:
+            if self._using_default_solve:
+                # the default solve resolves `existing` lazily (delta path)
+                decisions = self._default_solve(nodes, get_existing,
+                                                pending, services)
+            else:
+                decisions = self.solve_fn(nodes, get_existing(), pending,
+                                          services)
+        except Exception as e:
+            # a failed solve must not drop the drained wave: hand every pod
+            # to the error handler for backoff+requeue, like the serial
+            # driver does per pod (scheduler.go:96-101)
+            for pod in pending:
+                self._record(pod, "FailedScheduling",
+                             "Error scheduling wave: %s", e)
+                c.error(pod, e)
+            return 0
+
+        placed = self._split_decisions(pending, decisions)
+        if not placed:
+            return 0
+        _, bound = self._commit_wave(placed)
         return bound
+
+    # -- pipelined wave loop ------------------------------------------------
+    def _can_pipeline(self) -> bool:
+        return (self._encoder is not None and self._using_default_solve
+                and hasattr(self.config.modeler, "delta")
+                and hasattr(self.config.modeler, "token"))
+
+    def _pipeline_unavailable_reason(self) -> str:
+        if self._encoder is None:
+            return "policy needs the order-dependent full encoder"
+        if not self._using_default_solve:
+            return "custom solve_fn bypasses the snapshot seam"
+        return "modeler lacks the token/delta changelog"
+
+    def _speculate(self, pods: List[api.Pod],
+                   predicted: List[api.Pod]) -> _SpecResult:
+        """Encode wave k+1 against the PREDICTED post-commit state: the
+        encoder's resident planes plus wave k's not-yet-committed
+        placements. Runs on the loop thread while the commit thread binds
+        wave k — the commit path never touches the encoder, and this
+        never reads the modeler (a half-committed view would be
+        unverifiable)."""
+        t0 = time.perf_counter()
+        enc = self._encoder
+        if any(enc.has_pod(p.metadata.uid) for p in predicted):
+            # a predicted pod is already resident (e.g. a stale requeue of
+            # a pod another scheduler bound — its CAS will lose): applying
+            # would re-account the row and rollback could not restore it
+            return _SpecResult(None, None, False, "resident_conflict",
+                               time.perf_counter() - t0)
+        try:
+            nodes = self.config.minion_lister.list().items
+            services = self.factory.service_store.list()
+        except Exception:
+            return _SpecResult(None, None, False, "lister_error",
+                               time.perf_counter() - t0)
+        pending = gang.order_wave(pods)  # identity: wave is gang-free
+        snap = enc.encode_delta(nodes, predicted, [], pending, services)
+        if snap is None:
+            # encode_delta declines before applying anything when the
+            # node/service planes changed, but an overflow is detected
+            # after the apply — has_pod says which happened
+            applied = any(enc.has_pod(p.metadata.uid) for p in predicted)
+            return _SpecResult(None, None, applied, "encoder_fallback",
+                               time.perf_counter() - t0)
+        _wave_metrics().encode.observe(time.perf_counter() - t0)
+        return _SpecResult(snap, pending, True, "", time.perf_counter() - t0)
+
+    def _verify_speculation(self, spec: _SpecResult, predicted, outcomes):
+        """The divergence check: compare the prediction (every placed pod
+        bound at its chosen host, nothing else changed) against what
+        actually happened. Returns (reason, token, failed_uids):
+
+        - ``""``: the prediction held exactly — the speculative encode
+          (and any solve already in flight on it) is valid;
+        - ``"bind_failed"``: the only divergence is CAS-lost/failed binds
+          (or a speculative overflow) — O(changed) repair is possible;
+        - ``"store_delta"`` / ``"resync"``: foreign interference (another
+          scheduler's pod landed, a pod was removed, the changelog
+          window was exceeded) — full causal re-encode required.
+        """
+        failed_uids = {cl.metadata.uid for cl, err in zip(predicted, outcomes)
+                       if err is not None}
+        ok_uids = {cl.metadata.uid for cl in predicted} - failed_uids
+        d = self.config.modeler.delta(self._delta_token)
+        if d is None:
+            return "resync", None, failed_uids
+        upserted, removed, token = d
+        by_uid = {cl.metadata.uid: cl.status.host for cl in predicted}
+        matched = set()
+        for p in upserted:
+            uid = p.metadata.uid
+            if uid in ok_uids and by_uid.get(uid) == p.status.host:
+                matched.add(uid)
+                continue
+            if self._encoder.is_noop_upsert(p):
+                continue  # watch-confirm re-delivery of a resident pod
+            return "store_delta", None, failed_uids
+        if removed or matched != ok_uids:
+            # a removal touches node capacity; a missing assume event
+            # means the changelog raced — both are foreign interference
+            return "store_delta", None, failed_uids
+        if failed_uids or spec.snap is None:
+            return "bind_failed", token, failed_uids
+        return "", token, failed_uids
+
+    def _dispatch_causal(self, pods, solve_pool,
+                         pm: _PipelineMetrics) -> Optional[_Inflight]:
+        """Prepare + causally encode + dispatch a wave (bootstrap, and the
+        restart path after a divergence or an unspeculated wave)."""
+        if not pods:
+            return None
+        prep = self._prepare_wave(pods)
+        if prep is None:
+            return None
+        pending, nodes, services, get_existing = prep
+        snap = self._encode_wave(nodes, pending, services, get_existing)
+        pm.waves.inc()
+        return _Inflight(solve_pool.submit(self._solve_snap, snap,
+                                           len(pending)), pending)
+
+    def _pipelined_cycle(self, inflight: Optional[_Inflight], solve_pool,
+                         commit_pool, pm: _PipelineMetrics
+                         ) -> Optional[_Inflight]:
+        """One double-buffered wave. With wave k's solve in flight:
+
+        1. drain wave k+1 (the linger rides the solve);
+        2. collect wave k's decisions;
+        3. start wave k's commit on the commit thread;
+        4. speculatively encode wave k+1 against the predicted post-commit
+           planes and dispatch its solve — both riding wave k's commit;
+        5. when the commit lands, verify the prediction: a hit keeps the
+           in-flight wave k+1 solve, a divergence discards it, rolls the
+           predicted rows back, and re-encodes before re-dispatching.
+
+        Committed decisions are bit-identical to the causal loop:
+        speculation changes when work runs, never what state it sees."""
+        c = self.config
+        if inflight is None:
+            # bootstrap / restart: nothing in flight, encode causally.
+            # An empty queue is a normal idle tick, NOT an error — and it
+            # must be distinguished here, not by exception type in the
+            # loop: on py3.10+ socket.timeout IS TimeoutError, so a
+            # network timeout escaping a cycle must never be mistaken
+            # for an empty drain (the stale in-flight wave would then be
+            # committed twice by the next iteration).
+            try:
+                pods = self._drain_wave(timeout=0.2)
+            except TimeoutError:
+                return None
+            return self._dispatch_causal(pods, solve_pool, pm)
+        pending = inflight.pending
+        # overlap 1: drain wave k+1 while wave k solves
+        t0 = time.perf_counter()
+        next_pods: List[api.Pod] = []
+        try:
+            next_pods = self._drain_wave(timeout=self.wave_linger_s)
+        except TimeoutError:
+            pass
+        drain_s = time.perf_counter() - t0
+        try:
+            decisions = inflight.fut.result()
+        except Exception as e:
+            for pod in pending:
+                self._record(pod, "FailedScheduling",
+                             "Error scheduling wave: %s", e)
+                c.error(pod, e)
+            return self._dispatch_causal(next_pods, solve_pool, pm)
+        solve_s = time.perf_counter() - t0
+        pm.overlap.inc(by=min(drain_s, solve_s))
+        placed = self._split_decisions(pending, decisions)
+        if not placed:
+            return self._dispatch_causal(next_pods, solve_pool, pm)
+        # the predicted post-bind clones: shared verbatim between the
+        # speculative encode and assume_pod, so a verified hit leaves the
+        # encoder accounting the very objects the modeler holds
+        predicted = []
+        for pod, host in placed:
+            cl = deep_clone(pod)
+            cl.spec.host = host
+            cl.status.host = host
+            predicted.append(cl)
+        # wave k's bindings commit on the commit thread; the speculative
+        # encode (overlap 2) and wave k+1's solve (overlap 3) ride it
+        t_c0 = time.perf_counter()
+        commit_fut = commit_pool.submit(self._commit_wave, placed, predicted)
+        spec = None
+        next_fut = None
+        if next_pods and self._delta_token is not None and \
+                not any(gang.gang_key(p) is not None for p in next_pods):
+            spec = self._speculate(next_pods, predicted)
+            if spec.snap is not None:
+                next_fut = solve_pool.submit(self._solve_snap, spec.snap,
+                                             len(spec.pending))
+        elif next_pods:
+            pm.unspeculated.inc()
+        try:
+            outcomes, _bound = commit_fut.result()
+        except Exception as e:
+            # infra fault mid-commit: roll the speculation back and force
+            # a full resync — the encoder must not keep unverified rows.
+            # The already-drained next wave would otherwise be stranded
+            # (popped from the FIFO, never solved): hand it to the error
+            # handler, which re-fetches and requeues still-unbound pods.
+            if spec is not None and spec.applied:
+                self._encoder.forget_pods(
+                    [cl.metadata.uid for cl in predicted])
+            self._delta_token = None
+            for pod in next_pods:
+                self._record(pod, "FailedScheduling",
+                             "Error scheduling wave: %s", e)
+                c.error(pod, e)
+            raise
+        commit_s = time.perf_counter() - t_c0
+        if spec is None:
+            return self._dispatch_causal(next_pods, solve_pool, pm)
+        pm.overlap.inc(by=min(commit_s, spec.encode_s))
+        reason, token, failed_uids = self._verify_speculation(
+            spec, predicted, outcomes)
+        if not reason:
+            # prediction held: wave k+1 is already solving on the exact
+            # state the causal path would have encoded
+            self._delta_token = token
+            pm.hits.inc()
+            pm.waves.inc()
+            return _Inflight(next_fut, spec.pending)
+        # divergence: the in-flight speculative solve (if any) is
+        # discarded — its results never commit
+        if reason == "bind_failed" and spec.applied:
+            # only this wave's own CAS losers (and/or an overflow) diverged:
+            # roll back the losing rows and rebuild over corrected planes
+            self._encoder.forget_pods(failed_uids)
+            self._delta_token = token
+            pm.invalidations.inc("bind_failed" if failed_uids
+                                 else spec.reason or "encoder_fallback")
+            pending2 = spec.pending if spec.pending is not None \
+                else gang.order_wave(next_pods)
+            try:
+                nodes = c.minion_lister.list().items
+                services = self.factory.service_store.list()
+                snap2 = self._encoder.encode_delta(nodes, [], [], pending2,
+                                                   services)
+            except Exception:
+                snap2 = None
+            if snap2 is not None:
+                pm.waves.inc()
+                return _Inflight(solve_pool.submit(self._solve_snap, snap2,
+                                                   len(pending2)), pending2)
+            return self._dispatch_causal(next_pods, solve_pool, pm)
+        # foreign interference: exact rollback of every speculative row;
+        # the un-advanced token re-delivers the actual events (including
+        # this wave's real binds) to the causal encode below
+        if spec.applied:
+            self._encoder.forget_pods([cl.metadata.uid for cl in predicted])
+        pm.invalidations.inc(reason or spec.reason or "speculation_failed")
+        return self._dispatch_causal(next_pods, solve_pool, pm)
 
     # -- loop ---------------------------------------------------------------
     def run(self) -> "BatchScheduler":
-        t = threading.Thread(target=self._loop, daemon=True, name="tpu-batch-scheduler")
+        t = threading.Thread(target=self._loop, daemon=True,
+                             name="tpu-batch-scheduler")
         t.start()
         return self
 
@@ -358,6 +741,15 @@ class BatchScheduler:
         self._stop.set()
 
     def _loop(self) -> None:
+        if self.pipeline:
+            if self._can_pipeline():
+                return self._loop_pipelined()
+            _log.warning("pipeline mode unavailable (%s); falling back to "
+                         "the causal wave loop",
+                         self._pipeline_unavailable_reason())
+        self._loop_causal()
+
+    def _loop_causal(self) -> None:
         # per-pod and per-wave failures are evented + requeued inside
         # schedule_wave; an exception escaping to here is an infrastructure
         # fault that must not spin silently
@@ -373,6 +765,51 @@ class BatchScheduler:
                 errs.inc()
                 _log.exception("wave loop error (backing off 10ms)")
                 time.sleep(0.01)
+
+    def _loop_pipelined(self) -> None:
+        import concurrent.futures as cf
+        errs = metrics.default_registry().counter(
+            "scheduler_wave_loop_errors_total",
+            "exceptions escaping the tpu-batch wave loop")
+        pm = _pipeline_metrics()
+        solve_pool = cf.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="tpu-batch-solve")
+        commit_pool = cf.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="tpu-batch-commit")
+        inflight: Optional[_Inflight] = None
+        try:
+            while not self._stop.is_set():
+                prev = inflight
+                try:
+                    inflight = self._pipelined_cycle(inflight, solve_pool,
+                                                     commit_pool, pm)
+                except Exception as e:
+                    # includes TimeoutError: the empty-queue drain timeout
+                    # is handled INSIDE the cycle (returns None), so any
+                    # TimeoutError here is a real fault (socket.timeout is
+                    # TimeoutError on py3.10+) and must reset state like
+                    # every other error — continuing with the consumed
+                    # in-flight wave would commit it twice
+                    errs.inc()
+                    _log.exception(
+                        "pipelined wave loop error (backing off 10ms)")
+                    # heal: drop the speculation cursor (the next encode
+                    # full-resyncs, clearing any unverified rows) and hand
+                    # the in-flight wave's pods to the error handler — an
+                    # already-bound pod re-fetches as scheduled and is not
+                    # requeued, so this can never double-schedule
+                    self._delta_token = None
+                    inflight = None
+                    if prev is not None:
+                        for pod in prev.pending:
+                            try:
+                                self.config.error(pod, e)
+                            except Exception:
+                                pass
+                    time.sleep(0.01)
+        finally:
+            solve_pool.shutdown(wait=False)
+            commit_pool.shutdown(wait=False)
 
     def _record(self, pod, reason, fmt, *args):
         if self.config.recorder is not None:

@@ -56,6 +56,16 @@ class Counter(_Metric):
     def value(self, *label_values: str) -> float:
         return self._values.get(tuple(str(v) for v in label_values), 0.0)
 
+    def total(self) -> float:
+        """Sum across every label set (0.0 when nothing incremented)."""
+        with self._lock:
+            return sum(self._values.values())
+
+    def by_label(self) -> Dict[Tuple[str, ...], float]:
+        """Snapshot copy of {label values: count}."""
+        with self._lock:
+            return dict(self._values)
+
     def render(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.typ}"]
         with self._lock:

@@ -1,0 +1,300 @@
+"""Pipelined (speculative double-buffered) BatchScheduler: divergence
+protocol + hit fast path.
+
+The contract under test (scheduler/tpu_batch.py module docstring): with
+``--pipeline`` the committed decisions are bit-identical to the causal
+wave loop over the same workload, because every speculative encode is
+verified against actual bind outcomes and the modeler changelog before
+anything from the next wave may commit. Divergence is injected
+deterministically through the driver's own seams (the binder for
+CAS-lost binds, the solver for mid-solve store deltas), identically in
+the causal reference run and the pipelined run, and the final
+(pod -> node) maps are compared verbatim.
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.quantity import Quantity
+from kubernetes_tpu.apiserver.master import Master
+from kubernetes_tpu.client.client import Client, InProcessTransport
+from kubernetes_tpu.scheduler.driver import ConfigFactory, PodBackoff
+from kubernetes_tpu.scheduler.tpu_batch import (
+    BatchScheduler,
+    _pipeline_metrics,
+)
+
+N_NODES = 12
+N_PODS = 384
+WAVE = 128
+
+
+def mk_node(i):
+    return api.Node(
+        metadata=api.ObjectMeta(name=f"n{i:03d}"),
+        spec=api.NodeSpec(capacity={"cpu": Quantity("64"),
+                                    "memory": Quantity("256Gi")}))
+
+
+def mk_pod(i, prefix="p"):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=f"{prefix}{i:05d}", namespace="default",
+                                uid=f"uid-{prefix}{i:05d}"),
+        spec=api.PodSpec(containers=[api.Container(
+            name="c", image="img",
+            resources=api.ResourceRequirements(limits={
+                "cpu": Quantity(f"{100 + (i % 8) * 100}m"),
+                "memory": Quantity(f"{128 + (i % 4) * 64}Mi")}))]))
+
+
+def _pipe_counts():
+    pm = _pipeline_metrics()
+    return {
+        "hits": pm.hits.value(),
+        "inval": pm.invalidations.by_label(),
+        "overlap": pm.overlap.value(),
+    }
+
+
+def _pipe_delta(before):
+    now = _pipe_counts()
+    inval = {}
+    for k, v in now["inval"].items():
+        d = v - before["inval"].get(k, 0.0)
+        if d:
+            inval[k[0] if k else ""] = d
+    return {
+        "hits": now["hits"] - before["hits"],
+        "inval": inval,
+        "overlap": now["overlap"] - before["overlap"],
+    }
+
+
+def run_stack(pipeline, n_pods=N_PODS, binder_wrap=None, solver_wrap=None,
+              backoff=None, timeout=60.0):
+    """One full drain of a pre-created backlog through the live in-process
+    stack. ``binder_wrap``/``solver_wrap`` wrap the respective seams AFTER
+    config creation (identically for causal and pipelined runs). Returns
+    the final {pod name: host} map."""
+    m = Master()
+    client = Client(InProcessTransport(m))
+    for i in range(N_NODES):
+        client.nodes().create(mk_node(i))
+    for i in range(n_pods):
+        client.pods().create(mk_pod(i))
+    factory = ConfigFactory(client, node_poll_period=1.0)
+    if backoff is not None:
+        factory.backoff = backoff
+    config = factory.create(pipeline=pipeline)
+    if binder_wrap is not None:
+        config.binder = binder_wrap(config.binder)
+    # deterministic waves: the backlog and node set fully synced before
+    # the first drain, so wave k is exactly pods [k*WAVE, (k+1)*WAVE)
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        if len(factory.pod_queue.list()) >= n_pods and \
+                len(factory.node_store.list()) >= N_NODES:
+            break
+        time.sleep(0.02)
+    else:
+        pytest.fail("reflectors never synced the backlog")
+    sched = BatchScheduler(config, factory, client, wave_size=WAVE,
+                           wave_linger_s=0.02)
+    if solver_wrap is not None:
+        sched.solver = solver_wrap(factory)
+    sched.run()
+    try:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            bound = sum(1 for p in client.pods().list().items
+                        if p.spec.host)
+            if bound >= n_pods:
+                break
+            time.sleep(0.05)
+        placements = {p.metadata.name: p.spec.host
+                      for p in client.pods().list().items}
+        assert all(placements.values()), \
+            f"{sum(1 for h in placements.values() if not h)} pods never bound"
+        return placements
+    finally:
+        sched.stop()
+        factory.stop()
+
+
+def test_speculation_hit_fast_path_bit_identical():
+    """Clean backlog: every speculation verifies (hits > 0, zero
+    invalidations) and the committed placements equal the causal run's."""
+    causal = run_stack(pipeline=False)
+    before = _pipe_counts()
+    piped = run_stack(pipeline=True)
+    d = _pipe_delta(before)
+    assert piped == causal
+    assert d["hits"] >= 1, d
+    assert not d["inval"], d
+    assert d["overlap"] > 0.0, d
+
+
+class _FailOnceBinder:
+    """Deterministic CAS-loss injection: the named pod's first bind is
+    rejected (as if another scheduler won the race); every other bind
+    passes through. Exposes only .bind so both loops take the per-pod
+    path — the injection point is identical either way."""
+
+    def __init__(self, inner, fail_name):
+        self._inner = inner
+        self._fail_name = fail_name
+        self.failed = 0
+
+    def bind(self, binding):
+        if binding.pod_name == self._fail_name and self.failed == 0:
+            self.failed += 1
+            raise RuntimeError("injected CAS conflict: binding rejected")
+        return self._inner.bind(binding)
+
+
+def test_cas_lost_bind_invalidates_and_requeues_bit_identical():
+    """A CAS-lost bind in wave 1 while wave 2's speculation is in flight:
+    the speculation must invalidate (reason bind_failed), re-encode, and
+    the whole run's committed decisions — including the loser's eventual
+    requeue placement — must equal the causal path under the identical
+    injection."""
+    victim = "p00005"  # wave-1 pod (backlog order is creation order)
+    # backoff longer than the full drain: the loser re-schedules alone
+    # against the identical final state in both modes, so its placement
+    # is deterministic too
+    mk_backoff = lambda: PodBackoff(initial=2.0, max_duration=4.0)
+    causal = run_stack(pipeline=False, backoff=mk_backoff(),
+                       binder_wrap=lambda b: _FailOnceBinder(b, victim))
+    before = _pipe_counts()
+    piped = run_stack(pipeline=True, backoff=mk_backoff(),
+                      binder_wrap=lambda b: _FailOnceBinder(b, victim))
+    d = _pipe_delta(before)
+    assert piped == causal
+    assert piped[victim]  # the requeued loser did schedule, in a later wave
+    assert d["inval"].get("bind_failed", 0) >= 1, d
+
+
+class _InjectingSolver:
+    """Deterministic mid-solve store delta: the FIRST wave's solve lands a
+    foreign assigned pod (another scheduler's bind, as the reflector
+    would deliver it) in the modeler's scheduled store before returning.
+    Wave 1's decisions predate the delta in both loops (the snapshot is
+    already encoded when solve runs); wave 2 must account for it — the
+    pipelined loop via a store_delta invalidation of its speculative
+    encode."""
+
+    def __init__(self, factory):
+        self._factory = factory
+        self.injected = 0
+
+    def solve(self, snap):
+        from kubernetes_tpu.models.batch_solver import solve
+        if self.injected == 0:
+            self.injected += 1
+            foreign = mk_pod(0, prefix="foreign-")
+            foreign.spec.containers[0].resources.limits["cpu"] = \
+                Quantity("32")
+            foreign.spec.host = "n000"
+            foreign.status.host = "n000"
+            self._factory.scheduled_pods.add(foreign)
+        return solve(snap)
+
+
+def test_mid_solve_store_delta_invalidates_bit_identical():
+    causal = run_stack(pipeline=False, solver_wrap=_InjectingSolver)
+    before = _pipe_counts()
+    piped = run_stack(pipeline=True, solver_wrap=_InjectingSolver)
+    d = _pipe_delta(before)
+    assert piped == causal
+    assert d["inval"].get("store_delta", 0) >= 1, d
+
+
+def test_gang_waves_skip_speculation_but_schedule_bit_identical():
+    """Waves carrying gang members never speculate (their quorum gate
+    needs an authoritative existing-pod list) — the pipelined loop must
+    fall back to causal encodes for them and still place every group
+    all-or-nothing, identically to the causal loop."""
+    from kubernetes_tpu.models import gang as gang_mod
+
+    def mk_gang_pods():
+        pods = []
+        for g in range(24):
+            for m in range(4):
+                i = g * 4 + m
+                p = mk_pod(i, prefix="g")
+                p.metadata.annotations = {
+                    gang_mod.GANG_NAME_ANNOTATION: f"group-{g:03d}",
+                    gang_mod.GANG_MIN_MEMBERS_ANNOTATION: "4"}
+                pods.append(p)
+        return pods
+
+    def run_gangs(pipeline):
+        m = Master()
+        client = Client(InProcessTransport(m))
+        for i in range(N_NODES):
+            client.nodes().create(mk_node(i))
+        for p in mk_gang_pods():
+            client.pods().create(p)
+        factory = ConfigFactory(client, node_poll_period=1.0)
+        config = factory.create(pipeline=pipeline)
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if len(factory.pod_queue.list()) >= 96 and \
+                    len(factory.node_store.list()) >= N_NODES:
+                break
+            time.sleep(0.02)
+        sched = BatchScheduler(config, factory, client, wave_size=32,
+                               wave_linger_s=0.02).run()
+        try:
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                if all(p.spec.host for p in client.pods().list().items):
+                    break
+                time.sleep(0.05)
+            return {p.metadata.name: p.spec.host
+                    for p in client.pods().list().items}
+        finally:
+            sched.stop()
+            factory.stop()
+
+    causal = run_gangs(False)
+    before = _pipe_counts()
+    piped = run_gangs(True)
+    d = _pipe_delta(before)
+    assert all(causal.values()) and piped == causal
+    assert d["hits"] == 0 and not d["inval"], d
+
+
+def test_encoder_speculation_helpers_roundtrip():
+    """forget_pods is the exact inverse of a speculative upsert, and
+    is_noop_upsert classifies re-deliveries."""
+    import numpy as np
+
+    from kubernetes_tpu.models.incremental import IncrementalEncoder
+
+    nodes = [mk_node(i) for i in range(4)]
+    pods = [mk_pod(i) for i in range(8)]
+    enc = IncrementalEncoder()
+    snap0 = enc.encode(nodes, [], pods[:4])
+    used0 = enc._score_used.copy()
+
+    assumed = []
+    for j, host in ((0, "n000"), (1, "n002")):
+        cl = mk_pod(100 + j)
+        cl.spec.host = host
+        cl.status.host = host
+        assumed.append(cl)
+    snap1 = enc.encode_delta(nodes, assumed, [], pods[4:8])
+    assert snap1 is not None
+    assert enc.has_pod(assumed[0].metadata.uid)
+    assert enc.is_noop_upsert(assumed[0])         # re-delivery: benign
+    moved = mk_pod(100)
+    moved.spec.host = "n001"
+    moved.status.host = "n001"
+    assert not enc.is_noop_upsert(moved)          # host changed: real delta
+
+    enc.forget_pods([p.metadata.uid for p in assumed])
+    assert not enc.has_pod(assumed[0].metadata.uid)
+    assert np.array_equal(enc._score_used, used0)
