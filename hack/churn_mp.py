@@ -13,15 +13,17 @@ round (CHURN_MP_r{N}.json).
 Usage:
   python hack/churn_mp.py [--pods 6000] [--rate 1000] [--nodes 500]
                           [--feeders 4] [--out FILE]
-  (internal) python hack/churn_mp.py --_feed PREFIX COUNT RATE MASTER
+  (internal) python hack/churn_mp.py --_feed PREFIX COUNT RATE MASTER [LOG]
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import mmap
 import os
 import re
+import struct
 import subprocess
 import sys
 import time
@@ -49,32 +51,77 @@ def cpu_env() -> dict:
     return env
 
 
+_POD_TEMPLATE = json.dumps({
+    "kind": "Pod", "apiVersion": "v1",
+    "metadata": {"name": "@@NAME@@", "namespace": "default"},
+    "spec": {"containers": [{
+        "name": "c", "image": "img",
+        "resources": {"limits": {"cpu": "100m",
+                                 "memory": "128Mi"}}}]}})
+_POD_PATH = "/api/v1/namespaces/default/pods"
+
+
+def _render_request(prefix: str, i: int) -> bytes:
+    head, tail = _POD_TEMPLATE.split("@@NAME@@")
+    body = f"{head}{prefix}-{i:06d}{tail}".encode()
+    return (b"POST " + _POD_PATH.encode() + b" HTTP/1.1\r\n"
+            b"Host: a\r\nContent-Type: application/json\r\n"
+            b"Content-Length: " + str(len(body)).encode() +
+            b"\r\n\r\n" + body)
+
+
+def render_replay(prefix: str, count: int, path: str) -> str:
+    """Pre-serialize a feeder's whole request stream to a replay log:
+    ``path`` holds COUNT raw pipelined HTTP requests back-to-back and
+    ``path + ".idx"`` the little-endian u32 offsets (count+1 entries).
+    The paced send loop then costs one mmap slice per pod — ~0 CPU —
+    instead of a JSON render + f-string + bytes build per pod, which at
+    full shape was enough construction work to starve the offered rate
+    below the contract (CHURN_MP_r05_fullshape: 727/s offered of the
+    1,000 target)."""
+    offs = [0]
+    with open(path, "wb") as fh:
+        for i in range(count):
+            req = _render_request(prefix, i)
+            fh.write(req)
+            offs.append(offs[-1] + len(req))
+    with open(path + ".idx", "wb") as fh:
+        fh.write(struct.pack(f"<{len(offs)}I", *offs))
+    return path
+
+
 def feed(prefix: str, count: int, rate: float, master: str,
-         depth: int = 32) -> int:
+         depth: int = 32, replay: str = "") -> int:
     """Paced feeder (one process). Prints one JSON line when done.
 
-    Offers pods over a raw keep-alive socket from a pre-rendered wire
-    template (only the name varies) — a load generator must be cheaper
-    than the server it measures (the kubemark principle); the stdlib
-    http.client's per-response email-parser alone cost ~0.1ms/req of the
-    shared one-core budget. Requests are PIPELINED up to ``depth`` in
-    flight: the send side paces at the target rate while a reader thread
-    drains status lines, so the offered rate tracks the contract instead
-    of the server's per-request latency."""
+    Offers pods over a raw keep-alive socket — a load generator must be
+    cheaper than the server it measures (the kubemark principle); the
+    stdlib http.client's per-response email-parser alone cost ~0.1ms/req
+    of the shared one-core budget. With ``replay`` the requests come
+    pre-serialized from a replay log (render_replay) and the send loop is
+    pure mmap-slice + sendall; without it they are rendered live (warmup
+    path). Requests are PIPELINED up to ``depth`` in flight: the send
+    side paces at the target rate while a reader thread drains status
+    lines, so the offered rate tracks the contract instead of the
+    server's per-request latency."""
     import socket
     import threading
     import urllib.parse
 
     u = urllib.parse.urlparse(master)
-    template = json.dumps({
-        "kind": "Pod", "apiVersion": "v1",
-        "metadata": {"name": "@@NAME@@", "namespace": "default"},
-        "spec": {"containers": [{
-            "name": "c", "image": "img",
-            "resources": {"limits": {"cpu": "100m",
-                                     "memory": "128Mi"}}}]}})
-    head, tail = template.split("@@NAME@@")
-    path = "/api/v1/namespaces/default/pods"
+    log_mm = idx = None
+    if replay:
+        with open(replay + ".idx", "rb") as fh:
+            raw = fh.read()
+        idx = struct.unpack(f"<{len(raw) // 4}I", raw)
+        if len(idx) != count + 1:
+            print(json.dumps({"error": f"replay log {replay} holds "
+                              f"{len(idx) - 1} requests, need {count}"}),
+                  flush=True)
+            return 1
+        log_fh = open(replay, "rb")
+        log_mm = mmap.mmap(log_fh.fileno(), 0, access=mmap.ACCESS_READ)
+        log_mv = memoryview(log_mm)
     sock = socket.create_connection((u.hostname, u.port))
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
@@ -120,11 +167,10 @@ def feed(prefix: str, count: int, rate: float, master: str,
     behind_max = 0.0
     sent = 0
     for i in range(count):
-        body = f"{head}{prefix}-{i:06d}{tail}".encode()
-        req = (b"POST " + path.encode() + b" HTTP/1.1\r\n"
-               b"Host: a\r\nContent-Type: application/json\r\n"
-               b"Content-Length: " + str(len(body)).encode() +
-               b"\r\n\r\n" + body)
+        if log_mm is not None:
+            req = log_mv[idx[i]:idx[i + 1]]
+        else:
+            req = _render_request(prefix, i)
         while sent - done[0] >= depth and not bad:
             time.sleep(0.0005)
         if bad:
@@ -156,7 +202,10 @@ def feed(prefix: str, count: int, rate: float, master: str,
         return 1
     print(json.dumps({"created": count, "seconds": round(dt, 3),
                       "rate": round(count / dt, 1),
-                      "behind_max_s": round(behind_max, 3)}), flush=True)
+                      "behind_max_s": round(behind_max, 3),
+                      # self-reported: /proc is gone by the time the
+                      # parent aggregates the per-stage CPU budget
+                      "cpu_s": round(time.process_time(), 3)}), flush=True)
     return 0
 
 
@@ -165,7 +214,7 @@ def _scrape_wave_raw(port: int) -> dict:
     raw = urllib.request.urlopen(
         f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
     out = {}
-    for which in ("encode", "solve"):
+    for which in ("encode", "solve", "commit"):
         base = f"scheduler_wave_{which}_seconds"
         buckets, total, count = [], 0.0, 0.0
         for line in raw.splitlines():
@@ -182,22 +231,77 @@ def _scrape_wave_raw(port: int) -> dict:
 
 
 def _scrape_solverd(port: int) -> dict:
-    """Coalescing evidence from the daemon's /metrics: device solves vs
-    waves served -> the measured coalesce factor."""
+    """Coalescing + delta-wire evidence from the daemon's /metrics:
+    device solves vs waves served -> the measured coalesce factor;
+    solverd_delta_* -> delta hit rate, resyncs, bytes shipped vs saved."""
     raw = urllib.request.urlopen(
         f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
     vals = {}
+    resyncs = 0.0
     for line in raw.splitlines():
+        if line.startswith("solverd_delta_resyncs_total{"):
+            resyncs += float(line.rsplit(None, 1)[1])
+            continue
         for key in ("solverd_device_solves_total",
-                    "solverd_coalesced_waves_total"):
-            if line.startswith(key + " ") or line.startswith(key + "{"):
+                    "solverd_coalesced_waves_total",
+                    "solverd_delta_hits_total",
+                    "solverd_delta_full_frames_total",
+                    "solverd_delta_bytes_shipped_total",
+                    "solverd_delta_bytes_saved_total"):
+            if line.startswith(key + " "):
                 vals[key] = float(line.rsplit(None, 1)[1])
     solves = vals.get("solverd_device_solves_total", 0.0)
     waves = vals.get("solverd_coalesced_waves_total", 0.0)
     out = {"device_solves": int(solves), "waves_served": int(waves)}
     if solves:
         out["coalesce_factor"] = round(waves / solves, 2)
+    hits = vals.get("solverd_delta_hits_total", 0.0)
+    fulls = vals.get("solverd_delta_full_frames_total", 0.0)
+    out["delta_hits"] = int(hits)
+    out["delta_full_frames"] = int(fulls)
+    out["delta_resyncs"] = int(resyncs)
+    out["delta_hit_rate"] = round(hits / (hits + fulls), 3) \
+        if hits + fulls else 0.0
+    out["delta_bytes_shipped"] = int(
+        vals.get("solverd_delta_bytes_shipped_total", 0.0))
+    out["delta_bytes_saved"] = int(
+        vals.get("solverd_delta_bytes_saved_total", 0.0))
     return out
+
+
+def _proc_cpu_s(pid: int) -> float:
+    """utime+stime of one process from /proc (Linux), in seconds."""
+    with open(f"/proc/{pid}/stat") as fh:
+        parts = fh.read().rsplit(") ", 1)[1].split()
+    return (int(parts[11]) + int(parts[12])) / os.sysconf("SC_CLK_TCK")
+
+
+# The committed-record contract (tests/test_bench_record.py): a CHURN_MP
+# record must carry these so future rounds can't silently drop the
+# delta-wire evidence or the per-stage CPU budget the acceptance gates
+# read. solverd keys are required only when the run had a daemon.
+RECORD_FIELDS = ("config", "topology", "offered_pods_per_s",
+                 "sustained_pods_per_s", "all_bound", "feed_s", "total_s",
+                 "scheduler_waves", "cpu_budget_s", "host_cores")
+SOLVERD_DELTA_FIELDS = ("delta_hits", "delta_full_frames", "delta_resyncs",
+                        "delta_hit_rate", "delta_bytes_shipped",
+                        "delta_bytes_saved")
+
+
+def validate_record(rec: dict) -> list:
+    """-> list of missing/malformed field paths (empty = conformant).
+    Error records (a run that aborted) are exempt beyond their marker."""
+    if "error" in rec:
+        return []
+    missing = [k for k in RECORD_FIELDS if k not in rec]
+    sd = rec.get("solverd")
+    if isinstance(sd, dict) and "error" not in sd:
+        missing += [f"solverd.{k}" for k in SOLVERD_DELTA_FIELDS
+                    if k not in sd]
+    cb = rec.get("cpu_budget_s")
+    if cb is not None and not isinstance(cb, dict):
+        missing.append("cpu_budget_s:not-a-dict")
+    return missing
 
 
 def _scrape_pipeline(port: int) -> dict:
@@ -224,7 +328,7 @@ def _wave_stats_delta(start: dict, end: dict) -> dict:
     the once-per-bucket XLA compiles paid during warmup don't pollute the
     timed phase's mean/median."""
     out = {}
-    for which in ("encode", "solve"):
+    for which in ("encode", "solve", "commit"):
         b0 = dict(start.get(which, ([], 0, 0))[0])
         b1, s1, c1 = end.get(which, ([], 0, 0))
         _, s0, c0 = start.get(which, ([], 0, 0))
@@ -260,7 +364,9 @@ def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
     if argv and argv[0] == "--_feed":
-        return feed(argv[1], int(argv[2]), float(argv[3]), argv[4])
+        return feed(argv[1], int(argv[2]), float(argv[3]), argv[4],
+                    replay=argv[5] if len(argv) > 5 else "",
+                    depth=int(argv[6]) if len(argv) > 6 else 32)
 
     ap = argparse.ArgumentParser()
     ap.add_argument("--pods", type=int, default=6000)
@@ -285,6 +391,16 @@ def main(argv=None) -> int:
                     "dispatch of wave k+1 overlap the HTTP commit "
                     "round-trips of wave k — and the solverd round-trip "
                     "when combined with --solverd")
+    ap.add_argument("--solverd-gather", type=float, default=0.003,
+                    help="kube-solverd gather window seconds; raise it "
+                    "when several scheduler workers share the daemon so "
+                    "their waves coalesce into one vmap call instead of "
+                    "serializing through the solve thread")
+    ap.add_argument("--depth", type=int, default=32,
+                    help="per-feeder pipelined requests in flight; the "
+                    "offered rate is bounded by depth x feeders / server "
+                    "latency, so a latency-bound run needs more depth, "
+                    "not more feeder CPU")
     ap.add_argument("--port", type=int, default=18410)
     ap.add_argument("--out", default=None)
     ap.add_argument("--platform", choices=["cpu", "ambient"], default="cpu",
@@ -296,7 +412,7 @@ def main(argv=None) -> int:
     master = f"http://127.0.0.1:{args.port}"
     child_env = cpu_env() if args.platform == "cpu" else ENV
 
-    procs = []
+    procs = []   # (name, Popen) — names feed the per-stage CPU budget
 
     logdir = "/tmp/churn_mp_logs"
     os.makedirs(logdir, exist_ok=True)
@@ -304,8 +420,22 @@ def main(argv=None) -> int:
     def spawn(name, *cmd):
         log = open(os.path.join(logdir, f"{name}.log"), "w")
         p = subprocess.Popen(cmd, env=child_env, stdout=log, stderr=log)
-        procs.append(p)
+        procs.append((name, p))
         return p
+
+    def cpu_budget() -> dict:
+        """utime+stime per stage for every still-running child — the
+        'which host stage is the wall' evidence the round target asks
+        for. Feeders self-report (they exit before this runs)."""
+        agg = {}
+        for name, p in procs:
+            base = re.sub(r"\d+$", "", name)
+            try:
+                agg[base] = round(agg.get(base, 0.0)
+                                  + _proc_cpu_s(p.pid), 2)
+            except (OSError, IndexError, ValueError):
+                pass
+        return agg
 
     try:
         if args.apiservers > 1:
@@ -350,6 +480,7 @@ def main(argv=None) -> int:
             solverd_metrics_port = args.port + 8
             spawn("solverd", PY, "-m", "kubernetes_tpu.cmd.solverd",
                   "--port", str(solverd_port),
+                  "--gather-window", str(args.solverd_gather),
                   "--metrics-port", str(solverd_metrics_port))
             # the daemon must own its socket before any worker's first
             # wave, or every worker starts in the fallback cooldown
@@ -445,20 +576,71 @@ def main(argv=None) -> int:
         per = args.pods // args.feeders
         counts = [per + (1 if f < args.pods % args.feeders else 0)
                   for f in range(args.feeders)]
+        # pre-serialize every feeder's request stream to a replay log so
+        # the paced offer loop is mmap-slice + sendall, ~0 CPU per pod
+        replay_paths = [os.path.join(logdir, f"replay-{f}.bin")
+                        for f in range(args.feeders)]
+        t_r = time.perf_counter()
+        rthreads = [threadinglib.Thread(
+            target=render_replay,
+            args=(f"churn{f}", counts[f], replay_paths[f]))
+            for f in range(args.feeders)]
+        for t in rthreads:
+            t.start()
+        for t in rthreads:
+            t.join()
+        render_s = time.perf_counter() - t_r
+        print(f"[churn-mp] replay logs rendered in {render_s:.2f}s",
+              file=sys.stderr, flush=True)
+
         t0 = time.perf_counter()
         feeders = [subprocess.Popen(
             [PY, os.path.abspath(__file__), "--_feed", f"churn{f}",
-             str(counts[f]), str(args.rate / args.feeders), master],
+             str(counts[f]), str(args.rate / args.feeders), master,
+             replay_paths[f], str(args.depth)],
             env=child_env, stdout=subprocess.PIPE, text=True)
             for f in range(args.feeders)]
-        stats = [json.loads(p.communicate(timeout=600)[0].strip().splitlines()[-1])
-                 for p in feeders]
+        # Poll, don't block: a feeder that dies early (refused connect,
+        # non-2xx storm) used to leave the run wedged inside
+        # communicate() until the watchdog; now the first non-zero exit
+        # aborts the run with a partial record.
+        stats = [None] * args.feeders
+        abort_err = None
+        deadline = time.monotonic() + 600
+        pending_f = set(range(args.feeders))
+        while pending_f and abort_err is None:
+            for f in list(pending_f):
+                rc = feeders[f].poll()
+                if rc is None:
+                    continue
+                pending_f.discard(f)
+                out_txt = (feeders[f].communicate()[0] or "").strip()
+                try:
+                    stats[f] = json.loads(out_txt.splitlines()[-1])
+                except (ValueError, IndexError):
+                    stats[f] = {"error": f"feeder {f} exited {rc} "
+                                "with no stats", "created": 0}
+                if rc != 0:
+                    abort_err = stats[f].get(
+                        "error", f"feeder {f} exited {rc}")
+            if pending_f and abort_err is None:
+                if time.monotonic() > deadline:
+                    abort_err = "feeder deadline (600s) exceeded"
+                    break
+                time.sleep(0.2)
         feed_s = time.perf_counter() - t0
-        errors = [s["error"] for s in stats if "error" in s]
-        if errors:
+        errors = [s["error"] for s in stats
+                  if isinstance(s, dict) and "error" in s]
+        if abort_err or errors:
+            for f, p in enumerate(feeders):
+                if p.poll() is None:
+                    p.terminate()
             record = {"config": f"churn multi-process: {args.pods} pods",
-                      "error": f"feeder failures: {errors}",
-                      "created": sum(s.get("created", 0) for s in stats)}
+                      "error": f"feeder failures: {errors or [abort_err]}",
+                      "partial": True,
+                      "created": sum(s.get("created", 0) for s in stats
+                                     if isinstance(s, dict)),
+                      "cpu_budget_s": cpu_budget()}
             print(json.dumps(record, indent=1))
             if args.out:
                 with open(args.out, "w") as f:
@@ -487,6 +669,8 @@ def main(argv=None) -> int:
             sched_desc += " (--pipeline speculative double-buffering)"
         if solver_addr:
             sched_desc += " -> shared kube-solverd (wave coalescing)"
+        budget = cpu_budget()
+        budget["feeders"] = round(sum(s.get("cpu_s", 0.0) for s in stats), 2)
         record = {
             "config": f"churn multi-process: {args.pods} pods at "
                       f"{args.rate:.0f}/s onto {args.nodes} nodes",
@@ -494,14 +678,20 @@ def main(argv=None) -> int:
                          "(SO_REUSEPORT) + kube-store + "
                          if args.apiservers > 1 else "apiserver + ")
                         + sched_desc + " + "
-                        f"{args.feeders} feeders, separate processes, HTTP",
+                        f"{args.feeders} replay-log feeders, separate "
+                        "processes, HTTP",
             "offered_pods_per_s": round(offered, 1),
             "sustained_pods_per_s": round(sustained, 1),
             "all_bound": ok,
             "feed_s": round(feed_s, 2),
             "total_s": round(total_s, 2),
+            "replay_render_s": round(render_s, 2),
             "feeder_behind_max_s": max(s["behind_max_s"] for s in stats),
             "scheduler_waves": wave_stats,
+            # which host stage owns the core budget (utime+stime per
+            # component over the whole run; feeders self-reported)
+            "cpu_budget_s": budget,
+            "host_cores": os.cpu_count(),
         }
         if solver_addr:
             try:
@@ -518,6 +708,10 @@ def main(argv=None) -> int:
                     for k in pipes[0]}
             except Exception as e:
                 record["pipeline"] = {"error": f"scrape failed: {e}"}
+        missing = validate_record(record)
+        if missing:
+            print(f"[churn-mp] WARNING: record missing contract fields: "
+                  f"{missing}", file=sys.stderr, flush=True)
         out = json.dumps(record, indent=1)
         print(out)
         if args.out:
@@ -525,7 +719,7 @@ def main(argv=None) -> int:
                 f.write(out + "\n")
         return 0 if ok else 1
     finally:
-        for p in procs:
+        for _name, p in procs:
             p.terminate()
 
 

@@ -38,6 +38,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="bounded request queue; beyond this, requests get "
                         "an immediate BUSY reply (backpressure) instead of "
                         "unbounded latency")
+    p.add_argument("--cache-entries", "--cache_entries", type=int,
+                   default=64,
+                   help="delta-wire resident plane cache entries (one per "
+                        "worker thread x shape bucket); evictions cost the "
+                        "evicted client one full-frame resync")
     p.add_argument("--metrics-port", "--metrics_port", type=int, default=0,
                    help="serve /metrics, /healthz and /debug/pprof on this "
                         "port (0 disables)")
@@ -62,7 +67,8 @@ def solverd_server(argv: List[str],
     srv = SolverService(host=opts.address, port=opts.port,
                         gather_window_s=opts.gather_window,
                         max_batch=opts.max_batch,
-                        max_queue=opts.max_queue)
+                        max_queue=opts.max_queue,
+                        cache_entries=opts.cache_entries)
     if opts.metrics_port:
         from kubernetes_tpu.cmd.scheduler import _serve_debug
         _serve_debug(opts.metrics_port)

@@ -92,6 +92,31 @@ NEG = -1  # masked score sentinel (scores are always >= 0)
 
 _I32_HEADROOM = (2**31 - 1) // 10  # calculate_score multiplies by 10
 
+# KTPU_DEBUG=1: recompute encoder-resident zone_counts0 planes from the
+# group_counts/node_zone planes and assert they match (the same class of
+# insurance as snapshot.py's _ktpu_rows verification)
+_DEBUG_VERIFY_ZONES = os.environ.get("KTPU_DEBUG", "") not in ("", "0")
+
+
+def derive_zone_counts(node_zone: np.ndarray, group_counts: np.ndarray,
+                       V: int) -> np.ndarray:
+    """[A, G, V] per-group per-zone peer totals: zone_counts[a, g, v] =
+    sum of group_counts[g, n] over nodes n whose zone code for dim ``a``
+    is ``v``. Unlabeled nodes (code -1) and the off-list slot N count
+    toward no zone — exactly the set the one-hot contraction used to
+    cover."""
+    A = node_zone.shape[0]
+    N = node_zone.shape[1]
+    G = group_counts.shape[0]
+    out = np.zeros((A, G, V), np.int32)
+    gc = np.asarray(group_counts[:, :N], np.int32)
+    for a in range(A):
+        zi = node_zone[a]
+        m = zi >= 0
+        if m.any():
+            np.add.at(out[a].T, zi[m].astype(np.int64), gc[:, m].T)
+    return out
+
 
 class SolverInputs(NamedTuple):
     """Device-ready arrays (see ClusterSnapshot for shapes/meaning).
@@ -126,8 +151,8 @@ class SolverInputs(NamedTuple):
     pod_aff_static: jnp.ndarray  # [P, L] i32
     anchor_vals0: jnp.ndarray    # [G, L] i32
     has_anchor0: jnp.ndarray     # [G] bool
-    zone_labeled: jnp.ndarray    # [A, N] bool
-    zone_onehot: jnp.ndarray     # [A, N, V] f32
+    zone_idx: jnp.ndarray        # [A, N] i32 zone codes, -1 unlabeled
+    zone_counts0: jnp.ndarray    # [A, G, V] i32 initial per-group peers/zone
 
 
 def _pack_bits(a: np.ndarray) -> np.ndarray:
@@ -208,10 +233,19 @@ def snapshot_to_host_inputs(snap: ClusterSnapshot) -> SolverInputs:
                  else np.zeros((0, N), np.int32))
     A = node_zone.shape[0]
     V = max(1, int(node_zone.max(initial=-1)) + 1)
-    zone_onehot = (node_zone[:, :, None] ==
-                   np.arange(V, dtype=np.int32)[None, None, :]
-                   ).astype(np.float32)                       # [A, N, V]
-    zone_labeled = node_zone >= 0                             # [A, N]
+    zone_counts0 = snap.zone_counts0
+    if zone_counts0 is None:
+        # per-group per-zone initial peer totals over labeled nodes —
+        # derived here for the full encoder; the incremental encoder keeps
+        # these resident and hands them down (O(changed) maintenance)
+        zone_counts0 = derive_zone_counts(node_zone, snap.group_counts, V)
+    elif _DEBUG_VERIFY_ZONES:
+        want = derive_zone_counts(node_zone, snap.group_counts, V)
+        assert zone_counts0.shape == want.shape and \
+            np.array_equal(zone_counts0, want), (
+                "resident zone_counts0 diverged from the group_counts/"
+                "node_zone planes — the incremental encoder's O(changed) "
+                "zone maintenance is out of sync")
 
     host = SolverInputs(
         cap=cap.astype(rdt),
@@ -241,8 +275,8 @@ def snapshot_to_host_inputs(snap: ClusterSnapshot) -> SolverInputs:
         pod_aff_static=pod_aff_static.astype(np.int32),
         anchor_vals0=anchor_vals0.astype(np.int32),
         has_anchor0=np.asarray(has_anchor0, bool),
-        zone_labeled=np.asarray(zone_labeled, bool),
-        zone_onehot=zone_onehot.astype(np.float32),
+        zone_idx=node_zone.astype(np.int32),
+        zone_counts0=np.ascontiguousarray(zone_counts0, np.int32),
     )
     return host
 
@@ -399,10 +433,19 @@ def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
         counts: jnp.ndarray          # [G, N+1] i32
         anchor_vals: jnp.ndarray     # [G, L] i32
         has_anchor: jnp.ndarray      # [G] bool
+        zone_counts: jnp.ndarray     # [A, G, V] i32 peers per zone
 
+    V = inp.zone_counts0.shape[2]
+    if pol.anti_affinity:
+        # scan-invariant zone scatter basis, derived on device once per
+        # wave (XLA hoists it out of the scan): the wire/encoder ship only
+        # the compact [A, N] index plane
+        zone_onehot = (inp.zone_idx[:, :, None] ==
+                       jnp.arange(V, dtype=jnp.int32)[None, None, :]
+                       ).astype(jnp.float32)                 # [A, N, V]
     init = Carry(inp.fit_used, inp.score_used,
                  inp.node_ports, inp.node_pds, inp.group_counts,
-                 inp.anchor_vals0, inp.has_anchor0)
+                 inp.anchor_vals0, inp.has_anchor0, inp.zone_counts0)
 
     def step(carry: Carry, xs, blocked=None):
         (static_row, req, pod_ports, pod_pds,
@@ -469,20 +512,32 @@ def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
             # Score: ServiceAntiAffinity (spreading.go:104-168). The serial
             # path scores over the FILTERED node list, so per-zone counts
             # include only nodes feasible for this pod; peers off-list
-            # (slot N) and on infeasible nodes don't count.
+            # (slot N) and on infeasible nodes don't count. The per-zone
+            # totals over ALL labeled nodes ride the carry (seeded from
+            # the encoder's resident zone_counts0 plane, updated one-hot
+            # per commit); the per-step work is only the exact integer
+            # subtraction of peers sitting on infeasible labeled nodes —
+            # O(N) segment arithmetic instead of the former two [N, V]
+            # one-hot matmuls per step.
             counts_eff = jnp.where(gid >= 0, counts_row, jnp.int32(0))
             num = jnp.sum(counts_eff)
-            c = (counts_eff[:N] * feasible).astype(jnp.float32)
-            # Integer zone counts ride f32 matmuls; per-zone sums routinely
-            # exceed 256, so the TPU default (inputs rounded to bf16) would
-            # corrupt counts and flip decisions — HIGHEST is exact for
-            # integer values < 2^24.
-            hp = jax.lax.Precision.HIGHEST
-            zc = jnp.matmul(inp.zone_onehot[a].T, c, precision=hp)   # [V]
-            cnt = jnp.matmul(inp.zone_onehot[a], zc,
-                             precision=hp).astype(jnp.int32)         # [N]
+            zi = inp.zone_idx[a]                                    # [N]
+            labeled = zi >= 0
+            safe_zi = jnp.where(labeled, zi, 0)
+            zrow = jnp.where(gid >= 0,
+                             carry.zone_counts[a, jnp.maximum(gid, 0)],
+                             jnp.int32(0))                          # [V]
+            # peers on infeasible labeled nodes, folded per zone: one
+            # [N, V] contraction (HIGHEST: exact for integers < 2^24);
+            # unlabeled nodes have an all-zero one-hot row
+            c_inf = (counts_eff[:N] * ~feasible).astype(jnp.float32)
+            zc = zrow - jnp.matmul(
+                zone_onehot[a].T, c_inf,
+                precision=jax.lax.Precision.HIGHEST).astype(jnp.int32)
+            cnt = jnp.where(labeled, jnp.take(zc, safe_zi),
+                            jnp.int32(0))                           # [N]
             s = _spread_score(num, cnt)
-            s = jnp.where(inp.zone_labeled[a], s, jnp.int32(0))
+            s = jnp.where(labeled, s, jnp.int32(0))
             score = score + s * w
         if pol.label_prefs:
             score = score + inp.score_static
@@ -509,6 +564,18 @@ def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
         else:
             anchor_vals = carry.anchor_vals
             has_anchor = carry.has_anchor
+        if pol.anti_affinity:
+            # mirror of the counts update in zone space: every group the
+            # pod belongs to gains one peer in the chosen node's zone
+            # (nothing when unplaced or the chosen node is unlabeled)
+            zv = inp.zone_idx[:, jnp.maximum(chosen, 0)]         # [A]
+            zhit = ((chosen >= 0) & (zv >= 0))[:, None, None]    # [A, 1, 1]
+            zone_counts = carry.zone_counts + (
+                member[None, :, None] & zhit &
+                (jnp.arange(V, dtype=jnp.int32)[None, None, :]
+                 == zv[:, None, None])).astype(jnp.int32)
+        else:
+            zone_counts = carry.zone_counts
         carry = Carry(
             fit_used=carry.fit_used + onehot[:, None] * req[None, :],
             score_used=carry.score_used + onehot[:, None] * req[None, :],
@@ -520,6 +587,7 @@ def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
                                    * jnp.pad(onehot, (0, 1)).astype(jnp.int32)[None, :]),
             anchor_vals=anchor_vals,
             has_anchor=has_anchor,
+            zone_counts=zone_counts,
         )
         win_score = jnp.where(any_feasible, top, jnp.int32(NEG))
         return carry, (chosen, win_score)

@@ -117,6 +117,12 @@ class IncrementalEncoder:
         self._resource_names: List[str] = []
         # resident planes (allocated by _rebuild_nodes)
         self._N = 0
+        # O(changed) accounting, consumed by the tier-1 complexity guards
+        # (tests/test_incremental.py): zone_writes counts single-element
+        # zone-plane updates, group_writes the group-count ones;
+        # node_rebuilds the full resident-plane rebuilds
+        self.op_counts: Dict[str, int] = {
+            "zone_writes": 0, "group_writes": 0, "node_rebuilds": 0}
 
     # -- node side ----------------------------------------------------------
     @staticmethod
@@ -201,10 +207,16 @@ class IncrementalEncoder:
                     if v not in vocab:
                         vocab[v] = len(vocab)
                     self._node_zone[a, i] = vocab[v]
+        # zone codes are node-label-derived, so V is fixed until the next
+        # node-plane rebuild; same V rule as snapshot_to_host_inputs
+        self._zone_V = max(1, int(self._node_zone.max(initial=-1)) + 1)
 
-        # group counts get a fresh [G, N+1] layout; re-apply cached pods
+        # group counts get a fresh [G, N+1] layout (and the zone-count
+        # planes a matching [A, G, V] one); re-apply cached pods
         self._grp_rows: Dict[Tuple[int, int], int] = {}
         self._grp_cnt = np.zeros((8, N + 1), np.int32)
+        self._zone_cnt = np.zeros((A, 8, self._zone_V), np.int32)
+        self.op_counts["node_rebuilds"] += 1
         self._pods.clear()
         self._set_services(services)
         for p in existing:
@@ -267,12 +279,32 @@ class IncrementalEncoder:
             grown = np.zeros((_pow2_pad(row + 1), self._N + 1), np.int32)
             grown[:self._grp_cnt.shape[0]] = self._grp_cnt
             self._grp_cnt = grown
+            zgrown = np.zeros((self._zone_cnt.shape[0], grown.shape[0],
+                               self._zone_V), np.int32)
+            zgrown[:, :self._zone_cnt.shape[1]] = self._zone_cnt
+            self._zone_cnt = zgrown
         ns_code, si = key
         for rec in self._pods.values():
             if rec.ns_code == ns_code and si < rec.svc_mask.size and \
                     rec.svc_mask[si]:
                 self._grp_cnt[row, rec.host_idx] += 1
+                self.op_counts["group_writes"] += 1
+                self._zone_delta(row, rec.host_idx, 1)
         return row
+
+    def _zone_delta(self, row: int, host_idx: int, d: int) -> None:
+        """Mirror one group-count update into the resident zone planes:
+        the pod on ``host_idx`` adds/removes one peer in that node's zone
+        for every anti-affinity dim. Off-list (host_idx == N) and
+        unlabeled nodes belong to no zone — exactly the nodes the former
+        per-wave one-hot contraction zeroed out."""
+        if host_idx >= self._N:
+            return
+        for a in range(self._node_zone.shape[0]):
+            zv = int(self._node_zone[a, host_idx])
+            if zv >= 0:
+                self._zone_cnt[a, row, zv] += d
+                self.op_counts["zone_writes"] += 1
 
     # -- pod deltas ---------------------------------------------------------
     def _grow_cols(self, arr: np.ndarray, cap: int, fill=0) -> np.ndarray:
@@ -349,6 +381,8 @@ class IncrementalEncoder:
             for (g_ns, si), row in self._grp_rows.items():
                 if g_ns == ns_code and svc_mask[si]:
                     self._grp_cnt[row, i] += 1
+                    self.op_counts["group_writes"] += 1
+                    self._zone_delta(row, i, 1)
 
     def _remove_pod(self, uid: str) -> None:
         rec = self._pods.pop(uid)
@@ -364,6 +398,8 @@ class IncrementalEncoder:
             for (g_ns, si), row in self._grp_rows.items():
                 if g_ns == rec.ns_code and rec.svc_mask[si]:
                     self._grp_cnt[row, i] -= 1
+                    self.op_counts["group_writes"] += 1
+                    self._zone_delta(row, i, -1)
 
     # -- speculation support (scheduler/tpu_batch.py pipelined mode) --------
     def has_pod(self, uid: str) -> bool:
@@ -616,6 +652,7 @@ class IncrementalEncoder:
             pod_rid=pod_rid, pod_run_start=pod_run_start,
             score_static=self._score_static,
             node_zone=self._node_zone,
+            zone_counts0=self._zone_cnt.copy(),
             policy=self.policy,
             w_least_requested=self.policy.w_lr,
             w_spreading=self.policy.w_spread,

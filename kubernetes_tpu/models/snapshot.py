@@ -174,6 +174,12 @@ class ClusterSnapshot:
     anchor_vals0: np.ndarray = None    # [G, L] i32 — initial anchor values
     has_anchor0: np.ndarray = None     # [G] bool
     node_zone: np.ndarray = None       # [A, N] i32 zone codes, -1 unlabeled
+    # per-group per-zone initial peer totals [A, G, V]; None = derive from
+    # node_zone x group_counts (full encoder). The incremental encoder
+    # maintains this plane resident — O(changed) per bind/delete — and the
+    # solver seeds its scan carry from it (batch_solver.derive_zone_counts
+    # is the authoritative definition).
+    zone_counts0: np.ndarray = None
     policy: BatchPolicy = field(default_factory=lambda: DEFAULT_BATCH_POLICY)
     # priority weights (kept for back-compat; mirror policy)
     w_least_requested: int = 1

@@ -116,8 +116,8 @@ def eligible(inp, pol: Optional[BatchPolicy], gangs: bool,
         return False
     A = V = 0
     if pol.anti_affinity:
-        A = inp.zone_onehot.shape[0]
-        V = inp.zone_onehot.shape[2]
+        A = inp.zone_idx.shape[0]
+        V = inp.zone_counts0.shape[2]
         if not (0 < A <= _MAX_A and V <= _MAX_V
                 and A == len(pol.anti_affinity)):
             return False
@@ -581,7 +581,7 @@ def solve_pallas(inp, pol: Optional[BatchPolicy] = None,
             inp.node_extra_ok, inp.req, inp.pod_ports, inp.pod_sel,
             inp.pod_pds, inp.pod_host_idx, limbs, inp.pod_gid,
             inp.pod_group_member, inp.group_counts, inp.gang_start,
-            inp.zone_onehot, inp.zone_labeled,
+            inp.zone_idx, inp.zone_counts0,
             inp.score_static, inp.node_aff_vals, inp.pod_aff_static,
             inp.anchor_vals0, inp.has_anchor0,
             pol=pol, interpret=interpret, gangs=gangs,
@@ -594,7 +594,7 @@ def _solve_pallas_x32(cap_in, advertises, fit_used, fit_exceeded,
                       score_used, node_ports, node_sel, node_pds,
                       node_extra_ok, req_in, pod_ports, pod_sel, pod_pds,
                       pod_host_idx, tie_limbs, pod_gid, pod_group_member,
-                      group_counts, gang_start, zone_onehot, zone_labeled,
+                      group_counts, gang_start, zone_idx, zone_counts0,
                       score_static, node_aff_vals, pod_aff_static,
                       anchor_vals0, has_anchor0,
                       *, pol: BatchPolicy, interpret: bool, gangs: bool,
@@ -697,14 +697,19 @@ def _solve_pallas_x32(cap_in, advertises, fit_used, fit_exceeded,
             pod_aff_static.astype(jnp.int32))
 
     # ---- zone planes for anti-affinity ([A*V, NR, 128] i32 one-hots) -----
+    # The kernel consumes per-zone reduction planes; they are derived ON
+    # DEVICE from the compact [A, N] zone-index plane once per wave (the
+    # wire/encoder no longer materializes an [A, N, V] one-hot).
     A = len(pol.anti_affinity)
-    V = zone_onehot.shape[2] if A else 0
+    V = zone_counts0.shape[2] if A else 0
     zone_args, zone_specs = [], []
     if A:
-        zones = zone_onehot.astype(jnp.int32)          # [A, N, V]
-        zones = jnp.transpose(zones, (0, 2, 1)).reshape(A * V, N)
+        zidx = zone_idx.astype(jnp.int32)              # [A, N]
+        zones = (zidx[:, None, :] ==
+                 jnp.arange(V, dtype=jnp.int32)[None, :, None]
+                 ).astype(jnp.int32).reshape(A * V, N)
         zones = _pad_nodes(zones, Npad, 0).reshape(A * V, NR, LANES)
-        zlab = _pad_nodes(zone_labeled.astype(jnp.int32), Npad, 0)
+        zlab = _pad_nodes((zidx >= 0).astype(jnp.int32), Npad, 0)
         zlab = zlab.reshape(A, NR, LANES)
         zone_args = [zones, zlab]
         zone_specs = [pl.BlockSpec((A * V, NR, LANES),

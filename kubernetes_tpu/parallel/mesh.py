@@ -77,8 +77,8 @@ def pad_inputs_for_mesh(inp: SolverInputs, mesh: Mesh) -> Tuple[SolverInputs, in
         node_aff_vals=pad_n(inp.node_aff_vals, fill=-1),
         pod_aff_static=inp.pod_aff_static,
         anchor_vals0=inp.anchor_vals0, has_anchor0=inp.has_anchor0,
-        zone_labeled=pad_n(inp.zone_labeled, axis=1, fill=False),
-        zone_onehot=pad_n(inp.zone_onehot, axis=1),
+        zone_idx=pad_n(inp.zone_idx, axis=1, fill=-1),  # pad = unlabeled
+        zone_counts0=inp.zone_counts0,
     ), n
 
 
@@ -108,8 +108,8 @@ def _input_shardings(mesh: Mesh) -> SolverInputs:
         node_aff_vals=node2d,
         pod_aff_static=rep,
         anchor_vals0=rep, has_anchor0=rep,
-        zone_labeled=s(None, "nodes"),
-        zone_onehot=s(None, "nodes", None),
+        zone_idx=s(None, "nodes"),
+        zone_counts0=rep,
     )
 
 

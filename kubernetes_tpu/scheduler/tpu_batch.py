@@ -97,6 +97,10 @@ class _WaveMetrics:
         self.solve = reg.histogram(
             "scheduler_wave_solve_seconds",
             "Solver time per wave", buckets=buckets)
+        self.commit = reg.histogram(
+            "scheduler_wave_commit_seconds",
+            "Bind + assume time per wave (the store round-trips)",
+            buckets=buckets)
         self.pods = reg.counter(
             "scheduler_wave_pods_total", "Pods drained into waves")
         self.resyncs = reg.counter(
@@ -399,6 +403,7 @@ class BatchScheduler:
         encode so the encoder and the modeler account the IDENTICAL
         objects. Returns (outcomes, bound): outcomes[i] is None on
         success, else the bind error (aligned with ``placed``)."""
+        t_commit0 = time.perf_counter()
         c = self.config
 
         def mk_binding(pod, host) -> api.Binding:
@@ -459,6 +464,7 @@ class BatchScheduler:
                          pod.metadata.name, host)
             c.modeler.assume_pod(cl)
             bound += 1
+        _wave_metrics().commit.observe(time.perf_counter() - t_commit0)
         return outcomes, bound
 
     def schedule_wave(self, timeout: Optional[float] = None) -> int:

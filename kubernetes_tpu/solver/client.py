@@ -32,7 +32,8 @@ from __future__ import annotations
 import socket
 import threading
 import time
-from typing import Optional, Tuple
+import uuid
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
@@ -40,6 +41,21 @@ from kubernetes_tpu.models.policy import BatchPolicy
 from kubernetes_tpu.solver import protocol
 
 __all__ = ["RemoteSolver", "SolverBusy", "SolverUnavailable"]
+
+
+class _Mirror:
+    """Client-side copy of the resident planes the daemon holds for one
+    (worker-thread, shape-bucket) cache entry. The arrays are OWNED
+    copies: encoder-resident planes can mutate in place between waves, so
+    diffing against a reference we also hold by reference would see
+    nothing change. ``epoch`` counts applied frames and must stay in
+    lockstep with the daemon's entry — any skew surfaces as a resync."""
+
+    __slots__ = ("epoch", "planes")
+
+    def __init__(self, epoch: int, planes: Dict[str, np.ndarray]):
+        self.epoch = epoch
+        self.planes = planes
 
 
 class SolverUnavailable(Exception):
@@ -57,13 +73,17 @@ class RemoteSolver:
     # would re-send the wave and solve it twice
     def __init__(self, address: str, timeout_s: float = 180.0,
                  connect_timeout_s: float = 2.0, fallback: bool = True,
-                 cooldown_s: float = 5.0):
+                 cooldown_s: float = 5.0, delta: bool = True):
         host, _, port = address.rpartition(":")
         self._addr = (host or "127.0.0.1", int(port))
         self._timeout_s = timeout_s
         self._connect_timeout_s = connect_timeout_s
         self.fallback = fallback
         self.cooldown_s = cooldown_s
+        # delta wire (protocol v2): ship O(changed-rows) plane deltas
+        # against a daemon-side resident cache; False pins full frames
+        self.delta = delta
+        self._wid = uuid.uuid4().hex[:12]
         self._local = threading.local()
         self._lock = threading.Lock()
         self._unhealthy_until = 0.0
@@ -71,6 +91,11 @@ class RemoteSolver:
         self.remote_waves = 0
         self.fallback_waves = 0
         self.busy_waves = 0
+        self.delta_waves = 0
+        self.full_waves = 0
+        self.resync_waves = 0
+        self.delta_bytes_shipped = 0
+        self.delta_bytes_full = 0
 
     # -- plumbing ----------------------------------------------------------
     def _connect(self) -> socket.socket:
@@ -141,18 +166,9 @@ class RemoteSolver:
         return header
 
     # -- the solve seam ----------------------------------------------------
-    def solve_remote(self, host_inputs, pol: BatchPolicy, gangs: bool
-                     ) -> Tuple[np.ndarray, np.ndarray]:
-        """Ship one wave's host-side SolverInputs; returns (chosen, scores)
-        for the shipped pod axis. Raises SolverBusy / SolverUnavailable /
-        SolverProtocolError — no fallback at this layer."""
-        header = {
-            "op": "solve", "v": protocol.PROTOCOL_VERSION,
-            "fp": protocol.solver_fingerprint(pol, gangs),
-            "policy": protocol.policy_to_wire(pol),
-            "gangs": bool(gangs),
-        }
-        resp_header, arrays = self._call(header, tuple(host_inputs))
+    @staticmethod
+    def _parse_solve_reply(resp_header, arrays
+                           ) -> Tuple[np.ndarray, np.ndarray]:
         if resp_header.get("busy"):
             raise SolverBusy("kube-solverd queue full")
         if "err" in resp_header:
@@ -162,6 +178,114 @@ class RemoteSolver:
             raise protocol.SolverProtocolError(
                 f"solve reply carried {len(arrays)} arrays, expected 2")
         return arrays[0], arrays[1]
+
+    def _mirrors(self) -> Dict[str, _Mirror]:
+        m = getattr(self._local, "mirrors", None)
+        if m is None:
+            m = self._local.mirrors = {}
+        return m
+
+    _MAX_MIRRORS = 16  # pow-2 bucketing keeps live shapes well below this
+
+    def _delta_plan(self, host_inputs, mir: _Mirror):
+        """Diff the wave's planes against the mirror of what the daemon
+        holds -> (wire plane list, arrays to ship, mirror commit list).
+        The row compare is a vectorized memcmp over the resident planes
+        (~MBs/ms); the bytes SHIPPED are O(changed rows). A plane whose
+        delta would not beat re-sending it ships full."""
+        plan: list = []
+        arrays: list = []
+        commits: list = []
+        for name, cur in zip(host_inputs._fields, host_inputs):
+            cur = np.ascontiguousarray(cur)
+            if name not in protocol.DELTA_FIELDS:
+                plan.append("F")
+                arrays.append(cur)
+                continue
+            prev = mir.planes[name]
+            diff = prev != cur  # same shape/dtype: the bucket key pins them
+            changed = diff.any(axis=tuple(range(1, diff.ndim))) \
+                if diff.ndim > 1 else diff
+            rows = np.nonzero(changed)[0].astype(np.int32)
+            if rows.size == 0:
+                plan.append("S")
+                continue
+            row_nbytes = cur.nbytes // max(1, cur.shape[0])
+            if rows.size * (row_nbytes + 4) >= cur.nbytes:
+                plan.append("F")
+                arrays.append(cur)
+                commits.append((name, None, cur))
+            else:
+                vals = np.ascontiguousarray(cur[rows])
+                plan.append(["D", int(rows.size)])
+                arrays.extend((rows, vals))
+                commits.append((name, rows, vals))
+        return plan, tuple(arrays), commits
+
+    def solve_remote(self, host_inputs, pol: BatchPolicy, gangs: bool
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+        """Ship one wave's host-side SolverInputs; returns (chosen, scores)
+        for the shipped pod axis. Raises SolverBusy / SolverUnavailable /
+        SolverProtocolError — no fallback at this layer.
+
+        With ``delta`` on (default), consecutive waves of one thread ship
+        O(changed-rows) plane deltas against the daemon's resident cache;
+        a ``resync`` answer (daemon restarted, entry evicted, epoch skew)
+        degrades that one wave to a full frame and re-establishes the
+        pair. The mirror only advances after a successful solve reply, so
+        BUSY bounces and daemon-side failures can never desync it
+        silently — at worst the next delta resyncs."""
+        base = {
+            "op": "solve", "v": protocol.PROTOCOL_VERSION,
+            "fp": protocol.solver_fingerprint(pol, gangs),
+            "policy": protocol.policy_to_wire(pol),
+            "gangs": bool(gangs),
+        }
+        if not self.delta:
+            resp_header, arrays = self._call(base, tuple(host_inputs))
+            return self._parse_solve_reply(resp_header, arrays)
+        bucket = protocol.shape_bucket(host_inputs)
+        wid = f"{self._wid}.{threading.get_ident()}"
+        mirrors = self._mirrors()
+        mir = mirrors.get(bucket)
+        if mir is not None:
+            plan, arrays, commits = self._delta_plan(host_inputs, mir)
+            header = dict(base, cache={"wid": wid, "bucket": bucket,
+                                       "epoch": mir.epoch}, planes=plan)
+            resp_header, rarrs = self._call(header, arrays)
+            if not resp_header.get("resync"):
+                out = self._parse_solve_reply(resp_header, rarrs)
+                mir.epoch += 1
+                for name, rows, vals in commits:
+                    if rows is None:
+                        mir.planes[name] = np.array(vals, copy=True)
+                    else:
+                        mir.planes[name][rows] = vals
+                self.delta_waves += 1
+                self.delta_bytes_shipped += sum(a.nbytes for a in arrays)
+                self.delta_bytes_full += sum(
+                    a.nbytes for a in host_inputs)
+                return out
+            self.resync_waves += 1
+            mirrors.pop(bucket, None)
+        # full frame: establish (or resync) the daemon's cache entry
+        header = dict(base,
+                      cache={"wid": wid, "bucket": bucket, "epoch": 0},
+                      planes=["F"] * len(host_inputs))
+        resp_header, rarrs = self._call(header, tuple(host_inputs))
+        if resp_header.get("resync"):
+            raise protocol.SolverProtocolError(
+                f"daemon demanded resync of a full frame: "
+                f"{resp_header['resync']!r}")
+        out = self._parse_solve_reply(resp_header, rarrs)
+        self.full_waves += 1
+        if len(mirrors) >= self._MAX_MIRRORS:
+            mirrors.pop(next(iter(mirrors)))
+        mirrors[bucket] = _Mirror(1, {
+            name: np.array(arr, copy=True)
+            for name, arr in zip(host_inputs._fields, host_inputs)
+            if name in protocol.DELTA_FIELDS})
+        return out
 
     def solve(self, snap) -> Tuple[np.ndarray, np.ndarray]:
         """The batch_solver.solve twin over the wire: encode-side inputs

@@ -29,10 +29,37 @@ Ops:
   version and solve statistics, so a client can refuse a version-skewed
   daemon before shipping any tensors.
 
-The fingerprint exists so the daemon can group compatible requests for
-coalescing (same compiled program family) and reject requests from a
-scheduler built against a different protocol revision without decoding
-the tensor payload.
+**Delta frames (v2).** A full-shape wave ships several MB of planes, but
+between consecutive waves of one scheduler worker only O(changed) node
+rows differ — the incremental encoder keeps the node-side planes
+resident, so the wire should too. A v2 ``solve`` may carry:
+
+- ``cache``: ``{"wid": worker-id, "bucket": shape-bucket, "epoch": n}`` —
+  the daemon keys a resident plane cache by (wid, bucket); ``bucket``
+  digests every field's (dtype, shape), so any vocabulary growth or
+  dtype flip lands in a fresh bucket and forces a full frame;
+- ``planes``: one entry per SolverInputs field, in field order:
+  ``"F"`` (full array follows), ``"S"`` (unchanged — daemon reuses its
+  cached plane, nothing on the wire), or ``["D", k]`` (row delta: a
+  ``[k] i32`` row-index array followed by a ``[k, ...]`` values array).
+  Only ``DELTA_FIELDS`` (the node/group/zone resident planes) may be
+  ``"S"``/``"D"``; pod-axis planes are always ``"F"``.
+
+Epoch rule: a full frame (all-``F`` + ``cache``) installs the cache entry
+at epoch ``epoch+1``; each applied delta requires the entry to be at the
+request's ``epoch`` exactly and advances it by one. Any mismatch — no
+entry (daemon restarted, LRU-evicted), epoch skew (a lost reply
+desynced the pair), row out of range — is answered with
+``{"resync": reason}`` WITHOUT solving; the client re-sends the wave as
+a full frame. Solves are bit-identical by construction: the daemon
+reconstructs byte-identical arrays or refuses.
+
+A v1 client (no ``cache``/``planes``) against a v2 daemon keeps working:
+the daemon treats its frames as full-plane requests and fingerprints
+them with the request's own version. The fingerprint exists so the
+daemon can group compatible requests for coalescing (same compiled
+program family) and reject requests from a scheduler built against an
+incompatible protocol revision without decoding the tensor payload.
 """
 
 from __future__ import annotations
@@ -48,11 +75,24 @@ import numpy as np
 
 from kubernetes_tpu.models.policy import BatchPolicy
 
-__all__ = ["PROTOCOL_VERSION", "MAX_FRAME", "SolverProtocolError",
+__all__ = ["PROTOCOL_VERSION", "MIN_PROTOCOL_VERSION", "MAX_FRAME",
+           "DELTA_FIELDS", "SolverProtocolError",
            "send_msg", "recv_msg", "policy_to_wire", "policy_from_wire",
-           "solver_fingerprint"]
+           "solver_fingerprint", "shape_bucket"]
 
-PROTOCOL_VERSION = 1
+PROTOCOL_VERSION = 2      # v2: delta frames + resident plane cache
+MIN_PROTOCOL_VERSION = 1  # v1 full-plane clients still served
+
+# SolverInputs fields the daemon may cache between waves and the client
+# may ship as row deltas: everything keyed on the node/group/zone axes
+# (resident in models/incremental.IncrementalEncoder). Pod-axis planes
+# are new every wave and always ship full.
+DELTA_FIELDS = frozenset((
+    "cap", "advertises", "fit_used", "fit_exceeded", "score_used",
+    "node_ports", "node_sel", "node_pds", "node_extra_ok",
+    "group_counts", "score_static", "node_aff_vals",
+    "zone_idx", "zone_counts0",
+))
 
 # A full-shape wave (10k pods x 10k nodes) encodes to a few hundred MB in
 # the worst padded case; 1 GiB bounds a corrupt length word, not real use.
@@ -93,13 +133,25 @@ def policy_from_wire(d: dict) -> BatchPolicy:
     )
 
 
-def solver_fingerprint(pol: BatchPolicy, gangs: bool) -> str:
+def solver_fingerprint(pol: BatchPolicy, gangs: bool,
+                       version: int = PROTOCOL_VERSION) -> str:
     """Canonical digest of (protocol version, policy, gangs) — the compiled
     program family a request belongs to. Requests sharing a fingerprint may
-    be coalesced into one batched solve."""
-    blob = json.dumps({"v": PROTOCOL_VERSION, "policy": policy_to_wire(pol),
+    be coalesced into one batched solve. ``version`` is the REQUEST's
+    protocol version: a v2 daemon verifying a v1 frame must derive the
+    digest the v1 client computed."""
+    blob = json.dumps({"v": int(version), "policy": policy_to_wire(pol),
                        "gangs": bool(gangs)}, sort_keys=True,
                       separators=(",", ":"))
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:16]
+
+
+def shape_bucket(arrays) -> str:
+    """Digest of every array's (dtype, shape) in order — the delta cache
+    key's shape component. Any growth of a vocabulary axis, a pow-2 pod
+    bucket change, or an i32/i64 dtype flip produces a new bucket, so a
+    delta can never be applied across incompatible layouts."""
+    blob = ";".join(f"{a.dtype.str}{tuple(a.shape)}" for a in arrays)
     return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:16]
 
 

@@ -34,6 +34,19 @@ are already waiting, new requests get an immediate BUSY reply (the
 apiserver's 429 analog) instead of unbounded queueing latency — the
 client falls back to its in-process path for that wave, so a wedged or
 overloaded daemon degrades to exactly the pre-solverd behavior.
+
+**Delta wire (protocol v2).** The daemon keeps a resident plane cache
+keyed by (worker id, shape bucket): a client that already shipped a full
+frame for a bucket thereafter ships only the changed rows of the
+node/group/zone planes (``protocol.DELTA_FIELDS``) plus the per-wave pod
+planes. Reconstruction is copy-on-write — an applied delta produces NEW
+arrays, never mutating planes a queued earlier wave still references —
+and the cache entry is only installed when the wave is actually
+enqueued, so a BUSY bounce leaves client and daemon views consistent.
+Any mismatch (no entry after a restart or eviction, epoch skew, shape
+drift) is answered with ``{"resync": reason}`` before any solve work;
+the client re-sends the wave as a full frame. Solves stay bit-identical:
+the daemon either reconstructs byte-identical inputs or refuses.
 """
 
 from __future__ import annotations
@@ -44,7 +57,7 @@ import socket
 import struct
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -88,8 +101,8 @@ _PAD_SPEC = {
     "pod_aff_static":  (("P", "L"), -2),
     "anchor_vals0":    (("G", "L"), 0),
     "has_anchor0":     (("G",), False),
-    "zone_labeled":    (("A", "N"), False),
-    "zone_onehot":     (("A", "N", "V"), 0.0),
+    "zone_idx":        (("A", "N"), -1),   # pad nodes are unlabeled
+    "zone_counts0":    (("A", "G", "V"), 0),  # phantom zones hold no peers
 }
 
 
@@ -99,7 +112,7 @@ def _dims_of(inp) -> Dict[str, int]:
         "Wp": inp.node_ports.shape[1], "Ks": inp.node_sel.shape[1],
         "Wd": inp.node_pds.shape[1], "P": inp.req.shape[0],
         "G": inp.group_counts.shape[0], "L": inp.node_aff_vals.shape[1],
-        "A": inp.zone_labeled.shape[0], "V": inp.zone_onehot.shape[2],
+        "A": inp.zone_idx.shape[0], "V": inp.zone_counts0.shape[2],
     }
 
 
@@ -212,12 +225,18 @@ class SolverService:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  gather_window_s: float = 0.003, max_batch: int = 16,
-                 max_queue: int = 64):
+                 max_queue: int = 64, cache_entries: int = 64):
         from kubernetes_tpu.models.batch_solver import ensure_x64
         ensure_x64()  # spread_score's exact-rounding emulation needs x64
         self.gather_window_s = gather_window_s
         self.max_batch = max_batch
         self.max_queue = max_queue
+        # delta-wire resident plane cache: (wid, bucket) -> {"epoch": n,
+        # "planes": {field: np.ndarray}} — arrays are immutable by
+        # convention (copy-on-write on delta apply), LRU-bounded
+        self.cache_entries = cache_entries
+        self._plane_cache: "OrderedDict[tuple, dict]" = OrderedDict()
+        self._cache_lock = threading.Lock()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -229,9 +248,12 @@ class SolverService:
         self._conns: set = set()
         self._conns_lock = threading.Lock()
         self._m = _solverd_metrics()
+        self._dm = metrics.solverd_delta_metrics()
         # device-call / wave counters, exposed for tests and /metrics alike
         self.solve_calls = 0
         self.waves_served = 0
+        self.delta_waves = 0
+        self.resync_replies = 0
 
     # -- lifecycle ---------------------------------------------------------
     @property
@@ -360,16 +382,21 @@ class SolverService:
             with send_lock:
                 protocol.send_msg(conn, {"err": err, "msg": msg})
 
-        if header.get("v") != protocol.PROTOCOL_VERSION:
+        def resync(reason: str) -> None:
+            # NOT an error: the designed cold-cache/skew answer. The client
+            # re-sends the same wave as a full frame.
+            self.resync_replies += 1
+            self._dm.resyncs.inc(reason)
+            with send_lock:
+                protocol.send_msg(conn, {"resync": reason})
+
+        v = header.get("v")
+        if not (isinstance(v, int) and protocol.MIN_PROTOCOL_VERSION
+                <= v <= protocol.PROTOCOL_VERSION):
             reject("SolverProtocolError",
                    f"protocol version skew: daemon speaks "
-                   f"{protocol.PROTOCOL_VERSION}, request is "
-                   f"{header.get('v')!r}")
-            return
-        if len(arrays) != len(SolverInputs._fields):
-            reject("SolverProtocolError",
-                   f"expected {len(SolverInputs._fields)} arrays, "
-                   f"got {len(arrays)}")
+                   f"{protocol.MIN_PROTOCOL_VERSION}.."
+                   f"{protocol.PROTOCOL_VERSION}, request is {v!r}")
             return
         try:
             pol = protocol.policy_from_wire(header["policy"])
@@ -377,13 +404,105 @@ class SolverService:
             reject("SolverProtocolError", f"bad policy: {e}")
             return
         gangs = bool(header.get("gangs", False))
-        fp = protocol.solver_fingerprint(pol, gangs)
+        # a v1 client computed its fingerprint with v=1 — derive likewise
+        fp = protocol.solver_fingerprint(pol, gangs, version=v)
         if header.get("fp") not in (None, fp):
             reject("SolverProtocolError",
                    f"fingerprint mismatch: request {header.get('fp')!r}, "
                    f"daemon derives {fp!r}")
             return
-        inp = SolverInputs(*arrays)
+
+        fields = SolverInputs._fields
+        planes = header.get("planes")
+        cache_hdr = header.get("cache")
+        shipped = sum(a.nbytes for a in arrays)
+        cache_key = epoch = None
+        new_planes: Dict[str, np.ndarray] = {}
+        is_delta = False
+        if planes is None:
+            # v1-style full frame: every field present, nothing cached
+            if len(arrays) != len(fields):
+                reject("SolverProtocolError",
+                       f"expected {len(fields)} arrays, got {len(arrays)}")
+                return
+            cols = list(arrays)
+        else:
+            if len(planes) != len(fields):
+                reject("SolverProtocolError",
+                       f"expected {len(fields)} plane entries, "
+                       f"got {len(planes)}")
+                return
+            is_delta = any(p != "F" for p in planes)
+            entry = None
+            if cache_hdr is not None:
+                try:
+                    cache_key = (str(cache_hdr["wid"]),
+                                 str(cache_hdr["bucket"]))
+                    epoch = int(cache_hdr.get("epoch", 0))
+                except (KeyError, TypeError, ValueError) as e:
+                    reject("SolverProtocolError", f"bad cache header: {e}")
+                    return
+            if is_delta:
+                if cache_key is None:
+                    reject("SolverProtocolError",
+                           "delta planes without a cache header")
+                    return
+                with self._cache_lock:
+                    entry = self._plane_cache.get(cache_key)
+                if entry is None:
+                    resync("no_cache")
+                    return
+                if entry["epoch"] != epoch:
+                    resync("epoch")
+                    return
+            it = iter(arrays)
+            cols = []
+            try:
+                for name, p in zip(fields, planes):
+                    if p == "F":
+                        arr = next(it)
+                        if cache_key is not None and \
+                                name in protocol.DELTA_FIELDS:
+                            # own buffer: cached planes must not pin the
+                            # whole receive frame nor alias its reuse
+                            arr = np.array(arr, copy=True)
+                            new_planes[name] = arr
+                        cols.append(arr)
+                    elif p == "S":
+                        cols.append(entry["planes"][name])
+                    elif isinstance(p, list) and len(p) == 2 \
+                            and p[0] == "D":
+                        rows = next(it)
+                        vals = next(it)
+                        base = entry["planes"][name]
+                        if (rows.ndim != 1 or vals.shape[:1] != rows.shape
+                                or vals.shape[1:] != base.shape[1:]
+                                or vals.dtype != base.dtype
+                                or (rows.size and
+                                    (int(rows.max()) >= base.shape[0]
+                                     or int(rows.min()) < 0))):
+                            resync("shape")
+                            return
+                        # copy-on-write: queued earlier waves may still
+                        # reference the base plane
+                        arr = base.copy()
+                        arr[rows.astype(np.int64)] = vals
+                        new_planes[name] = arr
+                        cols.append(arr)
+                    else:
+                        reject("SolverProtocolError",
+                               f"bad plane entry {p!r} for {name}")
+                        return
+            except KeyError:
+                resync("missing_plane")
+                return
+            except StopIteration:
+                reject("SolverProtocolError", "truncated delta frame")
+                return
+            if next(it, None) is not None:
+                reject("SolverProtocolError", "trailing arrays in frame")
+                return
+        inp = SolverInputs(*cols)
         req = _Req(inp, pol, gangs, int(inp.req.shape[0]), conn, send_lock)
         with self._cond:
             if len(self._pending) >= self.max_queue:
@@ -394,9 +513,31 @@ class SolverService:
                 self._m.queue_depth.set(len(self._pending))
                 self._cond.notify()
         if busy:
+            # cache deliberately untouched: the client will not advance
+            # its mirror for a bounced wave, so both sides stay at the
+            # pre-frame epoch
             self._m.requests.inc("busy")
             with send_lock:
                 protocol.send_msg(conn, {"busy": True})
+            return
+        self._dm.bytes_shipped.inc(by=shipped)
+        self._dm.bytes_saved.inc(
+            by=max(0, sum(c.nbytes for c in cols) - shipped))
+        if is_delta:
+            self.delta_waves += 1
+            self._dm.hits.inc()
+        else:
+            self._dm.full_frames.inc()
+        if cache_key is not None:
+            with self._cache_lock:
+                prev = self._plane_cache.pop(cache_key, None)
+                merged = dict(prev["planes"]) if prev else {}
+                merged.update(new_planes)
+                self._plane_cache[cache_key] = {
+                    "epoch": (epoch or 0) + 1, "planes": merged}
+                while len(self._plane_cache) > self.cache_entries:
+                    self._plane_cache.popitem(last=False)
+                self._dm.cache_entries.set(len(self._plane_cache))
 
     # -- solver side -------------------------------------------------------
     def _gather(self) -> List[_Req]:
@@ -433,7 +574,7 @@ class SolverService:
             for r in batch:
                 key = (r.pol, r.gangs, str(r.inp.cap.dtype),
                        r.inp.node_aff_vals.shape[1],
-                       r.inp.zone_labeled.shape[0])
+                       r.inp.zone_idx.shape[0])
                 groups.setdefault(key, []).append(r)
             for reqs in groups.values():
                 try:

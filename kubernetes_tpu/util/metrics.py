@@ -13,7 +13,8 @@ import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["Counter", "Gauge", "Histogram", "Registry", "default_registry",
-           "DEFAULT_BUCKETS", "APISERVER_BUCKETS"]
+           "DEFAULT_BUCKETS", "APISERVER_BUCKETS",
+           "SolverdDeltaMetrics", "solverd_delta_metrics"]
 
 # ref: apiserver.go:60-61 — the expected request-latency envelope, in seconds.
 APISERVER_BUCKETS = (0.125, 0.25, 0.5, 1.0, 2.0, 4.0, 8.0)
@@ -200,3 +201,45 @@ _default = Registry()
 
 def default_registry() -> Registry:
     return _default
+
+
+class SolverdDeltaMetrics:
+    """The ``solverd_delta_*`` family — delta-wire effectiveness of the
+    kube-solverd resident plane cache (solver/service.py), exported from
+    the daemon's /metrics alongside the queue-depth/coalesce gauges.
+    Defined here (not in the service module) so the family is part of the
+    instrumentation contract the churn harness and dashboards scrape, the
+    same way the apiserver/kubelet metric families are."""
+
+    _singleton = None
+
+    def __init__(self, registry: Optional[Registry] = None):
+        reg = registry or default_registry()
+        self.hits = reg.counter(
+            "solverd_delta_hits_total",
+            "Solve frames whose resident planes arrived as row deltas "
+            "and were applied to the daemon's cache")
+        self.full_frames = reg.counter(
+            "solverd_delta_full_frames_total",
+            "Full-plane solve frames (cache establish/refresh, v1 "
+            "clients, or post-resync re-sends)")
+        self.resyncs = reg.counter(
+            "solverd_delta_resyncs_total",
+            "Delta frames refused pending a full resync, by reason",
+            ("reason",))
+        self.bytes_shipped = reg.counter(
+            "solverd_delta_bytes_shipped_total",
+            "Array bytes received on the wire for solve frames")
+        self.bytes_saved = reg.counter(
+            "solverd_delta_bytes_saved_total",
+            "Array bytes NOT shipped because resident planes were "
+            "reused (full reconstruction size minus wire size)")
+        self.cache_entries = reg.gauge(
+            "solverd_delta_cache_entries",
+            "Live (worker, shape-bucket) resident plane cache entries")
+
+
+def solverd_delta_metrics() -> SolverdDeltaMetrics:
+    if SolverdDeltaMetrics._singleton is None:
+        SolverdDeltaMetrics._singleton = SolverdDeltaMetrics()
+    return SolverdDeltaMetrics._singleton

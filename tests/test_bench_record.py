@@ -3,14 +3,31 @@ json.loads and stays < 1.5 KB regardless of how much detail the run
 produced (BENCH_r05.json had parsed:null because one giant line with
 inline runs_s arrays truncated in capture); the full record goes to the
 detail sidecar. The replay path must honor the same contract when
-re-emitting pre-contract committed records."""
+re-emitting pre-contract committed records.
 
+Also the CHURN_MP_* record schema (hack/churn_mp.py validate_record):
+committed churn records must carry the delta-wire evidence (hit rate,
+bytes shipped vs saved) and the per-stage CPU budget, so a future round
+can't silently drop the fields the acceptance gates read."""
+
+import glob
+import importlib.util
 import json
 import os
 
 import bench
 
 _LIMIT = 1500
+
+_REPO = os.path.dirname(os.path.abspath(bench.__file__))
+
+
+def _load_churn_mp():
+    spec = importlib.util.spec_from_file_location(
+        "churn_mp", os.path.join(_REPO, "hack", "churn_mp.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def _fat_record():
@@ -70,6 +87,62 @@ def test_compact_is_idempotent_on_already_compact_records():
     assert rec2["configs"]["north_star"].get("p50") == \
         rec1["configs"]["north_star"].get("p50")
     assert len(line2) < _LIMIT
+
+
+def _churn_sample_record():
+    return {
+        "config": "churn multi-process: 50000 pods at 1000/s onto "
+                  "10000 nodes",
+        "topology": "4 apiserver workers + kube-store + 2 tpu-batch "
+                    "scheduler workers -> shared kube-solverd + 4 "
+                    "replay-log feeders",
+        "offered_pods_per_s": 1001.2, "sustained_pods_per_s": 1000.3,
+        "all_bound": True, "feed_s": 49.9, "total_s": 50.0,
+        "replay_render_s": 1.2, "feeder_behind_max_s": 0.05,
+        "scheduler_waves": {"encode": {"waves": 50, "mean_ms": 5.0,
+                                       "p50_ms": 4.0, "p95_ms": 9.0}},
+        "cpu_budget_s": {"apiserver": 40.1, "scheduler": 30.2,
+                         "solverd": 25.3, "feeders": 2.0},
+        "host_cores": 24,
+        "solverd": {"device_solves": 50, "waves_served": 55,
+                    "coalesce_factor": 1.1,
+                    "delta_hits": 48, "delta_full_frames": 2,
+                    "delta_resyncs": 0, "delta_hit_rate": 0.96,
+                    "delta_bytes_shipped": 10_000_000,
+                    "delta_bytes_saved": 200_000_000},
+    }
+
+
+def test_churn_record_schema_accepts_complete_record():
+    churn_mp = _load_churn_mp()
+    assert churn_mp.validate_record(_churn_sample_record()) == []
+
+
+def test_churn_record_schema_flags_dropped_fields():
+    churn_mp = _load_churn_mp()
+    rec = _churn_sample_record()
+    del rec["cpu_budget_s"]
+    del rec["solverd"]["delta_hit_rate"]
+    missing = churn_mp.validate_record(rec)
+    assert "cpu_budget_s" in missing
+    assert "solverd.delta_hit_rate" in missing
+    # an aborted run's partial record is exempt beyond its error marker
+    assert churn_mp.validate_record(
+        {"error": "feeder failures", "created": 10}) == []
+
+
+def test_committed_churn_records_conform():
+    """Every committed CHURN_MP record from r07 on must satisfy the
+    schema — the contract that keeps delta-wire evidence and the CPU
+    budget in future rounds' records."""
+    churn_mp = _load_churn_mp()
+    for path in glob.glob(os.path.join(_REPO, "CHURN_MP_r*.json")):
+        round_no = int(path.rsplit("_r", 1)[1].split("_")[0].split(".")[0])
+        if round_no < 7:
+            continue  # pre-contract records are historical evidence
+        with open(path) as fh:
+            rec = json.load(fh)
+        assert churn_mp.validate_record(rec) == [], path
 
 
 def test_replay_of_committed_records_stays_compact():

@@ -342,6 +342,85 @@ def test_delta_bails_to_full_on_overflow_and_node_change():
     assert enc2.encode_delta([mk_node("n2")], [], [], [], []) is None
 
 
+# -- resident zone-count planes (ServiceAntiAffinity) ------------------------
+
+def _zone_fixture(n_nodes=32, n_existing=64):
+    pol = BatchPolicy(anti_affinity=(("zone", 2),))
+    enc = IncrementalEncoder(pol)
+    nodes = [mk_node(f"n{i}", labels={"zone": f"z{i % 4}"} if i % 5 else {})
+             for i in range(n_nodes)]
+    svc = api.Service(metadata=api.ObjectMeta(name="s", namespace="default"),
+                      spec=api.ServiceSpec(port=80, selector={"app": "x"}))
+    existing = [mk_pod(f"e{i}", labels={"app": "x"} if i % 2 else {},
+                       host=f"n{i % n_nodes}") for i in range(n_existing)]
+    return pol, enc, nodes, [svc], existing
+
+
+def test_zone_count_planes_stay_exact_under_delta_churn():
+    """The resident [A, G, V] zone-count planes must equal the from-scratch
+    derivation (batch_solver.derive_zone_counts) after every delta, and
+    the delta-path decisions must match a fresh encoder's and the full
+    encoder's under an anti-affinity policy."""
+    from kubernetes_tpu.models.batch_solver import derive_zone_counts
+
+    pol, enc, nodes, services, existing = _zone_fixture()
+    enc.encode(nodes, existing, [mk_pod("warm", labels={"app": "x"})],
+               services)
+    rng = random.Random(11)
+    for wave in range(4):
+        pending = [mk_pod(f"w{wave}p{j}",
+                          labels={"app": "x"} if rng.random() < 0.7 else {})
+                   for j in range(rng.randint(2, 6))]
+        upserted, removed = [], []
+        for p in list(existing):
+            if rng.random() < 0.1:
+                existing.remove(p)
+                removed.append(p)
+        snap = enc.encode_delta(nodes, upserted, removed, pending, services)
+        assert snap is not None
+        want = derive_zone_counts(snap.node_zone, snap.group_counts,
+                                  snap.zone_counts0.shape[2])
+        assert np.array_equal(snap.zone_counts0, want)
+        fresh = IncrementalEncoder(pol).encode(nodes, existing, pending,
+                                               services)
+        full = encode_snapshot(nodes, existing, pending, services,
+                               policy=pol)
+        chosen_d, _ = solve(snap)
+        chosen_fr, _ = solve(fresh)
+        chosen_fu, _ = solve(full)
+        assert decisions_to_names(snap, chosen_d) == \
+            decisions_to_names(fresh, chosen_fr) == \
+            decisions_to_names(full, chosen_fu)
+        for p, h in zip(pending, decisions_to_names(snap, chosen_d)):
+            if h:
+                p.status.host = h
+                existing.append(p)
+                enc.encode_delta(nodes, [p], [], [], services)
+
+
+def test_zone_plane_maintenance_is_o_changed():
+    """Counter-based O(changed) guard (tier-1 safe: no timing): one pod
+    bind + one delete must touch the zone planes a constant number of
+    times — A dims x matching groups per pod — independent of cluster
+    size, and must not trigger a node-plane rebuild."""
+    pol, enc, nodes, services, existing = _zone_fixture()
+    enc.encode(nodes, existing, [mk_pod("warm", labels={"app": "x"})],
+               services)
+    rebuilds = enc.op_counts["node_rebuilds"]
+    zw0 = enc.op_counts["zone_writes"]
+    newpod = mk_pod("np", labels={"app": "x"})
+    newpod.status.host = "n3"
+    gone = existing[1]  # labeled {"app": "x"}, on a zone-labeled node
+    snap = enc.encode_delta(nodes, [newpod], [gone],
+                            [mk_pod("pend", labels={"app": "x"})], services)
+    assert snap is not None
+    assert enc.op_counts["node_rebuilds"] == rebuilds
+    # A=1 anti-affinity dim, 1 matching group, 2 changed pods -> <= 2
+    # single-element writes; the resident planes were NOT rebuilt from
+    # the 64-pod existing list
+    assert enc.op_counts["zone_writes"] - zw0 <= 2
+
+
 def test_store_changelog_and_modeler_delta():
     from kubernetes_tpu.client.cache import FIFO, Store
     from kubernetes_tpu.scheduler.driver import SimpleModeler

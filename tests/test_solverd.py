@@ -409,6 +409,155 @@ class TestFallback:
             srv2.stop()
 
 
+# -- the delta wire (protocol v2) --------------------------------------------
+
+class TestDeltaWire:
+    """Bit-identity contract of the delta wire: a wave solved via plane
+    deltas against the daemon's resident cache must decide EXACTLY like
+    the same wave shipped as a full frame and like the in-process solve —
+    across churn, injected epoch skew, and a daemon restart mid-stream."""
+
+    @staticmethod
+    def _churn_stream(tag, waves=5, n_nodes=6, wave_pods=5):
+        """One incremental encoder churning: each yielded snapshot's
+        resident planes differ from the previous wave's by O(changed)
+        rows (binds accumulate), while shapes stay in one pow-2 bucket —
+        the steady state the delta wire exists for."""
+        from kubernetes_tpu.models.batch_solver import decisions_to_names
+
+        enc = IncrementalEncoder()
+        nodes = [mk_node(f"{tag}-n{i}") for i in range(n_nodes)]
+        existing = []
+        for w in range(waves):
+            pending = [mk_pod(f"{tag}-w{w}p{j}") for j in range(wave_pods)]
+            snap = enc.encode(nodes, existing, pending, SERVICES)
+            yield snap
+            chosen, _ = solve(snap)
+            for p, h in zip(pending, decisions_to_names(snap, chosen)):
+                if h:
+                    p.status.host = h
+                    existing.append(p)
+
+    def test_delta_stream_bit_identical_to_full_and_in_process(self):
+        srv = SolverService(gather_window_s=0.001).start()
+        try:
+            cli_delta = RemoteSolver(srv.address, fallback=False,
+                                     timeout_s=120)
+            cli_full = RemoteSolver(srv.address, fallback=False,
+                                    timeout_s=120, delta=False)
+            for snap in self._churn_stream("dw"):
+                expected = solve(snap)
+                got_d = cli_delta.solve(snap)
+                got_f = cli_full.solve(snap)
+                for got in (got_d, got_f):
+                    assert np.array_equal(got[0], expected[0])
+                    assert np.array_equal(got[1], expected[1])
+            # the stream stayed in one shape bucket: wave 1 established
+            # the cache, every later wave rode deltas and shipped less
+            assert cli_delta.full_waves == 1
+            assert cli_delta.delta_waves == 4
+            assert cli_delta.resync_waves == 0
+            assert srv.delta_waves == 4
+            assert cli_delta.delta_bytes_shipped < cli_delta.delta_bytes_full
+            # the full-frame client never touched the delta path
+            assert cli_full.delta_waves == 0 and cli_full.full_waves == 0
+        finally:
+            srv.stop()
+
+    def test_epoch_skew_resyncs_and_recovers(self):
+        srv = SolverService(gather_window_s=0.001).start()
+        try:
+            cli = RemoteSolver(srv.address, fallback=False, timeout_s=120)
+            snaps = list(self._churn_stream("ep"))
+            expected = [solve(s) for s in snaps]
+            got = cli.solve(snaps[0])
+            assert np.array_equal(got[0], expected[0][0])
+            # desync the pair: pretend the client applied frames the
+            # daemon never saw (a lost reply's worst case)
+            for mir in cli._local.mirrors.values():
+                mir.epoch += 3
+            got = cli.solve(snaps[1])
+            assert np.array_equal(got[0], expected[1][0])
+            assert cli.resync_waves == 1
+            assert srv.resync_replies == 1
+            # the full-frame resend re-established the pair: back to deltas
+            got = cli.solve(snaps[2])
+            assert np.array_equal(got[0], expected[2][0])
+            assert cli.delta_waves == 1
+        finally:
+            srv.stop()
+
+    def test_daemon_restart_mid_stream_resyncs_no_cache(self):
+        snaps = list(self._churn_stream("rs"))
+        expected = [solve(s) for s in snaps]
+        srv1 = SolverService(gather_window_s=0.001).start()
+        port = srv1.port
+        cli = RemoteSolver(srv1.address, fallback=False, timeout_s=120)
+        for i in (0, 1):
+            got = cli.solve(snaps[i])
+            assert np.array_equal(got[0], expected[i][0])
+        assert cli.delta_waves == 1
+        srv1.stop()
+        srv2 = None
+        deadline = time.monotonic() + 10
+        while srv2 is None:
+            try:
+                srv2 = SolverService(port=port, gather_window_s=0.001)
+            except OSError:
+                assert time.monotonic() < deadline, "port never freed"
+                time.sleep(0.1)
+        srv2.start()
+        try:
+            # the restarted daemon has no cache: the delta attempt must
+            # resync to a full frame (after the stale-socket retry), and
+            # later waves ride deltas against the fresh entry
+            got = cli.solve(snaps[2])
+            assert np.array_equal(got[0], expected[2][0])
+            assert cli.resync_waves == 1
+            got = cli.solve(snaps[3])
+            assert np.array_equal(got[0], expected[3][0])
+            assert cli.delta_waves == 2
+        finally:
+            srv2.stop()
+
+    def test_v1_full_frame_client_still_served(self):
+        """Version negotiation: a v1 client (no cache/planes, fingerprint
+        derived with v=1) against the v2 daemon gets full-plane service,
+        not an error."""
+        from kubernetes_tpu.models.batch_solver import (
+            snapshot_to_host_inputs)
+
+        snap = small_snapshot("v1c", 4, 6)
+        expected = solve(snap)
+        srv = SolverService(gather_window_s=0.001).start()
+        try:
+            sock = socket.create_connection(("127.0.0.1", srv.port),
+                                            timeout=10)
+            sock.settimeout(120)
+            host = snapshot_to_host_inputs(snap)
+            protocol.send_msg(sock, {
+                "op": "solve", "v": 1,
+                "fp": protocol.solver_fingerprint(BatchPolicy(), False,
+                                                  version=1),
+                "policy": protocol.policy_to_wire(BatchPolicy()),
+                "gangs": False}, tuple(host))
+            header, arrays = protocol.recv_msg(sock)
+            assert header.get("ok"), header
+            assert np.array_equal(arrays[0], expected[0])
+            sock.close()
+        finally:
+            srv.stop()
+
+    def test_shape_bucket_tracks_layout(self):
+        a = (np.zeros((4, 2), np.int32), np.ones(3, bool))
+        same = (np.ones((4, 2), np.int32) * 7, np.zeros(3, bool))
+        grown = (np.zeros((8, 2), np.int32), np.ones(3, bool))
+        widened = (np.zeros((4, 2), np.int64), np.ones(3, bool))
+        assert protocol.shape_bucket(a) == protocol.shape_bucket(same)
+        assert protocol.shape_bucket(a) != protocol.shape_bucket(grown)
+        assert protocol.shape_bucket(a) != protocol.shape_bucket(widened)
+
+
 # -- the scheduler end-to-end ------------------------------------------------
 
 class TestSchedulerIntegration:
