@@ -269,6 +269,166 @@ def _scrape_solverd(port: int) -> dict:
     return out
 
 
+def _parse_hist(raw: str, base: str):
+    """-> (sum, count, sorted [(le, cumcount)]) for one histogram family."""
+    buckets, total, count = [], 0.0, 0.0
+    for line in raw.splitlines():
+        if line.startswith(base + "_bucket"):
+            le = line.split('le="', 1)[1].split('"', 1)[0]
+            buckets.append((float("inf") if le == "+Inf" else float(le),
+                            float(line.rsplit(None, 1)[1])))
+        elif line.startswith(base + "_sum"):
+            total = float(line.rsplit(None, 1)[1])
+        elif line.startswith(base + "_count"):
+            count = float(line.rsplit(None, 1)[1])
+    return total, count, sorted(buckets)
+
+
+def _hist_quantile(buckets, count: float, q: float) -> float:
+    target = q * count
+    prev_le, prev_n = 0.0, 0.0
+    for le, n in buckets:
+        if n >= target:
+            if le == float("inf"):
+                return prev_le
+            span = n - prev_n
+            frac = (target - prev_n) / span if span else 1.0
+            return prev_le + (le - prev_le) * frac
+        prev_le, prev_n = le, n
+    return prev_le
+
+
+def _scrape_apiserver(master: str) -> dict:
+    """The apiserver_* hot-path evidence from the server's /metrics:
+    frame-cache effectiveness, fan-out write batching, lag drops, and the
+    batch-bind size/latency envelope (docs/design/apiserver-hotpath.md)."""
+    raw = urllib.request.urlopen(f"{master}/metrics", timeout=5
+                                 ).read().decode()
+    vals = {}
+    for key in ("apiserver_watch_frame_cache_hits_total",
+                "apiserver_watch_frame_cache_misses_total",
+                "apiserver_watch_frame_seeds_total",
+                "apiserver_watch_lag_drops_total",
+                "watch_events_coalesced_total",
+                "watch_events_dropped_total",
+                "watch_lag_resyncs_total"):
+        for line in raw.splitlines():
+            if line.startswith(key + " "):
+                vals[key] = float(line.rsplit(None, 1)[1])
+    hits = vals.get("apiserver_watch_frame_cache_hits_total", 0.0)
+    misses = vals.get("apiserver_watch_frame_cache_misses_total", 0.0)
+    out = {
+        "frame_cache_hits": int(hits),
+        "frame_cache_misses": int(misses),
+        "frame_cache_hit_rate": round(hits / (hits + misses), 3)
+        if hits + misses else 0.0,
+        "frame_seeds": int(
+            vals.get("apiserver_watch_frame_seeds_total", 0.0)),
+        "watch_lag_drops": int(
+            vals.get("apiserver_watch_lag_drops_total", 0.0)),
+        "watch_events_coalesced": int(
+            vals.get("watch_events_coalesced_total", 0.0)),
+        "watch_events_dropped": int(
+            vals.get("watch_events_dropped_total", 0.0)),
+    }
+    fo_sum, fo_count, _ = _parse_hist(raw, "apiserver_watch_fanout_seconds")
+    wf_sum, wf_count, _ = _parse_hist(raw, "apiserver_watch_write_frames")
+    out["fanout_seconds"] = round(fo_sum, 2)
+    out["fanout_writes"] = int(fo_count)
+    if wf_count:
+        out["frames_per_write"] = round(wf_sum / wf_count, 2)
+    sz_sum, sz_count, _ = _parse_hist(raw, "apiserver_batch_bind_size")
+    s_sum, s_count, s_buckets = _parse_hist(raw, "apiserver_batch_bind_seconds")
+    out["batch_bind_requests"] = int(sz_count)
+    out["batch_bind_bindings"] = int(sz_sum)
+    out["batch_bind_p50_ms"] = round(
+        _hist_quantile(s_buckets, s_count, 0.5) * 1000, 2) if s_count else 0.0
+    out["batch_bind_p95_ms"] = round(
+        _hist_quantile(s_buckets, s_count, 0.95) * 1000, 2) if s_count else 0.0
+    out["bind_server_ms_per_pod"] = round(s_sum / sz_sum * 1000, 3) \
+        if sz_sum else 0.0
+    return out
+
+
+def bind_parity_probe(client, api, n_nodes: int, k: int = 64) -> dict:
+    """Zero-divergence evidence for the batch endpoint ON THE LIVE SERVER:
+    two identical pod sets, one bound per-pod (POST pods/{name}/binding),
+    one via bindings:batch, with an intentional double-bind in each arm.
+    Runs before the scheduler starts so nothing races the probe. Returns
+    {checked, divergent, conflict_parity}."""
+    ns = "parity"
+    plan = [(f"parity-{arm}-{i:03d}", f"node-{i % n_nodes:05d}")
+            for arm in ("a", "b") for i in range(k)]
+    for name, _host in plan:
+        client.pods(ns).create(api.Pod(
+            metadata=api.ObjectMeta(name=name, namespace=ns),
+            spec=api.PodSpec(containers=[api.Container(
+                name="c", image="img")])))
+
+    def binding(name, host):
+        return api.Binding(metadata=api.ObjectMeta(name=name, namespace=ns),
+                           pod_name=name, host=host)
+
+    a_codes = []
+    for name, host in plan[:k] + [plan[0]]:       # last item re-binds: 409
+        try:
+            client.pods(ns).bind(binding(name, host))
+            a_codes.append(0)
+        except Exception as e:
+            a_codes.append(getattr(e, "code", -1))
+    res = client.pods(ns).bind_many(api.BindingList(
+        items=[binding(n, h) for n, h in plan[k:] + [plan[k]]]))
+    b_codes = [r.code for r in res.items]
+    divergent = sum(1 for ca, cb in zip(a_codes, b_codes) if ca != cb)
+    hosts = {p.metadata.name: p.spec.host
+             for p in client.pods(ns).list().items}
+    for i, (name, want) in enumerate(plan):
+        peer = plan[(i + k) % (2 * k)][0]
+        if hosts.get(name) != want or hosts.get(name) != hosts.get(peer):
+            divergent += 1
+    return {"checked": len(plan) + 2, "divergent": divergent,
+            "conflict_parity": a_codes[-1] == b_codes[-1] == 409}
+
+
+def bind_cost_probe(client, api, n_nodes: int, k: int = 512,
+                    rounds: int = 2, per_pod_n: int = 256) -> dict:
+    """Isolated apiserver bind cost on the QUIET server — the number
+    comparable to r07's commit-derived ~1.8 ms/bind, which r07 measured
+    on mostly post-feed (quiet) waves. Two arms: K-binding batch
+    requests (the bindings:batch path the scheduler uses) and a per-pod
+    control arm (one POST pods/{name}/binding per pod). Client-observed
+    wall per bind, so it includes client encode/decode + the wire —
+    conservative for the server."""
+    import time as _time
+    ns = "probe"
+    total = k * rounds + per_pod_n
+    names = [f"probe-{i:05d}" for i in range(total)]
+    for name in names:
+        client.pods(ns).create(api.Pod(
+            metadata=api.ObjectMeta(name=name, namespace=ns),
+            spec=api.PodSpec(containers=[api.Container(
+                name="c", image="img")])))
+
+    def binding(i):
+        return api.Binding(
+            metadata=api.ObjectMeta(name=names[i], namespace=ns),
+            pod_name=names[i], host=f"node-{i % n_nodes:05d}")
+
+    t0 = _time.perf_counter()
+    for r in range(rounds):
+        res = client.pods(ns).bind_many(api.BindingList(
+            items=[binding(i) for i in range(r * k, (r + 1) * k)]))
+        assert not any(x.error for x in res.items)
+    batch_ms = (_time.perf_counter() - t0) / (k * rounds) * 1000
+    t0 = _time.perf_counter()
+    for i in range(k * rounds, total):
+        client.pods(ns).bind(binding(i))
+    per_pod_ms = (_time.perf_counter() - t0) / per_pod_n * 1000
+    return {"batch_ms_per_pod": round(batch_ms, 3),
+            "per_pod_ms": round(per_pod_ms, 3),
+            "pods": total}
+
+
 def _proc_cpu_s(pid: int) -> float:
     """utime+stime of one process from /proc (Linux), in seconds."""
     with open(f"/proc/{pid}/stat") as fh:
@@ -279,18 +439,26 @@ def _proc_cpu_s(pid: int) -> float:
 # The committed-record contract (tests/test_bench_record.py): a CHURN_MP
 # record must carry these so future rounds can't silently drop the
 # delta-wire evidence or the per-stage CPU budget the acceptance gates
-# read. solverd keys are required only when the run had a daemon.
+# read. solverd keys are required only when the run had a daemon;
+# apiserver hot-path keys are required from r08 on.
 RECORD_FIELDS = ("config", "topology", "offered_pods_per_s",
                  "sustained_pods_per_s", "all_bound", "feed_s", "total_s",
                  "scheduler_waves", "cpu_budget_s", "host_cores")
 SOLVERD_DELTA_FIELDS = ("delta_hits", "delta_full_frames", "delta_resyncs",
                         "delta_hit_rate", "delta_bytes_shipped",
                         "delta_bytes_saved")
+APISERVER_FIELDS = ("frame_cache_hits", "frame_cache_misses",
+                    "frame_cache_hit_rate", "watch_lag_drops",
+                    "batch_bind_requests", "batch_bind_bindings",
+                    "batch_bind_p50_ms", "bind_server_ms_per_pod",
+                    "per_bind_ms_live", "bind_parity", "bind_probe")
 
 
-def validate_record(rec: dict) -> list:
+def validate_record(rec: dict, round_no: int = 8) -> list:
     """-> list of missing/malformed field paths (empty = conformant).
-    Error records (a run that aborted) are exempt beyond their marker."""
+    ``round_no`` gates fields introduced mid-series (apiserver hot-path
+    evidence exists from r08 on). Error records (a run that aborted) are
+    exempt beyond their marker."""
     if "error" in rec:
         return []
     missing = [k for k in RECORD_FIELDS if k not in rec]
@@ -298,6 +466,13 @@ def validate_record(rec: dict) -> list:
     if isinstance(sd, dict) and "error" not in sd:
         missing += [f"solverd.{k}" for k in SOLVERD_DELTA_FIELDS
                     if k not in sd]
+    if round_no >= 8:
+        ap = rec.get("apiserver")
+        if not isinstance(ap, dict):
+            missing.append("apiserver")
+        elif "error" not in ap:
+            missing += [f"apiserver.{k}" for k in APISERVER_FIELDS
+                        if k not in ap]
     cb = rec.get("cpu_budget_s")
     if cb is not None and not isinstance(cb, dict):
         missing.append("cpu_budget_s:not-a-dict")
@@ -396,6 +571,12 @@ def main(argv=None) -> int:
                     "when several scheduler workers share the daemon so "
                     "their waves coalesce into one vmap call instead of "
                     "serializing through the solve thread")
+    ap.add_argument("--watchers", type=int, default=0,
+                    help="observer watch streams on /api/v1/pods (the "
+                    "kubelet/controller stand-ins every real cluster "
+                    "has): each receives every pod event, so the "
+                    "encode-once fan-out is exercised at width instead "
+                    "of the minimum the scheduler alone provides")
     ap.add_argument("--depth", type=int, default=32,
                     help="per-feeder pipelined requests in flight; the "
                     "offered rate is bounded by depth x feeders / server "
@@ -473,6 +654,20 @@ def main(argv=None) -> int:
                 spec=api.NodeSpec(capacity={"cpu": Quantity("64"),
                                             "memory": Quantity("256Gi")})))
 
+        # batch-vs-per-pod CAS parity on the LIVE server, before any
+        # scheduler can race the probe pods (the zero-divergence evidence
+        # the record carries)
+        try:
+            parity = bind_parity_probe(client, api, args.nodes)
+        except Exception as e:
+            parity = {"error": f"probe failed: {e}"}
+        # isolated bind cost on the quiet server (comparable to r07's
+        # commit-derived figure, which r07 measured on post-feed waves)
+        try:
+            bind_probe = bind_cost_probe(client, api, args.nodes)
+        except Exception as e:
+            bind_probe = {"error": f"probe failed: {e}"}
+
         solver_addr = ""
         if args.solverd:
             solverd_port = args.port + 7
@@ -515,35 +710,92 @@ def main(argv=None) -> int:
         # trying to measure). A pod transitioning into the
         # spec.host!= filter emits one ADDED frame; counting frames on
         # the raw chunked stream costs the server one cached frame
-        # encode and this process a substring scan.
+        # encode and this process a substring scan. If the stream ever
+        # ends (a 410 lag drop, an apiserver hiccup), the monitor does
+        # what any reflector does: ONE list to resync the count, then
+        # re-watches from the list's resourceVersion — bound pods never
+        # unbind, so frames-seen and bound-now stay the same number.
         import socket as socketlib
         import threading as threadinglib
         bound_count = [0]
+        # pods the probes bound before the monitor started (a resync LIST
+        # would count them; the watch stream never does)
+        parity_bound = (parity.get("checked", 2) - 2
+                        + bind_probe.get("pods", 0))
+        churn_done = threadinglib.Event()
 
         MARK = b'"type": "ADDED"'
 
-        def bind_counter():
+        def _count_stream(rv: str) -> None:
+            q = b"watch=1&fieldSelector=spec.host%21%3D"
+            if rv:
+                q += b"&resourceVersion=" + rv.encode()
             s = socketlib.create_connection(("127.0.0.1", args.port))
-            s.sendall(b"GET /api/v1/pods?watch=1&fieldSelector="
-                      b"spec.host%21%3D HTTP/1.1\r\nHost: a\r\n\r\n")
-            tail = b""
-            while True:
-                try:
+            try:
+                s.sendall(b"GET /api/v1/pods?" + q +
+                          b" HTTP/1.1\r\nHost: a\r\n\r\n")
+                tail = b""
+                while True:
                     chunk = s.recv(1 << 16)
+                    if not chunk:
+                        return
+                    buf = tail + chunk
+                    n = buf.count(MARK)
+                    if n:
+                        bound_count[0] += n
+                        # drop everything through the last counted marker
+                        # so the kept tail can never be re-counted
+                        buf = buf[buf.rfind(MARK) + len(MARK):]
+                    tail = buf[-(len(MARK) - 1):]  # split marker survives
+            finally:
+                s.close()
+
+        def bind_counter():
+            rv = ""
+            while not churn_done.is_set():
+                try:
+                    _count_stream(rv)
                 except OSError:
+                    pass
+                if churn_done.is_set():
                     return
-                if not chunk:
-                    return
-                buf = tail + chunk
-                n = buf.count(MARK)
-                if n:
-                    bound_count[0] += n
-                    # drop everything through the last counted marker so
-                    # the kept tail can never be re-counted
-                    buf = buf[buf.rfind(MARK) + len(MARK):]
-                tail = buf[-(len(MARK) - 1):]  # split marker survives
+                # stream ended: resync count from one list, resume from
+                # its resourceVersion (the Reflector contract)
+                try:
+                    lst = json.loads(urllib.request.urlopen(
+                        f"{master}/api/v1/pods?fieldSelector="
+                        "spec.host%21%3D", timeout=30).read())
+                    bound_count[0] = len(lst.get("items", ())) - parity_bound
+                    rv = str(lst.get("metadata", {})
+                             .get("resourceVersion", ""))
+                except Exception:
+                    time.sleep(0.5)
 
         threadinglib.Thread(target=bind_counter, daemon=True).start()
+
+        # observer fleet: each stream receives every pod frame as cached
+        # bytes (a stand-in for the kubelets/controllers of a real
+        # cluster); readers just drain and count
+        observer_frames = [0] * args.watchers
+
+        def observer(slot):
+            while not churn_done.is_set():
+                try:
+                    s = socketlib.create_connection(("127.0.0.1", args.port))
+                    s.sendall(b"GET /api/v1/pods?watch=1 HTTP/1.1\r\n"
+                              b"Host: a\r\n\r\n")
+                    while True:
+                        chunk = s.recv(1 << 16)
+                        if not chunk:
+                            break
+                        observer_frames[slot] += chunk.count(b'"type"')
+                    s.close()
+                except OSError:
+                    time.sleep(0.2)
+
+        for w in range(args.watchers):
+            threadinglib.Thread(target=observer, args=(w,),
+                                daemon=True).start()
 
         def wait_all_bound(total_created, timeout=180.0):
             deadline = time.monotonic() + timeout
@@ -669,6 +921,8 @@ def main(argv=None) -> int:
             sched_desc += " (--pipeline speculative double-buffering)"
         if solver_addr:
             sched_desc += " -> shared kube-solverd (wave coalescing)"
+        if args.watchers:
+            sched_desc += f" + {args.watchers} observer watch streams"
         budget = cpu_budget()
         budget["feeders"] = round(sum(s.get("cpu_s", 0.0) for s in stats), 2)
         record = {
@@ -693,6 +947,29 @@ def main(argv=None) -> int:
             "cpu_budget_s": budget,
             "host_cores": os.cpu_count(),
         }
+        # the apiserver hot-path evidence (encode-once fan-out + batch
+        # bind): scraped from the live server, plus the live per-bind
+        # cost derived from the scheduler's commit-wave quantiles
+        try:
+            ap = _scrape_apiserver(master)
+        except Exception as e:
+            ap = {"error": f"scrape failed: {e}"}
+        commit = wave_stats.get("commit") if isinstance(wave_stats, dict) \
+            else None
+        if isinstance(commit, dict) and commit.get("waves"):
+            # client-observed: commit-wave p50 over the average wave size
+            # (the same derivation that put r07's wall at ~1.8 ms/bind)
+            ap["per_bind_ms_live"] = round(
+                commit["p50_ms"] / (args.pods / commit["waves"]), 3)
+        else:
+            ap.setdefault("per_bind_ms_live", 0.0)
+        ap["bind_parity"] = parity
+        ap["bind_probe"] = bind_probe
+        if args.watchers:
+            ap["observer_watchers"] = args.watchers
+            ap["observer_frames"] = sum(observer_frames)
+        record["apiserver"] = ap
+        churn_done.set()  # monitor/observer threads stop reconnecting
         if solver_addr:
             try:
                 record["solverd"] = _scrape_solverd(solverd_metrics_port)

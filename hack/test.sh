@@ -29,7 +29,8 @@ if [[ "$RACE" == 1 ]]; then
     ROUNDS="${RACE_ROUNDS:-3}"
     SUITES=(tests/test_contention.py tests/test_storage.py
             tests/test_remote_store.py tests/test_cache.py
-            tests/test_http.py tests/test_stale_wave.py
+            tests/test_http.py tests/test_apiserver.py
+            tests/test_stale_wave.py
             tests/test_websocket_pprof.py tests/test_cloudprovider.py
             tests/test_envvars.py tests/test_capabilities.py
             tests/test_kubelet.py tests/test_process_runtime.py

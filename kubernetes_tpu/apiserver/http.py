@@ -473,7 +473,14 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(200, json.dumps(version_pkg.get().as_dict()))
             return 200
         if head == "metrics":
-            self._send_text(200, apisrv.metrics_registry.render_text(),
+            payload = apisrv.metrics_registry.render_text()
+            # the process-wide default registry carries the watch-package
+            # loss counters (watch_events_dropped/coalesced, lag resyncs)
+            # — surface them alongside the per-server families
+            default_reg = metrics_pkg.default_registry()
+            if default_reg is not apisrv.metrics_registry:
+                payload += default_reg.render_text()
+            self._send_text(200, payload,
                             ctype="text/plain; version=0.0.4; charset=utf-8")
             return 200
         if head == "validate":
@@ -501,6 +508,14 @@ class _Handler(BaseHTTPRequestHandler):
             return self._handle_proxy_redirect(rest[0], version, rest[1:],
                                                query, user, method, raw_body)
 
+        # the batch-bind verb-suffix route: "bindings:batch" is one path
+        # segment; normalize it to the bindings resource before namespace
+        # scoping so both path-ns and query-ns forms resolve
+        batch_bind = "bindings:batch" in rest
+        if batch_bind:
+            rest = ["bindings" if seg == "bindings:batch" else seg
+                    for seg in rest]
+
         # namespace from path (v1-style) or query param (v1beta1-style).
         # /namespaces/{name}[/finalize] stays the namespaces resource itself;
         # /namespaces/{ns}/{known-resource}/... scopes the request.
@@ -514,6 +529,17 @@ class _Handler(BaseHTTPRequestHandler):
         self._metric_resource = resource
         name = rest[1] if len(rest) > 1 else ""
         subresource = rest[2] if len(rest) > 2 else ""
+
+        if batch_bind:
+            if resource != "bindings" or name or watching:
+                raise errors.new_bad_request(
+                    "the :batch suffix applies to POST .../bindings:batch")
+            self._metric_resource = "bindings:batch"
+            if method != "POST":
+                raise errors.new_method_not_supported("bindings:batch",
+                                                      method)
+            return self._handle_batch_bind(version, namespace, raw_body,
+                                           user)
 
         label_sel = query.get("labelSelector", query.get("labels", ""))
         field_sel = query.get("fieldSelector", query.get("fields", ""))
@@ -530,11 +556,12 @@ class _Handler(BaseHTTPRequestHandler):
                 raise errors.new_bad_request("watch requires GET")
             if name:  # single-object watch scopes by name
                 field_sel = f"metadata.name={name}"
-            watcher = apisrv.master.dispatch(
-                "watch", resource, namespace=namespace,
+            watcher, translate = apisrv.master.dispatch(
+                "watch_raw", resource, namespace=namespace,
                 label_selector=label_sel, field_selector=field_sel,
-                resource_version=rv, user=user)
-            self._stream_watch(watcher, version)
+                resource_version=rv, user=user,
+                lag_limit=apisrv.watch_lag_limit)
+            self._stream_watch(watcher, translate, version)
             return 200
 
         body_obj = None
@@ -560,8 +587,44 @@ class _Handler(BaseHTTPRequestHandler):
             ok = api.Status(status=api.StatusSuccess, code=code)
             self._send_json(code, apisrv.scheme.encode(ok, version))
         else:
-            self._send_json(code, apisrv.scheme.encode(out, version))
+            # encode_response seeds the watch frame cache with this very
+            # payload: the fan-out of the store event this write produced
+            # then copies bytes instead of encoding again
+            self._send_json(code, apisrv.encode_response(out, version))
         return code
+
+    def _handle_batch_bind(self, version: str, namespace: str,
+                           raw_body: bytes, user) -> int:
+        """POST .../bindings:batch — one scheduler wave of CAS binds in
+        ONE keep-alive request (the bind_many seam's wire form). Body:
+        BindingList; response: 200 BindingResultList with per-item
+        status/code — partial success, per-pod CAS semantics identical
+        to POST pods/{name}/binding."""
+        apisrv = self.server.api  # type: ignore[attr-defined]
+        started = time.monotonic()
+        if not raw_body:
+            raise errors.new_bad_request(
+                "bindings:batch requires a BindingList body")
+        try:
+            body = apisrv.scheme.decode(raw_body, default_version=version)
+        except Exception as e:
+            raise errors.new_bad_request(f"cannot decode body: {e}")
+        if isinstance(body, api.Binding):
+            body = api.BindingList(items=[body])
+        if not isinstance(body, api.BindingList):
+            raise errors.new_bad_request(
+                "bindings:batch body must be a BindingList")
+        out = apisrv.master.bind_batch(
+            namespace or api.NamespaceDefault, body, user=user,
+            # encode-once at commit: each bound pod's new revision is
+            # serialized here, where the write lands, so the watch fan-out
+            # of its CAS event is a byte copy for every watcher
+            on_bound=lambda pod: apisrv.seed_frame(pod, version))
+        payload = apisrv.scheme.encode(out, version)
+        apisrv.metric_batch_bind_size.observe(len(body.items))
+        apisrv.metric_batch_bind_seconds.observe(time.monotonic() - started)
+        self._send_json(200, payload)
+        return 200
 
     def _handle_patch(self, version, resource, namespace, name, subresource,
                       raw: bytes, user) -> int:
@@ -615,11 +678,51 @@ class _Handler(BaseHTTPRequestHandler):
         self._send_text(200, body)
         return 200
 
-    def _stream_watch(self, watcher: watchpkg.Watcher, version: str):
+    def _translate_batch(self, batch, translate, version, ws_frames: bool):
+        """Map one drained batch of raw store events to wire byte parts.
+        Returns (parts, lagged): ``lagged`` means the bounded-lag resync
+        marker was hit — its 410 ERROR frame is the last part and the
+        stream must end. The encode (if any) happens here exactly once
+        per (revision, version); every other watcher of the same event
+        copies cached bytes."""
+        apisrv = self.server.api  # type: ignore[attr-defined]
+        idx = 2 if ws_frames else 1
+        parts = []
+        for ev in batch:
+            if ev.type == watchpkg.ERROR and ev.object is None:
+                # bounded-lag drop-to-resync marker from the store layer
+                parts.append(apisrv.lag_resync_entry(version)[idx])
+                apisrv.metric_watch_lag_drops.inc()
+                return parts, True
+            try:
+                tev = translate(ev)
+                if tev is None:
+                    continue
+                if isinstance(tev, tuple):  # fast path: (type, rv, thunk)
+                    ev_type, rv, thunk = tev
+                    parts.append(
+                        apisrv.frame_entry(ev_type, thunk, version,
+                                           rv=rv)[idx])
+                else:
+                    parts.append(apisrv.frame_entry(tev.type, tev.object,
+                                                    version)[idx])
+            except Exception as e:  # undecodable payload: surface, keep going
+                parts.append(apisrv.frame_entry(
+                    watchpkg.ERROR,
+                    errors.new_internal_error(str(e)).status, version)[idx])
+        return parts, False
+
+    def _stream_watch(self, watcher: watchpkg.Watcher, translate, version: str):
+        """Chunked-JSON watch stream as a byte WRITER: this connection's
+        thread drains raw store events in batches, maps them through the
+        shared frame-bytes cache, and writes each batch with ONE send —
+        no per-watcher pump thread, no per-watcher encode, one syscall
+        per batch instead of four per event
+        (ref: pkg/apiserver/watch.go:62-142)."""
         from kubernetes_tpu.util import websocket as ws
 
         if ws.wants_websocket(self.headers):
-            return self._stream_watch_websocket(watcher, version)
+            return self._stream_watch_websocket(watcher, translate, version)
         apisrv = self.server.api  # type: ignore[attr-defined]
         apisrv.track_watcher(watcher)
         self.send_response(200)
@@ -627,9 +730,21 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Transfer-Encoding", "chunked")
         self.end_headers()
         try:
-            for ev in watcher:
-                frame = apisrv.event_frame(ev, version)
-                self._write_chunk(frame.encode("utf-8") + b"\n")
+            lagged = False
+            while not lagged:
+                batch = watcher.next_batch(
+                    linger=apisrv.watch_write_linger)
+                if batch is None:
+                    break
+                t0 = time.monotonic()
+                parts, lagged = self._translate_batch(batch, translate,
+                                                      version, ws_frames=False)
+                if parts:
+                    apisrv.metric_fanout_frames.observe(len(parts))
+                    self.wfile.write(b"".join(parts))
+                    self.wfile.flush()
+                    apisrv.metric_fanout_seconds.observe(
+                        time.monotonic() - t0)
             self.wfile.write(b"0\r\n\r\n")
             self.wfile.flush()
         except (BrokenPipeError, ConnectionResetError, socket.timeout):
@@ -639,9 +754,10 @@ class _Handler(BaseHTTPRequestHandler):
             apisrv.untrack_watcher(watcher)
             self.close_connection = True
 
-    def _stream_watch_websocket(self, watcher: watchpkg.Watcher,
+    def _stream_watch_websocket(self, watcher: watchpkg.Watcher, translate,
                                 version: str):
-        """Watch events as WebSocket text frames, one event per message
+        """Watch events as WebSocket text frames, one event per message,
+        batches of cached frame bytes per send like the chunked variant
         (ref: pkg/apiserver/watch.go:62-126 — the websocket variant the
         reference serves alongside chunked JSON, negotiated by Upgrade)."""
         from kubernetes_tpu.util import websocket as ws
@@ -678,10 +794,22 @@ class _Handler(BaseHTTPRequestHandler):
         threading.Thread(target=reader, daemon=True,
                          name="ws-watch-reader").start()
         try:
-            for ev in watcher:
-                frame = apisrv.event_frame(ev, version)
-                with wlock:
-                    ws.send_text(self.wfile, frame.encode("utf-8"))
+            lagged = False
+            while not lagged:
+                batch = watcher.next_batch(
+                    linger=apisrv.watch_write_linger)
+                if batch is None:
+                    break
+                t0 = time.monotonic()
+                parts, lagged = self._translate_batch(batch, translate,
+                                                      version, ws_frames=True)
+                if parts:
+                    apisrv.metric_fanout_frames.observe(len(parts))
+                    with wlock:
+                        self.wfile.write(b"".join(parts))
+                        self.wfile.flush()
+                    apisrv.metric_fanout_seconds.observe(
+                        time.monotonic() - t0)
             with wlock:
                 ws.send_close(self.wfile)
         except (BrokenPipeError, ConnectionResetError, socket.timeout):
@@ -755,8 +883,24 @@ class APIServer:
                  metrics_registry: Optional[metrics_pkg.Registry] = None,
                  node_locator=None, kubelet_port: int = 10250,
                  reuse_port: bool = False, cors_allowed_origins=(),
-                 read_only: bool = False, rate_limiter=None):
+                 read_only: bool = False, rate_limiter=None,
+                 watch_lag_limit: int = 65536):
         self.master = master
+        # per-HTTP-watcher queue bound: past it, modify events coalesce and
+        # anything uncoalescible drops the watcher to resync (410 ERROR
+        # frame + end-of-stream; the client re-lists). 0/None disables.
+        # The queue holds shared StoreEvent references (bytes are only
+        # rendered at write time), so the default is sized as a
+        # stuck-watcher safety valve, NOT burst shedding: a commit wave
+        # fanning thousands of events at a busy-but-draining consumer
+        # (the scheduler's own reflectors) must ride the queue, while a
+        # watcher minutes behind gets the 410 and re-lists.
+        self.watch_lag_limit = watch_lag_limit or None
+        # fan-out write linger: accumulate this long after a batch's
+        # first event before draining+writing, so a steady event stream
+        # costs each watcher one wakeup and one syscall per BATCH, not
+        # per event (see Watcher.next_batch)
+        self.watch_write_linger = 0.004
         # CORS origin allow-list, each entry a regex (ref: handlers.go CORS
         # + --cors_allowed_origins; empty list = CORS disabled)
         self.cors_patterns = [re.compile(p) for p in cors_allowed_origins]
@@ -780,16 +924,62 @@ class APIServer:
         self.metric_latency = self.metrics_registry.histogram(
             "apiserver_request_latencies_seconds", "Request latency",
             ("verb", "resource"), buckets=metrics_pkg.APISERVER_BUCKETS)
+        # the apiserver hot-path family (docs/design/apiserver-hotpath.md):
+        # frame-cache effectiveness, fan-out write batching, lag drops,
+        # and the batch-bind endpoint's size/latency envelope
+        self.metric_frame_hits = self.metrics_registry.counter(
+            "apiserver_watch_frame_cache_hits_total",
+            "Watch frame deliveries served from cached bytes "
+            "(no object encode)")
+        self.metric_frame_misses = self.metrics_registry.counter(
+            "apiserver_watch_frame_cache_misses_total",
+            "Watch frame deliveries that had to encode the object")
+        self.metric_frame_seeds = self.metrics_registry.counter(
+            "apiserver_watch_frame_seeds_total",
+            "Frame-cache entries seeded by the write path "
+            "(encode-once at commit)")
+        self.metric_watch_lag_drops = self.metrics_registry.counter(
+            "apiserver_watch_lag_drops_total",
+            "Watch streams dropped to resync (410 ERROR frame) after "
+            "exceeding the lag bound")
+        self.metric_fanout_seconds = self.metrics_registry.histogram(
+            "apiserver_watch_fanout_seconds",
+            "Translate+write time per fan-out batch to one watcher",
+            buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                     0.025, 0.05, 0.1, 0.25, 1.0))
+        self.metric_fanout_frames = self.metrics_registry.histogram(
+            "apiserver_watch_write_frames",
+            "Frames per fan-out write (write-coalescing depth)",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128))
+        self.metric_batch_bind_size = self.metrics_registry.histogram(
+            "apiserver_batch_bind_size",
+            "Bindings per bindings:batch request",
+            buckets=(1, 4, 16, 64, 256, 1024, 4096))
+        self.metric_batch_bind_seconds = self.metrics_registry.histogram(
+            "apiserver_batch_bind_seconds",
+            "bindings:batch handler latency",
+            buckets=metrics_pkg.DEFAULT_BUCKETS)
         self._watchers: set = set()
         self._watch_lock = threading.Lock()
-        # (resourceVersion, event type, wire version) -> encoded frame.
-        # Each watcher runs its own decode pump, so several watchers of one
-        # resource would otherwise re-encode every event; the store's
-        # modified_index is globally unique per revision, making it a safe
-        # fan-out-wide cache key (the encode analog of StoreHelper's
-        # decode cache). Bounded FIFO.
+        # Encode-once fan-out caches (one lock guards both):
+        #  _wire_cache:  (resourceVersion, wire version) -> the object's
+        #      wire JSON string. The store's modified_index is globally
+        #      unique per revision (and list responses never seed or
+        #      fetch), making it a safe fan-out-wide key — the encode
+        #      analog of StoreHelper's decode cache. Seeded by the write
+        #      path (create/update responses, batch-bind commits) so the
+        #      fan-out usually never encodes at all.
+        #  _frame_cache: (resourceVersion, event type, wire version) ->
+        #      (frame json str, chunked-transfer bytes, websocket frame
+        #      bytes) assembled from the wire JSON — every watcher of any
+        #      transport writes the same bytes. Both bounded FIFO.
+        self._wire_cache: "OrderedDict" = OrderedDict()
         self._frame_cache: "OrderedDict" = OrderedDict()
         self._frame_lock = threading.Lock()
+        # (rv, version) -> Event: one fan-out thread encodes a revision,
+        # concurrent watchers of the same event wait for its bytes
+        # instead of burning the GIL on duplicate encodes
+        self._encode_inflight: Dict[tuple, threading.Event] = {}
         self._httpd = ThreadingHTTPServer((host, port), _Handler,
                                           bind_and_activate=False)
         self._httpd.daemon_threads = True
@@ -846,42 +1036,180 @@ class APIServer:
         except Exception:
             return False
 
-    _FRAME_CACHE_MAX = 4096
+    # Sized for the lag depth the watch queues allow, not just the event
+    # rate: a watcher thousands of events behind must still find the
+    # bytes of the revisions it is draining, or every lagging stream
+    # re-encodes history (an 8192-entry first cut churned exactly that
+    # way at full shape). Entries are shared strings/bytes, ~1-3 KB each.
+    _FRAME_CACHE_MAX = 32768
+    _WIRE_CACHE_MAX = 65536
+
+    @staticmethod
+    def _rv_of(obj) -> str:
+        from kubernetes_tpu.api.meta import accessor
+
+        kind = getattr(obj, "kind", "") or type(obj).__name__
+        if kind.endswith("List"):
+            # a list's resourceVersion is a store INDEX, which an object's
+            # modified_index can equal — lists never seed or fetch
+            return ""
+        try:
+            return accessor.resource_version(obj)
+        except Exception:
+            return ""
+
+    def seed_frame(self, obj, version: str, wire_json: str = "") -> None:
+        """Seed the wire cache with one object's encoding — called by the
+        WRITE path (create/update responses, batch-bind commits), where
+        the bytes are being produced anyway, so the watch fan-out of the
+        resulting store event is a pure byte copy (the 'serialize exactly
+        once per (resourceVersion, api version)' contract)."""
+        rv = self._rv_of(obj)
+        if not rv:
+            return
+        key = (rv, version)
+        with self._frame_lock:
+            if key in self._wire_cache:
+                return
+        if not wire_json:
+            try:
+                wire_json = self.scheme.encode(obj, version)
+            except Exception:
+                return
+        self.metric_frame_seeds.inc()
+        with self._frame_lock:
+            self._wire_cache[key] = wire_json
+            while len(self._wire_cache) > self._WIRE_CACHE_MAX:
+                self._wire_cache.popitem(last=False)
+            waiter = self._encode_inflight.pop(key, None)
+        if waiter is not None:
+            waiter.set()  # wake fan-out threads parked on this revision
+
+    def encode_response(self, obj, version: str) -> str:
+        """Encode a dispatch result for its HTTP response AND seed the
+        frame cache with it (single objects only — see seed_frame)."""
+        payload = self.scheme.encode(obj, version)
+        self.seed_frame(obj, version, wire_json=payload)
+        return payload
+
+    @staticmethod
+    def _assemble(ev_type: str, obj_json: str):
+        """(frame json, chunked bytes, ws frame bytes) for one event —
+        pure string/byte assembly, no codec work."""
+        from kubernetes_tpu.util import websocket as ws
+
+        frame = '{"type": "%s", "object": %s}' % (ev_type, obj_json)
+        payload = frame.encode("utf-8")
+        body = payload + b"\n"
+        chunk = ("%x\r\n" % len(body)).encode("ascii") + body + b"\r\n"
+        return frame, chunk, ws.text_frame(payload)
+
+    _ENCODE_FALLBACK = ('{"kind": "Status", "status": "Failure", '
+                        '"message": "encode error"}')
+
+    def frame_entry(self, ev_type: str, obj, version: str,
+                    rv: Optional[str] = None):
+        """(frame json, chunked bytes, ws frame bytes) for one watch
+        event, encoded at most once per (object revision, wire version)
+        across every watcher and transport (ref: the reference encodes
+        per watch connection, pkg/apiserver/watch.go:66 — here the encode
+        is the fan-out hot path, so it is deduplicated). Concurrent
+        watchers of one event rendezvous on an in-flight marker: one
+        encodes, the rest wait for its bytes.
+
+        ``obj`` may be a zero-arg thunk (the fast translate path passes
+        ``rv`` explicitly and defers the decode): it is only called when
+        the caches miss — a cache-hit delivery touches no codec."""
+        lazy = callable(obj) and rv is not None
+        if rv is None:
+            rv = self._rv_of(obj)
+        if not rv:
+            # uncacheable payloads (Status objects in ERROR frames)
+            try:
+                return self._assemble(ev_type,
+                                      self.scheme.encode(obj, version))
+            except Exception:
+                return self._assemble(ev_type, self._ENCODE_FALLBACK)
+        fkey = (rv, ev_type, version)
+        wkey = (rv, version)
+        with self._frame_lock:
+            entry = self._frame_cache.get(fkey)
+            if entry is not None:
+                self.metric_frame_hits.inc()
+                return entry
+            obj_json = self._wire_cache.get(wkey)
+            waiter = leader = None
+            if obj_json is None:
+                waiter = self._encode_inflight.get(wkey)
+                if waiter is None:
+                    leader = threading.Event()
+                    self._encode_inflight[wkey] = leader
+        if obj_json is None and waiter is not None:
+            waiter.wait(timeout=2.0)
+            with self._frame_lock:
+                obj_json = self._wire_cache.get(wkey)
+        if obj_json is None:
+            if lazy:
+                try:
+                    obj = obj()
+                except Exception:
+                    # a DECODE failure must surface as an ERROR frame (the
+                    # caller's contract), never as a typed frame wrapping a
+                    # Status — release any waiters first
+                    if leader is not None:
+                        with self._frame_lock:
+                            self._encode_inflight.pop(wkey, None)
+                        leader.set()
+                    raise
+            try:
+                obj_json = self.scheme.encode(obj, version)
+            except Exception:
+                # never cache the fallback: a transient encode failure must
+                # not poison this revision for later watchers
+                if leader is not None:
+                    with self._frame_lock:
+                        self._encode_inflight.pop(wkey, None)
+                    leader.set()
+                return self._assemble(ev_type, self._ENCODE_FALLBACK)
+            self.metric_frame_misses.inc()
+            with self._frame_lock:
+                self._wire_cache[wkey] = obj_json
+                while len(self._wire_cache) > self._WIRE_CACHE_MAX:
+                    self._wire_cache.popitem(last=False)
+        else:
+            # assembled from cached/seeded wire JSON: the encode was avoided
+            self.metric_frame_hits.inc()
+        if leader is not None:
+            with self._frame_lock:
+                self._encode_inflight.pop(wkey, None)
+            leader.set()
+        entry = self._assemble(ev_type, obj_json)
+        with self._frame_lock:
+            self._frame_cache[fkey] = entry
+            while len(self._frame_cache) > self._FRAME_CACHE_MAX:
+                self._frame_cache.popitem(last=False)
+        return entry
 
     def event_frame(self, ev, version: str) -> str:
         """One JSON watch frame per (object revision, event type, wire
-        version), shared across all watchers (ref: the reference encodes
-        per watch connection, pkg/apiserver/watch.go:66 — here the encode
-        is the fan-out hot path, so it is deduplicated)."""
-        from kubernetes_tpu.api.meta import accessor
+        version), shared across all watchers."""
+        return self.frame_entry(ev.type, ev.object, version)[0]
 
-        rv = ""
-        try:
-            rv = accessor.resource_version(ev.object)
-        except Exception:
-            pass
-        key = (rv, ev.type, version) if rv else None
-        if key is not None:
+    _LAG_STATUS = ('{"kind": "Status", "apiVersion": "%s", '
+                   '"status": "Failure", "reason": "Expired", "code": 410, '
+                   '"message": "watch lag bound exceeded; re-list required"}')
+
+    def lag_resync_entry(self, version: str):
+        """The bookmark-style drop-to-resync marker: a 410 Expired Status
+        ERROR frame (pre-assembled per version)."""
+        key = ("", "ERROR", version)
+        with self._frame_lock:
+            entry = self._frame_cache.get(key)
+        if entry is None:
+            entry = self._assemble("ERROR", self._LAG_STATUS % version)
             with self._frame_lock:
-                frame = self._frame_cache.get(key)
-            if frame is not None:
-                return frame
-        try:
-            obj_wire = self.scheme.encode_to_wire(ev.object, version)
-        except Exception:
-            # never cache the fallback: a transient encode failure must not
-            # poison this revision for later watchers
-            return json.dumps({"type": ev.type,
-                               "object": {"kind": "Status",
-                                          "status": "Failure",
-                                          "message": "encode error"}})
-        frame = json.dumps({"type": ev.type, "object": obj_wire})
-        if key is not None:
-            with self._frame_lock:
-                self._frame_cache[key] = frame
-                while len(self._frame_cache) > self._FRAME_CACHE_MAX:
-                    self._frame_cache.popitem(last=False)
-        return frame
+                self._frame_cache[key] = entry
+        return entry
 
     def track_watcher(self, w) -> None:
         with self._watch_lock:

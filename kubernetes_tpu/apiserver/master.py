@@ -177,10 +177,29 @@ class Master:
         if self.authorizer is not None:
             self.authorizer.authorize(user, attrs)
 
+    def bind_batch(self, namespace: str, bindings: api.BindingList,
+                   user: Any = None,
+                   on_bound: Optional[Any] = None) -> api.BindingResultList:
+        """POST /api/{v}/ns/{ns}/bindings:batch — one wave of CAS binds in
+        one request. Authorization and admission run ONCE against the
+        request namespace (the same checks the per-pod bind path runs per
+        binding — every item is namespace-pinned to the request by
+        BindingREST.create_many, so nothing escapes the single check);
+        per-item CAS semantics and partial success are preserved by
+        create_many/atomic_update_many."""
+        ctx = Context(namespace=namespace, user=user)
+        attrs = admission_pkg.Attributes(
+            operation=admission_pkg.CREATE, resource="bindings",
+            namespace=namespace, obj=bindings, user=user)
+        self._authorize(user, attrs)
+        self.admission.admit(attrs)
+        return self.bindings.create_many(ctx, bindings, on_bound=on_bound)
+
     def dispatch(self, verb: str, resource: str, *, namespace: str = "",
                  name: str = "", body: Any = None, subresource: str = "",
                  label_selector: str = "", field_selector: str = "",
-                 resource_version: str = "", user: Any = None) -> Any:
+                 resource_version: str = "", user: Any = None,
+                 lag_limit: Optional[int] = None) -> Any:
         """The generic REST entry (ref: resthandler.go Get/List/Create/Update/
         Delete/Watch Resource). Verbs: get, list, create, update, delete,
         watch. Returns API objects, or a watch.Watcher for watch."""
@@ -220,6 +239,23 @@ class Master:
             return registry.watch(ctx, parse_selector(label_selector),
                                   parse_field_selector(field_selector),
                                   resource_version=resource_version)
+        if verb == "watch_raw":
+            # the HTTP fan-out path (apiserver/http._stream_watch): raw
+            # store events + a translate callable, driven by the
+            # connection's own thread — see GenericRegistry.watch_raw
+            self._authorize(user, attrs)
+            raw_fn = getattr(registry, "watch_raw", None)
+            if raw_fn is None:
+                # non-generic storage (e.g. bindings): the plain watch verb
+                # carries the 405/behavior contract; identity-translate
+                w = registry.watch(ctx, parse_selector(label_selector),
+                                   parse_field_selector(field_selector),
+                                   resource_version=resource_version)
+                return w, (lambda ev: ev)
+            return raw_fn(ctx, parse_selector(label_selector),
+                          parse_field_selector(field_selector),
+                          resource_version=resource_version,
+                          lag_limit=lag_limit)
         if verb == "create":
             attrs.operation = admission_pkg.CREATE
             attrs.name = getattr(getattr(body, "metadata", None), "name", name)

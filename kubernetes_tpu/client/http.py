@@ -122,7 +122,10 @@ class HTTPTransport:
             parts.append(name)
         if subresource:
             parts.append(subresource)
-        url = self.base_url + "/" + "/".join(urllib.parse.quote(p) for p in parts)
+        # ':' stays literal (RFC 3986 pchar) — the bindings:batch verb
+        # suffix must reach the server unescaped
+        url = self.base_url + "/" + "/".join(
+            urllib.parse.quote(p, safe=":") for p in parts)
         q = {k: v for k, v in query.items() if v}
         if q:
             url += "?" + urllib.parse.urlencode(q)
@@ -278,6 +281,13 @@ class HTTPTransport:
             url = self._url(resource, namespace, name, subresource, query,
                             watching=True)
             return self._start_watch(url)
+
+        if verb == "create" and resource == "bindings" \
+                and isinstance(body, api.BindingList):
+            # the bind_many seam over the wire: one keep-alive POST to the
+            # batch endpoint commits a whole wave (per-item results;
+            # per-pod CAS semantics preserved server-side)
+            resource = "bindings:batch"
 
         method = {"get": "GET", "list": "GET", "create": "POST",
                   "update": "PUT", "delete": "DELETE", "patch": "PATCH"}[verb]

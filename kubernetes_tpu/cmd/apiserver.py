@@ -62,6 +62,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--reuse-port", "--reuse_port", action="store_true",
                    help="bind with SO_REUSEPORT so several apiserver "
                         "worker processes share one listen port")
+    p.add_argument("--watch-lag-limit", "--watch_lag_limit", type=int,
+                   default=65536,
+                   help="per-watch-connection event queue bound: a "
+                        "watcher lagging past it is dropped to resync "
+                        "(410 ERROR frame; the client re-lists). "
+                        "0 disables.")
     return p
 
 
@@ -116,7 +122,8 @@ def build_server(opts, ready_event: Optional[threading.Event] = None):
                     authenticator=authenticator,
                     kubelet_port=opts.kubelet_port,
                     reuse_port=getattr(opts, "reuse_port", False),
-                    cors_allowed_origins=cors)
+                    cors_allowed_origins=cors,
+                    watch_lag_limit=getattr(opts, "watch_lag_limit", 65536))
     ro_port = getattr(opts, "read_only_port", 0)
     if ro_port:
         # the kubernetes-ro companion (ref: cmd server.go:267-276):

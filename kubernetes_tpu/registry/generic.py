@@ -15,6 +15,7 @@ import random
 import string
 import threading
 import uuid
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple, Type
 
@@ -101,6 +102,12 @@ class GenericRegistry:
         self.attr_func = attr_func
         self.ttl_func = ttl_func
         self.kind = strategy.kind
+        # (namespace, name, resourceVersion) -> attr_func result. A stored
+        # revision's selectable attributes are immutable, and watch fan-out
+        # evaluates every watcher's selector against the same revision —
+        # N watchers pay one attr build instead of N. Bounded FIFO.
+        self._attr_cache: "OrderedDict" = OrderedDict()
+        self._attr_lock = threading.Lock()
 
     # -- keys ---------------------------------------------------------------
     def key_root(self, ctx: Context) -> str:
@@ -199,10 +206,52 @@ class GenericRegistry:
             self.key_root(ctx), resource_version=resource_version,
             filter_fn=lambda o: self._matches(o, label_selector, field_selector))
 
+    def watch_raw(self, ctx: Context,
+                  label_selector: Optional[Selector] = None,
+                  field_selector: Optional[FieldSelector] = None,
+                  resource_version: str = "",
+                  lag_limit: Optional[int] = None):
+        """Raw watch + translate for the HTTP fan-out path: returns
+        ``(watcher, translate)`` where ``watcher`` streams StoreEvents on a
+        bounded queue and ``translate(ev)`` maps one to the API-level watch
+        Event (None = filtered out) via the shared decode/attr caches. The
+        caller's own thread drives translation — no per-watcher pump."""
+        if label_selector is not None and label_selector.empty():
+            label_selector = None
+        if field_selector is not None and not field_selector.requirements:
+            field_selector = None
+        raw = self.helper.watch_raw(self.key_root(ctx), resource_version,
+                                    lag_limit=lag_limit)
+        if label_selector is None and field_selector is None:
+            # unfiltered watchers (the wide-fan-out population) take the
+            # decode-free fast path: (type, rv, obj_thunk) tuples
+            return raw, self.helper.translate_event_fast
+        filt = lambda o: self._matches(o, label_selector, field_selector)
+        return raw, (lambda ev: self.helper.translate_event(ev, filt))
+
     # -- selection ----------------------------------------------------------
+    _ATTR_CACHE_MAX = 8192
+
+    def _attrs(self, obj: Any) -> Tuple[Dict[str, str], Dict[str, str]]:
+        m = getattr(obj, "metadata", None)
+        rv = getattr(m, "resource_version", "") if m is not None else ""
+        name = getattr(m, "name", "") if m is not None else ""
+        if not rv or not name:
+            return self.attr_func(obj)
+        key = (getattr(m, "namespace", ""), name, rv)
+        with self._attr_lock:
+            got = self._attr_cache.get(key)
+        if got is None:
+            got = self.attr_func(obj)
+            with self._attr_lock:
+                self._attr_cache[key] = got
+                while len(self._attr_cache) > self._ATTR_CACHE_MAX:
+                    self._attr_cache.popitem(last=False)
+        return got
+
     def _matches(self, obj: Any, label_selector: Optional[Selector],
                  field_selector: Optional[FieldSelector]) -> bool:
-        lbls, flds = self.attr_func(obj)
+        lbls, flds = self._attrs(obj)
         if label_selector is not None and not label_selector.matches(lbls):
             return False
         if field_selector is not None and not field_selector.matches(flds):

@@ -111,14 +111,19 @@ class BindingREST:
                                        self._assign_fn(name, binding.host))
         return api.Status(status=api.StatusSuccess)
 
-    def create_many(self, ctx: Context,
-                    bindings: api.BindingList) -> api.BindingResultList:
+    def create_many(self, ctx: Context, bindings: api.BindingList,
+                    on_bound=None) -> api.BindingResultList:
         """One transactional store pass for a whole wave's bindings (the
         batched form of the CAS bind; see api.BindingList). Every item is
         scoped to the REQUEST namespace — authorization and admission ran
         against that namespace only, so an item naming another namespace
         is rejected per-item rather than silently escaping the checks
-        (callers batch per namespace; the scheduler does)."""
+        (callers batch per namespace; the scheduler does).
+
+        ``on_bound`` (optional) is called with each successfully bound
+        pod (its committed post-bind revision) — the apiserver's
+        encode-once seam: the HTTP layer serializes the revision here,
+        at commit, so fanning its watch event out is a byte copy."""
         updates = []
         results = [api.BindingResult() for _ in bindings.items]
         slot_map = []
@@ -143,6 +148,11 @@ class BindingREST:
             if isinstance(oc, errors.StatusError):
                 results[i].error = oc.status.message
                 results[i].code = oc.status.code
+            elif on_bound is not None:
+                try:
+                    on_bound(oc)
+                except Exception:
+                    pass  # seeding is best-effort, never fails a bind
         return api.BindingResultList(items=results)
 
     # only create is implemented; the storage map exposure must answer the

@@ -106,6 +106,11 @@ class _WaveMetrics:
         self.resyncs = reg.counter(
             "scheduler_wave_encode_resyncs_total",
             "Full-list encoder syncs (vs O(changed) delta waves)")
+        self.bind_fallback = reg.counter(
+            "scheduler_bind_fallback_total",
+            "Waves committed via per-pod binder.bind because the binder "
+            "lacks the bind_many seam (a mis-wired live stack pays one "
+            "HTTP round-trip per pod)")
 
 
 def _wave_metrics() -> _WaveMetrics:
@@ -435,6 +440,14 @@ class BatchScheduler:
                     for i in idxs:
                         outcomes[i] = e
         else:  # custom binder without the batch seam: reference behavior
+            _wave_metrics().bind_fallback.inc()
+            if not getattr(self, "_warned_bind_fallback", False):
+                self._warned_bind_fallback = True
+                _log.warning(
+                    "binder %s has no bind_many: committing waves one "
+                    "bind round-trip per pod (scheduler_bind_fallback_"
+                    "total counts affected waves)",
+                    type(c.binder).__name__)
             for idx, (pod, host) in enumerate(placed):
                 try:
                     c.binder.bind(mk_binding(pod, host))

@@ -61,7 +61,13 @@ class StoreHelper:
     # get-mutate-set idiom is legitimate there and they are off the churn
     # hot path. atomic_update isolates before calling update_fn; the
     # DELETED-event resourceVersion rewrite clones explicitly.
-    _DECODE_CACHE_MAX = 8192
+    #
+    # Sized to hold a full-shape churn working set (50k pods): at 8192 a
+    # pod created early in the run was evicted by the time its bind
+    # committed, so every batched bind paid a cold decode + the bind
+    # event's prev_kv decode — two full codec passes back on the hot
+    # path the cache exists to remove.
+    _DECODE_CACHE_MAX = 65536
 
     def __init__(self, store: MemStore, scheme):
         self.store = store
@@ -250,56 +256,122 @@ class StoreHelper:
         return results
 
     # -- watch --------------------------------------------------------------
-    def watch(self, prefix: str, resource_version: str = "",
-              filter_fn: Optional[Callable[[Any], bool]] = None,
-              recursive: bool = True) -> watchpkg.Watcher:
-        """Decoded object watch (ref: etcd_helper_watch.go:64-95 WatchList).
-
-        Store events become ADDED/MODIFIED/DELETED watch.Events carrying API
-        objects. ``filter_fn`` implements label/field selection; like the
-        reference's etcdWatcher filter, an object transitioning out of the
-        filter emits DELETED and into it emits ADDED.
-        """
+    def watch_raw(self, prefix: str, resource_version: str = "",
+                  recursive: bool = True,
+                  lag_limit: Optional[int] = None) -> watchpkg.Watcher:
+        """Raw StoreEvent watch — the encode-once fan-out seam. The HTTP
+        layer pulls StoreEvents on its OWN connection thread and maps each
+        through translate_event + the apiserver's frame-bytes cache, so
+        fanning one store mutation to N watchers costs one decode + one
+        encode total instead of a pump thread and a re-encode per watcher.
+        ``lag_limit`` bounds the per-watcher queue (see MemStore.watch)."""
         from_index = parse_watch_resource_version(resource_version)
         try:
-            src = self.store.watch(prefix, from_index=from_index, recursive=recursive)
+            return self.store.watch(prefix, from_index=from_index,
+                                    recursive=recursive, lag_limit=lag_limit)
         except ErrIndexOutdated as e:
             # Surface as an API-level 410 so clients above the store boundary
             # (Reflector, HTTP clients) share one expired-watch contract.
             raise errors.new_expired(str(e))
+
+    def translate_event_fast(self, ev: watchpkg.Event):
+        """Unfiltered translate: ``(event type, resourceVersion, obj_thunk)``
+        with NO decode at all — the event type falls out of the store
+        action, the resourceVersion out of the store index, and the
+        object is only materialized (via the shared decode cache) if the
+        apiserver's frame cache actually misses. This is the observer
+        fan-out fast path: a cache-hit delivery touches no codec."""
+        sev = ev.object
+        a = sev.action
+        if a == "create":
+            return (watchpkg.ADDED, str(sev.kv.modified_index),
+                    lambda: self._decode(sev.kv))
+        if a in ("set", "compareAndSwap"):
+            t = watchpkg.MODIFIED if sev.prev_kv is not None else watchpkg.ADDED
+            return (t, str(sev.kv.modified_index),
+                    lambda: self._decode(sev.kv))
+        if a in ("delete", "expire"):
+            if sev.prev_kv is None:
+                return None
+
+            def thunk():
+                prev_out = deep_clone(self._decode(sev.prev_kv))
+                # deleted object carries the deletion resourceVersion
+                accessor.set_resource_version(prev_out, str(sev.index))
+                return prev_out
+
+            return (watchpkg.DELETED, str(sev.index), thunk)
+        return None
+
+    def translate_event(self, ev: watchpkg.Event,
+                        filter_fn: Optional[Callable[[Any], bool]] = None
+                        ) -> Optional[watchpkg.Event]:
+        """Map one raw store Event to its API-level watch Event, or None
+        when the object is outside ``filter_fn``. Factored from the watch
+        pump so the HTTP byte-writer path and the threaded pump share one
+        translation (and one decode cache). Like the reference's
+        etcdWatcher filter, an object transitioning out of the filter
+        emits DELETED and into it emits ADDED. Raises on undecodable
+        payloads — callers surface an ERROR event and keep going."""
+        sev = ev.object
+        cur = self._decode(sev.kv) if sev.kv else None
+        prev = self._decode(sev.prev_kv) if sev.prev_kv else None
+        cur_ok = cur is not None and (filter_fn is None or filter_fn(cur))
+        prev_ok = prev is not None and (filter_fn is None or filter_fn(prev))
+        if sev.action in ("create",):
+            if cur_ok:
+                return watchpkg.Event(watchpkg.ADDED, cur)
+        elif sev.action in ("set", "compareAndSwap"):
+            if cur_ok and prev_ok:
+                return watchpkg.Event(watchpkg.MODIFIED, cur)
+            if cur_ok:
+                return watchpkg.Event(watchpkg.ADDED, cur)
+            if prev_ok:
+                # fell out of the filter: deliver the *new* state like
+                # the reference (etcd_helper_watch.go sendModify)
+                return watchpkg.Event(watchpkg.DELETED, cur)
+        elif sev.action in ("delete", "expire"):
+            if prev_ok:
+                # clone: the deletion-rv rewrite below must not
+                # mutate the shared cached revision
+                prev_out = deep_clone(prev)
+                # deleted object carries the deletion resourceVersion
+                accessor.set_resource_version(prev_out, str(sev.index))
+                return watchpkg.Event(watchpkg.DELETED, prev_out)
+        return None
+
+    def watch(self, prefix: str, resource_version: str = "",
+              filter_fn: Optional[Callable[[Any], bool]] = None,
+              recursive: bool = True,
+              lag_limit: Optional[int] = None) -> watchpkg.Watcher:
+        """Decoded object watch (ref: etcd_helper_watch.go:64-95 WatchList).
+
+        Store events become ADDED/MODIFIED/DELETED watch.Events carrying API
+        objects (translate_event). A bounded watcher that lags out delivers
+        one ERROR Event carrying a 410 Expired Status, then ends — the
+        Reflector re-lists.
+        """
+        src = self.watch_raw(prefix, resource_version, recursive=recursive,
+                             lag_limit=lag_limit)
         out = watchpkg.Watcher(on_stop=lambda _w: src.stop())
 
         def pump():
             for ev in src:
-                sev = ev.object
+                if ev.type == watchpkg.ERROR and ev.object is None:
+                    # bounded-lag drop-to-resync marker from the store
+                    out.send(watchpkg.Event(
+                        watchpkg.ERROR,
+                        errors.new_expired("watch lag bound exceeded; "
+                                           "re-list required").status))
+                    break
                 try:
-                    cur = self._decode(sev.kv) if sev.kv else None
-                    prev = self._decode(sev.prev_kv) if sev.prev_kv else None
+                    tev = self.translate_event(ev, filter_fn)
                 except Exception as e:  # undecodable payload: surface, keep going
-                    out.send(watchpkg.Event(watchpkg.ERROR, errors.new_internal_error(str(e)).status))
+                    out.send(watchpkg.Event(
+                        watchpkg.ERROR, errors.new_internal_error(str(e)).status))
                     continue
-                cur_ok = cur is not None and (filter_fn is None or filter_fn(cur))
-                prev_ok = prev is not None and (filter_fn is None or filter_fn(prev))
-                if sev.action in ("create",):
-                    if cur_ok:
-                        out.send(watchpkg.Event(watchpkg.ADDED, cur))
-                elif sev.action in ("set", "compareAndSwap"):
-                    if cur_ok and prev_ok:
-                        out.send(watchpkg.Event(watchpkg.MODIFIED, cur))
-                    elif cur_ok:
-                        out.send(watchpkg.Event(watchpkg.ADDED, cur))
-                    elif prev_ok:
-                        # fell out of the filter: deliver the *new* state like
-                        # the reference (etcd_helper_watch.go sendModify)
-                        out.send(watchpkg.Event(watchpkg.DELETED, cur))
-                elif sev.action in ("delete", "expire"):
-                    if prev_ok:
-                        # clone: the deletion-rv rewrite below must not
-                        # mutate the shared cached revision
-                        prev_out = deep_clone(prev)
-                        # deleted object carries the deletion resourceVersion
-                        accessor.set_resource_version(prev_out, str(sev.index))
-                        out.send(watchpkg.Event(watchpkg.DELETED, prev_out))
+                if tev is not None:
+                    out.send(tev)
             out.close()
 
         t = threading.Thread(target=pump, daemon=True, name=f"watch-{prefix}")

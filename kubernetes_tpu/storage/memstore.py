@@ -312,7 +312,8 @@ class MemStore:
 
     # -- watch -------------------------------------------------------------
     def watch(self, prefix: str, from_index: int = 0,
-              recursive: bool = True) -> watchpkg.Watcher:
+              recursive: bool = True,
+              lag_limit: Optional[int] = None) -> watchpkg.Watcher:
         """Stream StoreEvents for keys under prefix with index > from_index.
 
         from_index == 0 means "from now" (ref: ParseWatchResourceVersion,
@@ -320,6 +321,13 @@ class MemStore:
         rv N resumes after N). History replay past the window raises
         ErrIndexOutdated, which clients handle by relisting (the Reflector
         contract, ref: pkg/client/cache/reflector.go:83).
+
+        ``lag_limit`` bounds how far a consumer may fall behind: past the
+        bound, modify events for one key coalesce (latest state still
+        delivered) and anything uncoalescible drops the watcher to resync
+        — one ERROR event, then end-of-stream (see watch.Watcher). The
+        default (None) keeps the historical unbounded queue for
+        in-process consumers that are trusted to drain.
         """
         with self._lock:
             self._maybe_raise("watch", prefix)
@@ -329,13 +337,37 @@ class MemStore:
                     # asked to replay events that are gone
                     raise ErrIndexOutdated(
                         f"requested index {from_index} is outside the history window")
-            w = watchpkg.Watcher()
+            w = watchpkg.Watcher(
+                lag_limit=lag_limit,
+                coalesce=_coalesce_store_events if lag_limit else None)
             if from_index:
                 for ev in self._history:
                     if ev.index > from_index and _match(ev.key, prefix, recursive):
                         w.send(watchpkg.Event(ev.action, ev))
             self._watchers.append((prefix, recursive, w))
             return w
+
+
+def _coalesce_store_events(old: watchpkg.Event,
+                           new: watchpkg.Event) -> Optional[watchpkg.Event]:
+    """Merge two queued mutations of ONE key into a single modify event
+    preserving the prev->cur chain: (v1->v2) + (v2->v3) becomes (v1->v3),
+    proven contiguous by the store indices, so filter-transition logic
+    downstream (helper.translate_event) still sees the true endpoints.
+    Creates/deletes never merge — their presence transitions must be
+    delivered (or the watcher resyncs)."""
+    osev, nsev = old.object, new.object
+    if not isinstance(osev, StoreEvent) or not isinstance(nsev, StoreEvent):
+        return None
+    if (osev.key != nsev.key
+            or osev.action not in ("set", "compareAndSwap")
+            or nsev.action not in ("set", "compareAndSwap")):
+        return None
+    if osev.kv is None or nsev.prev_kv is None \
+            or osev.kv.modified_index != nsev.prev_kv.modified_index:
+        return None  # not contiguous (interleaved delete/recreate)
+    return watchpkg.Event(nsev.action, StoreEvent(
+        nsev.action, nsev.key, nsev.index, nsev.kv, osev.prev_kv))
 
 
 def _match(key: str, prefix: str, recursive: bool) -> bool:

@@ -210,7 +210,8 @@ class StoreServer:
         try:
             src = self.store.watch(req.get("prefix", ""),
                                    from_index=req.get("from_index", 0),
-                                   recursive=req.get("recursive", True))
+                                   recursive=req.get("recursive", True),
+                                   lag_limit=req.get("lag_limit"))
         except StoreError as e:
             _send_frame(conn, _err_out(e))
             return
@@ -229,6 +230,11 @@ class StoreServer:
                          name="store-watch-reap").start()
         try:
             for ev in src:
+                if ev.type == watchpkg.ERROR and ev.object is None:
+                    # bounded-lag drop-to-resync marker: forward, then the
+                    # stream ends (the client re-lists)
+                    _send_frame(conn, {"lagged": True})
+                    break
                 sev: StoreEvent = ev.object
                 _send_frame(conn, {"ev": {
                     "action": sev.action, "key": sev.key, "index": sev.index,
@@ -354,12 +360,14 @@ class RemoteStore:
                                   "prev_index": prev_index}))
 
     def watch(self, prefix: str, from_index: int = 0,
-              recursive: bool = True) -> watchpkg.Watcher:
+              recursive: bool = True,
+              lag_limit: Optional[int] = None) -> watchpkg.Watcher:
         sock = self._connect()
         # the open handshake stays under the connect timeout (a wedged
         # store must fail watch() in bounded time) ...
         _send_frame(sock, {"op": "watch", "prefix": prefix,
-                           "from_index": from_index, "recursive": recursive})
+                           "from_index": from_index, "recursive": recursive,
+                           "lag_limit": lag_limit})
         resp = _recv_frame(sock)
         if resp is None:
             raise StoreError("store connection closed opening watch")
@@ -382,7 +390,14 @@ class RemoteStore:
             try:
                 while True:
                     frame = _recv_frame(sock)
-                    if frame is None or "ev" not in frame:
+                    if frame is None:
+                        break
+                    if frame.get("lagged"):
+                        # server-side lag bound tripped: replay the
+                        # drop-to-resync locally (ERROR + end-of-stream)
+                        w.drop_to_resync()
+                        break
+                    if "ev" not in frame:
                         break
                     d = frame["ev"]
                     w.send(watchpkg.Event(d["action"], StoreEvent(

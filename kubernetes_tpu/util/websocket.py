@@ -16,7 +16,8 @@ import struct
 from typing import Optional, Tuple
 
 __all__ = ["accept_key", "wants_websocket", "send_text", "send_close",
-           "read_frame", "OP_TEXT", "OP_CLOSE", "OP_PING", "OP_PONG"]
+           "read_frame", "build_frame", "text_frame",
+           "OP_TEXT", "OP_CLOSE", "OP_PING", "OP_PONG"]
 
 _GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
 
@@ -37,7 +38,11 @@ def wants_websocket(headers) -> bool:
         and bool(headers.get("Sec-WebSocket-Key"))
 
 
-def _send_frame(wfile, opcode: int, payload: bytes) -> None:
+def build_frame(opcode: int, payload: bytes) -> bytes:
+    """One unmasked FIN frame as bytes — cacheable: a server text frame
+    for a given payload is byte-identical for every connection, so the
+    watch fan-out builds it once per (revision, version) and every
+    watcher writes the same bytes."""
     header = bytearray([0x80 | opcode])
     n = len(payload)
     if n < 126:
@@ -48,7 +53,16 @@ def _send_frame(wfile, opcode: int, payload: bytes) -> None:
     else:
         header.append(127)
         header += struct.pack(">Q", n)
-    wfile.write(bytes(header) + payload)
+    return bytes(header) + payload
+
+
+def text_frame(payload: bytes) -> bytes:
+    """One unmasked FIN text frame as bytes (see build_frame)."""
+    return build_frame(OP_TEXT, payload)
+
+
+def _send_frame(wfile, opcode: int, payload: bytes) -> None:
+    wfile.write(build_frame(opcode, payload))
     wfile.flush()
 
 

@@ -329,3 +329,104 @@ def test_fake_client_records_actions():
     fc.on("list", "pods", lambda **kw: api.PodList(items=[_pod("scripted")]))
     out = fc.pods("default").list()
     assert out.items[0].metadata.name == "scripted"
+
+
+# -- encode-once fan-out primitives + batch bind (apiserver hot path) -------
+
+
+def test_watcher_counts_drops_on_full_bounded_queue():
+    from kubernetes_tpu.util import metrics as metrics_pkg
+
+    dropped = metrics_pkg.default_registry().counter(
+        "watch_events_dropped_total")
+    before = dropped.total()
+    w = watchpkg.Watcher(maxsize=1)
+    assert w.send(watchpkg.Event(watchpkg.ADDED, "a"), timeout=0.01)
+    assert not w.send(watchpkg.Event(watchpkg.ADDED, "b"), timeout=0.01)
+    assert dropped.total() == before + 1
+
+
+def test_memstore_watch_lag_drops_to_resync():
+    from kubernetes_tpu.storage.memstore import MemStore
+
+    s = MemStore()
+    w = s.watch("/r", lag_limit=4)
+    for i in range(10):  # distinct keys: nothing can coalesce
+        s.create(f"/r/k{i}", "v")
+    assert w.lagged
+    evs = []
+    while True:
+        ev = w.next_event(timeout=1)
+        if ev is None:
+            break
+        evs.append(ev)
+    assert evs[-1].type == watchpkg.ERROR and evs[-1].object is None
+    # a subsequent write must not resurrect the dropped watcher
+    s.create("/r/late", "v")
+    assert w.next_event(timeout=0.2) is None
+
+
+def test_memstore_watch_coalesces_same_key_chain():
+    from kubernetes_tpu.storage.memstore import MemStore
+
+    s = MemStore()
+    w = s.watch("/r", lag_limit=4)
+    s.create("/r/k", "v0")
+    for i in range(1, 12):
+        s.set("/r/k", f"v{i}")
+    assert not w.lagged
+    evs = []
+    for _ in range(4):
+        evs.append(w.next_event(timeout=1))
+    assert [e.type for e in evs] == ["create", "set", "set", "set"]
+    # the tail event carries the LATEST value and a contiguous prev chain
+    assert evs[-1].object.kv.value == "v11"
+    for prev, cur in zip(evs, evs[1:]):
+        assert cur.object.prev_kv.modified_index == \
+            prev.object.kv.modified_index
+    # delete does not merge into the modify chain
+    s.delete("/r/k")
+    assert w.next_event(timeout=1).type == "delete"
+
+
+def test_master_bind_batch_namespace_pinning_and_on_bound(cluster):
+    m, c = cluster
+    pods = c.pods("default")
+    for n in ("x1", "x2"):
+        pods.create(_pod(n))
+    seeded = []
+    res = m.bind_batch("default", api.BindingList(items=[
+        api.Binding(metadata=api.ObjectMeta(name="x1", namespace="default"),
+                    pod_name="x1", host="m1"),
+        api.Binding(metadata=api.ObjectMeta(name="x2", namespace="other"),
+                    pod_name="x2", host="m1"),   # foreign ns: pinned out
+    ]), on_bound=seeded.append)
+    assert res.items[0].error == ""
+    assert res.items[1].code == 403
+    # on_bound saw exactly the committed post-bind revisions
+    assert [p.metadata.name for p in seeded] == ["x1"]
+    assert seeded[0].spec.host == "m1"
+    assert seeded[0].metadata.resource_version == \
+        pods.get("x1").metadata.resource_version
+    assert pods.get("x2").spec.host == ""
+
+
+def test_dispatch_watch_raw_translates_like_watch(cluster):
+    m, c = cluster
+    raw, translate = m.dispatch("watch_raw", "pods", namespace="default",
+                                field_selector="spec.host=", lag_limit=64)
+    try:
+        c.pods("default").create(_pod("rawpod"))
+        ev = translate(raw.next_event(timeout=5))
+        assert ev.type == watchpkg.ADDED
+        assert ev.object.metadata.name == "rawpod"
+        # binding moves the pod out of the spec.host= filter -> DELETED
+        m.bind_batch("default", api.BindingList(items=[
+            api.Binding(metadata=api.ObjectMeta(name="rawpod",
+                                                namespace="default"),
+                        pod_name="rawpod", host="m1")]))
+        ev = translate(raw.next_event(timeout=5))
+        assert ev.type == watchpkg.DELETED
+        assert ev.object.spec.host == "m1"  # new state, reference shape
+    finally:
+        raw.stop()

@@ -110,6 +110,22 @@ def _churn_sample_record():
                     "delta_resyncs": 0, "delta_hit_rate": 0.96,
                     "delta_bytes_shipped": 10_000_000,
                     "delta_bytes_saved": 200_000_000},
+        "apiserver": {"frame_cache_hits": 900_000,
+                      "frame_cache_misses": 50_000,
+                      "frame_cache_hit_rate": 0.947, "frame_seeds": 99_000,
+                      "watch_lag_drops": 0, "watch_events_coalesced": 0,
+                      "watch_events_dropped": 0,
+                      "fanout_seconds": 12.5, "fanout_writes": 40_000,
+                      "frames_per_write": 9.1,
+                      "batch_bind_requests": 50,
+                      "batch_bind_bindings": 50_000,
+                      "batch_bind_p50_ms": 310.0, "batch_bind_p95_ms": 700.0,
+                      "bind_server_ms_per_pod": 0.41,
+                      "per_bind_ms_live": 0.8,
+                      "bind_parity": {"checked": 130, "divergent": 0,
+                                      "conflict_parity": True},
+                      "bind_probe": {"batch_ms_per_pod": 0.4,
+                                     "per_pod_ms": 1.2, "pods": 1280}},
     }
 
 
@@ -123,18 +139,34 @@ def test_churn_record_schema_flags_dropped_fields():
     rec = _churn_sample_record()
     del rec["cpu_budget_s"]
     del rec["solverd"]["delta_hit_rate"]
+    del rec["apiserver"]["frame_cache_hit_rate"]
+    del rec["apiserver"]["bind_parity"]
     missing = churn_mp.validate_record(rec)
     assert "cpu_budget_s" in missing
     assert "solverd.delta_hit_rate" in missing
+    assert "apiserver.frame_cache_hit_rate" in missing
+    assert "apiserver.bind_parity" in missing
     # an aborted run's partial record is exempt beyond its error marker
     assert churn_mp.validate_record(
         {"error": "feeder failures", "created": 10}) == []
 
 
+def test_churn_record_schema_apiserver_fields_gated_by_round():
+    """r07 records predate the apiserver hot-path family; r08+ must
+    carry it (the frame-cache/batch-bind evidence the acceptance gates
+    read)."""
+    churn_mp = _load_churn_mp()
+    rec = _churn_sample_record()
+    del rec["apiserver"]
+    assert churn_mp.validate_record(rec, round_no=7) == []
+    assert "apiserver" in churn_mp.validate_record(rec, round_no=8)
+
+
 def test_committed_churn_records_conform():
     """Every committed CHURN_MP record from r07 on must satisfy the
-    schema — the contract that keeps delta-wire evidence and the CPU
-    budget in future rounds' records."""
+    schema (r08+ additionally the apiserver hot-path fields) — the
+    contract that keeps the evidence the acceptance gates read in every
+    future round's record."""
     churn_mp = _load_churn_mp()
     for path in glob.glob(os.path.join(_REPO, "CHURN_MP_r*.json")):
         round_no = int(path.rsplit("_r", 1)[1].split("_")[0].split(".")[0])
@@ -142,7 +174,7 @@ def test_committed_churn_records_conform():
             continue  # pre-contract records are historical evidence
         with open(path) as fh:
             rec = json.load(fh)
-        assert churn_mp.validate_record(rec) == [], path
+        assert churn_mp.validate_record(rec, round_no=round_no) == [], path
 
 
 def test_replay_of_committed_records_stays_compact():
