@@ -510,6 +510,7 @@ FULL_SHAPES = {
     "affinity": (5_000, 5_000, {}),
     "binpack3": (5_000, 10_000, {"three_resources": True}),
     "gang": (2_000, 0, {"gang_groups": 1_000, "gang_size": 8}),
+    "mesh": (10_000, 2_048, {}),
 }
 
 
@@ -885,6 +886,111 @@ def run_solver_config(tag, n_nodes, n_pods, gate_nodes=0, gate_pods=0,
         f"+ device(transfer+solve+readback) {res['device_s']:.4f}; "
         f"{res['value']:.0f} pods/s{pipe}; "
         f"scheduled {res['scheduled']}/{res['pods']}")
+    return res
+
+
+def run_mesh_config(tag, n_nodes, n_pods, pods_axis=1, gate_nodes=600,
+                    gate_pods=600, runs=5):
+    """Race the mesh-sharded GSPMD solve (parallel/mesh.sharded_program —
+    the exact program kube-solverd's MeshExecutor dispatches) against the
+    same program pinned to a 1x1 single-device submesh, on one wave at a
+    node count above the mesh floor. Three gates, all hard: the two
+    layouts must agree BITWISE on (chosen, scores); the decisions must
+    match the slice serial oracle; and padding indices must never escape
+    the real node range. ``value`` is the WINNING layout's pods/s — on a
+    CPU sub-mesh the single-device layout usually wins (the measured
+    crossover MeshExecutor's auto dispatch encodes); on real multi-chip
+    the sharded layout is the capacity path. Both rates are recorded so
+    the record shows the crossover, not just the winner."""
+    import jax
+
+    if jax.device_count() <= 1:
+        log(f"[{tag}] needs >1 device (have {jax.device_count()}; set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=N); skipping")
+        return None
+    import numpy as np
+
+    from kubernetes_tpu.models.batch_solver import snapshot_to_host_inputs
+    from kubernetes_tpu.models.snapshot import encode_snapshot
+    from kubernetes_tpu.parallel import mesh as pm
+
+    log(f"[{tag}] building {n_pods} pods x {n_nodes} nodes "
+        f"(mesh {jax.device_count() // pods_axis} node-shards x "
+        f"{pods_axis} pods)")
+    nodes, existing, pending, services = build_cluster(n_nodes, n_pods)
+    snap = encode_snapshot(nodes, existing, pending, services)
+    inp = snapshot_to_host_inputs(snap)
+    full = pm.make_mesh(pods_axis=pods_axis)
+    single = pm.make_mesh(jax.devices()[:1], pods_axis=1)
+
+    def timed(mesh):
+        def once():
+            t0 = time.perf_counter()
+            out = pm.solve_sharded(inp, mesh, pol=snap.policy,
+                                   gangs=snap.has_gangs,
+                                   prefer_kernel=False)
+            return out, time.perf_counter() - t0
+        out, _cold = once()  # compile + first placement, untimed
+        times = []
+        for _ in range(runs):
+            out, dt = once()
+            times.append(dt)
+        times.sort()
+        return out, times[len(times) // 2]
+
+    (sh_chosen, sh_scores), sharded_s = timed(full)
+    (sg_chosen, sg_scores), single_s = timed(single)
+    if not (np.array_equal(sh_chosen, sg_chosen)
+            and np.array_equal(sh_scores, sg_scores)):
+        n_div = int((sh_chosen != sg_chosen).sum())
+        log(f"[{tag}] LAYOUT PARITY FAILURE: sharded != single-device "
+            f"({n_div}/{len(sh_chosen)} decisions diverge)")
+        return None
+    if sh_chosen.max(initial=-1) >= n_nodes:
+        log(f"[{tag}] PADDING ESCAPE: decision index "
+            f"{int(sh_chosen.max())} >= {n_nodes}")
+        return None
+
+    # slice serial-oracle gate, same derivation as run_solver_config
+    g_nodes = nodes[:gate_nodes]
+    keep = {n.metadata.name for n in g_nodes}
+    g_exist = [p for p in existing if p.status.host in keep]
+    g_pend = pending[:gate_pods]
+    g_snap = encode_snapshot(g_nodes, g_exist, g_pend, services)
+    g_chosen, _ = pm.solve_sharded(snapshot_to_host_inputs(g_snap), full,
+                                   pol=g_snap.policy,
+                                   gangs=g_snap.has_gangs,
+                                   prefer_kernel=False)
+    rate = check_equivalence(tag, g_snap, g_chosen, g_nodes, g_exist,
+                             g_pend, services)
+    if rate is None:
+        return None
+
+    report = pm.shard_memory_report(inp, full)
+    winner = "shard" if sharded_s < single_s else "single"
+    best_s = min(sharded_s, single_s)
+    res = {
+        "pods": n_pods, "nodes": n_nodes,
+        "devices": jax.device_count(),
+        "pods_axis": pods_axis,
+        "node_shards": int(full.shape["nodes"]),
+        "sharded_wave_s": round(sharded_s, 4),
+        "single_wave_s": round(single_s, 4),
+        "winner": winner,
+        "value": round(n_pods / best_s, 1),
+        "sharded_pods_per_s": round(n_pods / sharded_s, 1),
+        "single_pods_per_s": round(n_pods / single_s, 1),
+        "speedup": round(single_s / sharded_s, 3),
+        "layout_parity": "bitwise-identical",
+        "gate": f"slice-oracle-{len(g_pend)}x{len(g_nodes)}",
+        "serial_oracle_pods_per_s": round(rate, 1),
+        "shard_bytes_per_device": report["total_bytes_per_device"],
+        "runs": runs,
+    }
+    log(f"[{tag}] sharded {sharded_s:.3f}s vs single-device "
+        f"{single_s:.3f}s per wave -> {winner} wins "
+        f"({res['value']:.0f} pods/s); layouts bitwise identical; "
+        f"{report['total_bytes_per_device'] >> 20} MiB/device sharded")
     return res
 
 
@@ -1347,7 +1453,7 @@ def child(argv) -> int:
     s = args.smoke
     runs = args.runs or (5 if s else 12 if args.cpu else 30)
     known = {"north_star", "basic", "affinity", "binpack3", "gang", "churn",
-             "pipeline"}
+             "pipeline", "mesh"}
     if args.configs != "all":
         want = set(args.configs.split(","))
     else:
@@ -1356,6 +1462,11 @@ def child(argv) -> int:
             # the pipeline config races two full live-stack drains; only
             # meaningful (and only paid for) when the mode is requested
             want.discard("pipeline")
+        if len(devices) <= 1:
+            # the mesh config races two device layouts; without a second
+            # device there is nothing to race (run under XLA_FLAGS=
+            # --xla_force_host_platform_device_count=N to include it)
+            want.discard("mesh")
     detail_path = args.detail_out or os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_detail.json")
     unknown = want - known
@@ -1462,6 +1573,11 @@ def child(argv) -> int:
         gate_nodes=50 if s else 200, gate_pods=160 if s else 400,
         runs=runs, pipeline=args.pipeline,
         **({"gang_groups": 20, "gang_size": 8} if s else g_kw))
+    m_nodes, m_pods, _ = FULL_SHAPES["mesh"]
+    run("mesh", run_mesh_config,
+        256 if s else m_nodes, 128 if s else m_pods,
+        gate_nodes=100 if s else 600, gate_pods=100 if s else 600,
+        runs=2 if s else 5)
     run("churn", run_churn_config,
         20 if s else 500, 300 if s else 8_000,
         rate_pods_per_s=300 if s else 1_000,

@@ -266,6 +266,64 @@ def _scrape_solverd(port: int) -> dict:
         vals.get("solverd_delta_bytes_shipped_total", 0.0))
     out["delta_bytes_saved"] = int(
         vals.get("solverd_delta_bytes_saved_total", 0.0))
+    mesh = _scrape_solverd_mesh(raw)
+    if mesh is not None:
+        out["mesh"] = mesh
+    return out
+
+
+def _scrape_solverd_mesh(raw: str):
+    """The solverd_mesh_* family (solver/mesh_exec.MeshExecutor): mesh
+    topology, device-resident plane traffic (delta scatters vs resharding
+    re-establishes), per-device shard footprint, the mesh-vs-single solve
+    quantiles, and the live parity probe. None when the daemon ran
+    without the mesh dispatch (the record section is then omitted —
+    tests/test_bench_record.py requires it from r09 on)."""
+    keys = {"solverd_mesh_devices",
+            "solverd_mesh_pods_axis",
+            "solverd_mesh_node_shards",
+            "solverd_mesh_waves_total",
+            "solverd_mesh_transfer_bytes_total",
+            "solverd_mesh_reshard_bytes_total",
+            "solverd_mesh_resident_bytes",
+            "solverd_mesh_shard_bytes_per_device",
+            "solverd_mesh_parity_checks_total",
+            "solverd_mesh_parity_divergent_total"}
+    vals = {}
+    for line in raw.splitlines():
+        key, _, val = line.rpartition(" ")
+        if key in keys:
+            vals[key] = float(val)
+    if vals.get("solverd_mesh_devices", 0.0) <= 0:
+        return None
+    out = {
+        "devices": int(vals["solverd_mesh_devices"]),
+        "pods_axis": int(vals.get("solverd_mesh_pods_axis", 1)),
+        "node_shards": int(vals.get("solverd_mesh_node_shards", 0)),
+        "waves": int(vals.get("solverd_mesh_waves_total", 0)),
+        "transfer_bytes": int(
+            vals.get("solverd_mesh_transfer_bytes_total", 0)),
+        "reshard_bytes": int(
+            vals.get("solverd_mesh_reshard_bytes_total", 0)),
+        "resident_bytes": int(vals.get("solverd_mesh_resident_bytes", 0)),
+        "shard_bytes_per_device": int(
+            vals.get("solverd_mesh_shard_bytes_per_device", 0)),
+        "parity_checks": int(
+            vals.get("solverd_mesh_parity_checks_total", 0)),
+        "parity_divergent": int(
+            vals.get("solverd_mesh_parity_divergent_total", 0)),
+    }
+    m_sum, m_count, m_buckets = _parse_hist(raw, "solverd_mesh_solve_seconds")
+    out["solve_waves"] = int(m_count)
+    out["solve_p50_ms"] = round(
+        _hist_quantile(m_buckets, m_count, 0.5) * 1000, 2) if m_count else 0.0
+    out["solve_p95_ms"] = round(
+        _hist_quantile(m_buckets, m_count, 0.95) * 1000, 2) if m_count else 0.0
+    s_sum, s_count, s_buckets = _parse_hist(
+        raw, "solverd_mesh_single_device_seconds")
+    out["single_device_probes"] = int(s_count)
+    out["single_device_p50_ms"] = round(
+        _hist_quantile(s_buckets, s_count, 0.5) * 1000, 2) if s_count else 0.0
     return out
 
 
@@ -452,6 +510,14 @@ APISERVER_FIELDS = ("frame_cache_hits", "frame_cache_misses",
                     "batch_bind_requests", "batch_bind_bindings",
                     "batch_bind_p50_ms", "bind_server_ms_per_pod",
                     "per_bind_ms_live", "bind_parity", "bind_probe")
+# The mesh-sharded production solve evidence (solver/mesh_exec.py),
+# required under solverd from r09 on: mesh topology, the mesh-vs-single
+# solve quantiles, resident-plane traffic, and the live parity probe.
+SOLVERD_MESH_FIELDS = ("devices", "pods_axis", "node_shards", "waves",
+                       "transfer_bytes", "reshard_bytes",
+                       "shard_bytes_per_device", "solve_p50_ms",
+                       "single_device_p50_ms", "parity_checks",
+                       "parity_divergent")
 
 
 def validate_record(rec: dict, round_no: int = 8) -> list:
@@ -466,6 +532,17 @@ def validate_record(rec: dict, round_no: int = 8) -> list:
     if isinstance(sd, dict) and "error" not in sd:
         missing += [f"solverd.{k}" for k in SOLVERD_DELTA_FIELDS
                     if k not in sd]
+        if round_no >= 9:
+            # r09 claimed the mesh-sharded solve; every later record must
+            # carry the mesh section so the solve-stage evidence (device
+            # count, mesh-vs-single p50, reshard bytes, parity) can't be
+            # silently dropped
+            mesh = sd.get("mesh")
+            if not isinstance(mesh, dict):
+                missing.append("solverd.mesh")
+            elif "error" not in mesh:
+                missing += [f"solverd.mesh.{k}" for k in SOLVERD_MESH_FIELDS
+                            if k not in mesh]
     if round_no >= 8:
         ap = rec.get("apiserver")
         if not isinstance(ap, dict):
@@ -566,6 +643,24 @@ def main(argv=None) -> int:
                     "dispatch of wave k+1 overlap the HTTP commit "
                     "round-trips of wave k — and the solverd round-trip "
                     "when combined with --solverd")
+    ap.add_argument("--mesh-devices", type=int, default=0,
+                    help="carve the solverd child's CPU backend into N "
+                    "virtual devices (XLA_FLAGS="
+                    "--xla_force_host_platform_device_count=N) so the "
+                    "daemon's device-mesh dispatch has a mesh to shard "
+                    "over; 0 inherits the ambient device topology (real "
+                    "multi-chip, or a pre-set XLA_FLAGS)")
+    ap.add_argument("--mesh", choices=("auto", "on", "off"), default="auto",
+                    help="kube-solverd --mesh: device-mesh production "
+                    "dispatch for waves above the node floor (auto = on "
+                    "whenever >1 device is attached)")
+    ap.add_argument("--pods-axis", type=int, default=1,
+                    help="kube-solverd --pods-axis (mesh 'pods' axis)")
+    ap.add_argument("--mesh-dispatch",
+                    choices=("auto", "shard", "single"), default="auto",
+                    help="kube-solverd --mesh-dispatch: auto times "
+                    "sharded vs single-device once per shape and runs "
+                    "the winner; shard/single pin a layout")
     ap.add_argument("--solverd-gather", type=float, default=0.003,
                     help="kube-solverd gather window seconds; raise it "
                     "when several scheduler workers share the daemon so "
@@ -598,9 +693,10 @@ def main(argv=None) -> int:
     logdir = "/tmp/churn_mp_logs"
     os.makedirs(logdir, exist_ok=True)
 
-    def spawn(name, *cmd):
+    def spawn(name, *cmd, env=None):
         log = open(os.path.join(logdir, f"{name}.log"), "w")
-        p = subprocess.Popen(cmd, env=child_env, stdout=log, stderr=log)
+        p = subprocess.Popen(cmd, env=env or child_env, stdout=log,
+                             stderr=log)
         procs.append((name, p))
         return p
 
@@ -673,10 +769,24 @@ def main(argv=None) -> int:
             solverd_port = args.port + 7
             solver_addr = f"127.0.0.1:{solverd_port}"
             solverd_metrics_port = args.port + 8
+            sd_env = dict(child_env)
+            if args.mesh_devices:
+                # carve the daemon's CPU backend into a virtual device
+                # mesh; the other children keep the plain single-device
+                # backend (they never solve when the daemon is healthy)
+                flags = sd_env.get("XLA_FLAGS", "")
+                sd_env["XLA_FLAGS"] = (
+                    (flags + " " if flags else "")
+                    + "--xla_force_host_platform_device_count="
+                    + str(args.mesh_devices))
             spawn("solverd", PY, "-m", "kubernetes_tpu.cmd.solverd",
                   "--port", str(solverd_port),
                   "--gather-window", str(args.solverd_gather),
-                  "--metrics-port", str(solverd_metrics_port))
+                  "--metrics-port", str(solverd_metrics_port),
+                  "--mesh", args.mesh,
+                  "--pods-axis", str(args.pods_axis),
+                  "--mesh-dispatch", args.mesh_dispatch,
+                  env=sd_env)
             # the daemon must own its socket before any worker's first
             # wave, or every worker starts in the fallback cooldown
             import socket as _socket
@@ -920,7 +1030,11 @@ def main(argv=None) -> int:
         if args.pipeline:
             sched_desc += " (--pipeline speculative double-buffering)"
         if solver_addr:
-            sched_desc += " -> shared kube-solverd (wave coalescing)"
+            sched_desc += " -> shared kube-solverd (wave coalescing"
+            if args.mesh_devices:
+                sched_desc += (f", {args.mesh_devices}-device mesh "
+                               "dispatch")
+            sched_desc += ")"
         if args.watchers:
             sched_desc += f" + {args.watchers} observer watch streams"
         budget = cpu_budget()

@@ -36,7 +36,7 @@ if [[ "$RACE" == 1 ]]; then
             tests/test_kubelet.py tests/test_process_runtime.py
             tests/test_controllers.py tests/test_scheduler.py
             tests/test_integration.py tests/test_solverd.py
-            tests/test_incremental.py)
+            tests/test_incremental.py tests/test_parallel.py)
     rc=0
     for ((i = 1; i <= ROUNDS; i++)); do
         echo "=== race round ${i}/${ROUNDS} (switchinterval=1e-6) ==="
@@ -51,4 +51,15 @@ for v in ${VERSIONS//,/ }; do
     echo "=== test run with KUBE_TEST_API_VERSION=${v} ==="
     KUBE_TEST_API_VERSION="$v" python -m pytest tests/ -q "$@" || rc=$?
 done
+
+# Tier-2: the solver suites again on an 8-way CPU sub-mesh. conftest
+# already forces 8 virtual devices for every run above; this step pins
+# the flag EXPLICITLY (immune to a pre-set XLA_FLAGS in the environment)
+# so the mesh executor, delta-onto-sharded-planes, and
+# pipeline-through-mesh suites always see the multi-device topology the
+# production solverd --mesh path ships with.
+echo "=== tier-2: solver suites under xla_force_host_platform_device_count=8 ==="
+XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_parallel.py tests/test_solverd.py \
+    tests/test_batch_solver.py -q "$@" || rc=$?
 exit "$rc"

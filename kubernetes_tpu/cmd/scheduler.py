@@ -45,6 +45,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "divergence re-encodes first. Composes with "
                         "--solver-addr (the speculative encode overlaps "
                         "the daemon round-trip).")
+    p.add_argument("--mesh", choices=("auto", "on", "off"), default="auto",
+                   help="tpu-batch: device-mesh solve for in-process waves "
+                        "(parallel/mesh.py): auto shards waves above the "
+                        "node floor over the attached device mesh when >1 "
+                        "device exists (real multi-chip, or CPU sub-meshes "
+                        "via XLA_FLAGS="
+                        "--xla_force_host_platform_device_count=N). "
+                        "Decisions stay bit-identical to the single-device "
+                        "path. With --solver-addr the daemon's own --mesh "
+                        "governs the shared solve; this flag still covers "
+                        "the in-process fallback.")
+    p.add_argument("--pods-axis", "--pods_axis", type=int, default=1,
+                   help="mesh 'pods' axis length (see kube-solverd "
+                        "--pods-axis)")
     p.add_argument("--event-qps", "--event_qps", type=float, default=50.0,
                    help="client-side event rate limit (successor "
                         "codebases' --event-qps; 0 disables)")
@@ -120,7 +134,9 @@ def build_scheduler(opts):
     config = factory.create(provider=opts.algorithm_provider,
                             policy=policy, recorder=recorder,
                             solver_addr=getattr(opts, "solver_addr", ""),
-                            pipeline=getattr(opts, "pipeline", False))
+                            pipeline=getattr(opts, "pipeline", False),
+                            mesh=getattr(opts, "mesh", "auto"),
+                            pods_axis=getattr(opts, "pods_axis", 1))
     if getattr(opts, "pipeline", False) and opts.algorithm != "tpu-batch":
         print("kube-scheduler: --pipeline requires --algorithm tpu-batch; "
               "ignoring", file=sys.stderr)

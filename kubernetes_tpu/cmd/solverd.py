@@ -46,6 +46,34 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--metrics-port", "--metrics_port", type=int, default=0,
                    help="serve /metrics, /healthz and /debug/pprof on this "
                         "port (0 disables)")
+    p.add_argument("--mesh", choices=("auto", "on", "off"), default="auto",
+                   help="device-mesh production dispatch "
+                        "(solver/mesh_exec.py): auto enables it whenever "
+                        ">1 device is attached — real multi-chip, or CPU "
+                        "sub-meshes via XLA_FLAGS="
+                        "--xla_force_host_platform_device_count=N; waves "
+                        "above --mesh-min-nodes then solve from "
+                        "device-resident sharded planes")
+    p.add_argument("--pods-axis", "--pods_axis", type=int, default=1,
+                   help="mesh 'pods' axis length; the rest of the devices "
+                        "shard the node axis (pods_axis=1 is pure "
+                        "tensor-parallel over nodes)")
+    p.add_argument("--mesh-min-nodes", "--mesh_min_nodes", type=int,
+                   default=None,
+                   help="node-count floor for the mesh dispatch (default "
+                        "parallel.mesh.DEFAULT_MESH_MIN_NODES); smaller "
+                        "waves keep the padded vmap path")
+    p.add_argument("--mesh-dispatch", "--mesh_dispatch",
+                   choices=("auto", "shard", "single"), default="auto",
+                   help="node-axis layout: auto times the fully-sharded "
+                        "scan against the single-device submesh once per "
+                        "shape (persisted in the warm-start dir) and runs "
+                        "the winner; shard/single pin a layout")
+    p.add_argument("--mesh-probe", "--mesh_probe",
+                   choices=("first", "all", "off"), default="first",
+                   help="live bit-identity probe: re-solve mesh-path "
+                        "waves in the other layout and compare bitwise "
+                        "(first = once per daemon run)")
     return p
 
 
@@ -68,13 +96,23 @@ def solverd_server(argv: List[str],
                         gather_window_s=opts.gather_window,
                         max_batch=opts.max_batch,
                         max_queue=opts.max_queue,
-                        cache_entries=opts.cache_entries)
+                        cache_entries=opts.cache_entries,
+                        mesh=opts.mesh, pods_axis=opts.pods_axis,
+                        mesh_min_nodes=opts.mesh_min_nodes,
+                        mesh_dispatch=opts.mesh_dispatch,
+                        mesh_probe=opts.mesh_probe)
     if opts.metrics_port:
         from kubernetes_tpu.cmd.scheduler import _serve_debug
         _serve_debug(opts.metrics_port)
+    me = srv._mesh_exec
+    mesh_desc = (f", mesh {me.node_shards} node-shards x "
+                 f"{me.pods_axis} pods (min {me.min_nodes} nodes, "
+                 f"dispatch {opts.mesh_dispatch})"
+                 if me is not None else ", mesh off")
     print(f"kube-solverd listening on {srv.address} "
           f"(gather {opts.gather_window * 1000:.1f}ms, "
-          f"batch<= {opts.max_batch}, queue<= {opts.max_queue})",
+          f"batch<= {opts.max_batch}, queue<= {opts.max_queue}"
+          f"{mesh_desc})",
           file=sys.stderr, flush=True)
     if ready is not None:
         ready.set()

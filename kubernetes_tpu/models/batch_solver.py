@@ -745,11 +745,15 @@ class WaveRouter:
     @staticmethod
     def _cal_key(key) -> str:
         """Persisted-store key: the in-memory plan key PLUS the default
-        backend. Calibration timings are a property of the attached
-        device — a 'device' plan measured over a TPU tunnel must never be
-        restored into a CPU-only restart (the tunnel dropping is a
-        recurring condition here), nor vice versa."""
-        return f"{jax.default_backend()}|{key!r}"
+        backend and its device count (the mesh shape). Calibration
+        timings are a property of the attached devices — a 'device' plan
+        measured over a TPU tunnel must never be restored into a CPU-only
+        restart (the tunnel dropping is a recurring condition here), and
+        a plan measured on one host device must not leak into a run where
+        --xla_force_host_platform_device_count carved the same cores into
+        an 8-device sub-mesh (different threadpool split, different
+        timings)."""
+        return f"{jax.default_backend()}x{jax.device_count()}|{key!r}"
 
     def save_calibrations(self) -> None:
         """Best-effort atomic write of every known plan (persisted +
@@ -859,8 +863,17 @@ class WaveRouter:
 default_router = WaveRouter()
 
 
+def _mesh_min_nodes() -> int:
+    """parallel.mesh.DEFAULT_MESH_MIN_NODES, imported lazily: parallel/
+    mesh imports this module at load, so the constant cannot be a
+    top-level import here."""
+    from kubernetes_tpu.parallel.mesh import DEFAULT_MESH_MIN_NODES
+    return DEFAULT_MESH_MIN_NODES
+
+
 def solve(snap: ClusterSnapshot,
-          host: Optional[SolverInputs] = None) -> Tuple[np.ndarray, np.ndarray]:
+          host: Optional[SolverInputs] = None,
+          mesh=None) -> Tuple[np.ndarray, np.ndarray]:
     """Host entry: encode -> device -> solve -> host decisions (including
     the all-or-nothing gang post-pass when the wave has PodGroups).
     Waves route through the measured host-vs-device dispatch (WaveRouter):
@@ -868,11 +881,28 @@ def solve(snap: ClusterSnapshot,
     faster on the host CPU backend. ``host`` short-circuits the host-side
     encode when the caller already holds snapshot_to_host_inputs(snap)
     (the RemoteSolver fallback path, which encoded before learning the
-    daemon couldn't take the wave)."""
+    daemon couldn't take the wave).
+
+    ``mesh`` (a parallel.mesh Mesh, kube-scheduler --mesh) routes waves at
+    or above the mesh node floor through solve_sharded's measured
+    kernel-vs-mesh dispatch instead of the router — the in-process twin
+    of kube-solverd's MeshExecutor, minus device residency (workers that
+    want resident planes use the daemon). Decisions are bit-identical
+    either way (parallel/mesh.py contract); the gang post-pass is applied
+    here exactly as on the router path."""
     if host is None:
         host = snapshot_to_host_inputs(snap)
     has_gangs = snap.has_gangs
     peer_bound = peer_bound_of(snap)
+    if mesh is not None and int(host.cap.shape[0]) >= _mesh_min_nodes():
+        from kubernetes_tpu.parallel.mesh import solve_sharded
+        chosen, scores = solve_sharded(host, mesh, pol=snap.policy,
+                                       gangs=has_gangs,
+                                       peer_bound=peer_bound)
+        if has_gangs:
+            chosen = gang.apply_all_or_nothing(snap.pod_rid, chosen)
+            scores = np.where(chosen < 0, np.int32(NEG), scores)
+        return chosen, scores
     plan = default_router.plan_for(host, snap.policy, has_gangs, peer_bound)
     inp = ship_inputs(host, plan.device)
     chosen, scores = solve_device(

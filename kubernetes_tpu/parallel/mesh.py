@@ -20,6 +20,7 @@ remain bit-identical to the unsharded / serial paths.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -29,8 +30,30 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kubernetes_tpu.models.batch_solver import SolverInputs, solve_jit
 
-__all__ = ["make_mesh", "pad_inputs_for_mesh", "solve_sharded",
-           "shard_memory_report"]
+__all__ = ["make_mesh", "maybe_mesh", "pad_inputs_for_mesh", "solve_sharded",
+           "shard_memory_report", "sharded_program", "input_shardings",
+           "RESIDENT_FIELDS", "WAVE_FIELDS", "DEFAULT_MESH_MIN_NODES"]
+
+_DEBUG = os.environ.get("KTPU_DEBUG", "") not in ("", "0")
+
+# Below this node count the mesh dispatch stays out of the way by default:
+# small waves are kernel- or single-device territory (the measured numbers
+# in solve_sharded's docstring), and the production full-shape planes the
+# mesh exists for start around here.
+DEFAULT_MESH_MIN_NODES = 4096
+
+# The resident/wave split of SolverInputs, shared with the solver daemon's
+# delta wire (solver/protocol.DELTA_FIELDS names the same set): node/group/
+# zone planes persist between waves (device-resident under the mesh
+# executor), pod-axis planes are new every wave and safe to donate.
+RESIDENT_FIELDS = (
+    "cap", "advertises", "fit_used", "fit_exceeded", "score_used",
+    "node_ports", "node_sel", "node_pds", "node_extra_ok",
+    "group_counts", "score_static", "node_aff_vals",
+    "zone_idx", "zone_counts0",
+)
+WAVE_FIELDS = tuple(f for f in SolverInputs._fields
+                    if f not in RESIDENT_FIELDS)
 
 
 def make_mesh(devices=None, pods_axis: int = 1) -> Mesh:
@@ -44,45 +67,109 @@ def make_mesh(devices=None, pods_axis: int = 1) -> Mesh:
     return Mesh(arr, ("pods", "nodes"))
 
 
+def maybe_mesh(mode: str = "auto", pods_axis: int = 1) -> Optional[Mesh]:
+    """Resolve a --mesh flag to a Mesh or None. ``auto`` builds the mesh
+    exactly when more than one device is attached (real multi-chip, or CPU
+    sub-meshes via --xla_force_host_platform_device_count); ``on`` demands
+    one (raises on a single-device host); ``off`` is None."""
+    mode = (mode or "auto").strip().lower()
+    if mode not in ("auto", "on", "off"):
+        raise ValueError(f"mesh={mode!r}: expected auto|on|off")
+    if mode == "off":
+        return None
+    n = jax.device_count()
+    if n <= 1:
+        if mode == "on":
+            raise RuntimeError("--mesh on requires >1 device "
+                               f"(have {n}; set XLA_FLAGS="
+                               "--xla_force_host_platform_device_count=N)")
+        return None
+    return make_mesh(pods_axis=pods_axis)
+
+
+@functools.lru_cache(maxsize=512)
+def _pad_width(n: int, shards: int) -> int:
+    """Memoized node-axis pad width per (shape bucket N, mesh shards) —
+    the per-wave re-derivation this cache replaces showed up as O(fields)
+    numpy pad calls on every full-shape wave."""
+    return (-n) % shards
+
+
+def _assert_padding_invariant(padded: SolverInputs, n: int) -> None:
+    """KTPU_DEBUG gate: padding rows must be decision-invariant — never
+    feasible (so they cannot win any tie-break), never advertising
+    resources, never zone-labeled. A violation here means a future field
+    was added to SolverInputs without teaching pad_inputs_for_mesh its
+    decision-invariant fill."""
+    total = int(padded.cap.shape[0])
+    if total == n:
+        return
+    assert not np.asarray(padded.node_extra_ok[n:]).any(), \
+        "mesh padding produced a feasible node (node_extra_ok True)"
+    assert np.asarray(padded.fit_exceeded[n:]).all(), \
+        "mesh padding produced a node with headroom (fit_exceeded False)"
+    assert not np.asarray(padded.advertises[n:]).any(), \
+        "mesh padding advertises resources"
+    assert not np.asarray(padded.cap[n:]).any(), \
+        "mesh padding carries capacity"
+    assert (np.asarray(padded.zone_idx[:, n:]) == -1).all(), \
+        "mesh padding is zone-labeled (would perturb anti-affinity counts)"
+    assert (np.asarray(padded.node_aff_vals[n:]) == -1).all(), \
+        "mesh padding carries affinity label values"
+
+
+# (axis, decision-invariant fill) of each plane pad_inputs_for_mesh
+# extends (absent = unpadded). The ONE definition: pad_inputs_for_mesh
+# materializes from it, shard_memory_report derives padded-as-allocated
+# sizes from it without building the pads, and the mesh executor pads a
+# SINGLE re-established plane host-side from it. Fills are the
+# never-wins guarantees _assert_padding_invariant re-checks: pad nodes
+# are never feasible (node_extra_ok False, fit_exceeded True), advertise
+# nothing, carry no capacity, are zone-unlabeled (-1) and
+# affinity-unlabeled (-1).
+PAD_SPEC = {
+    "cap": (0, 0), "advertises": (0, False), "fit_used": (0, 0),
+    "fit_exceeded": (0, True), "score_used": (0, 0),
+    "node_ports": (0, 0), "node_sel": (0, 0), "node_pds": (0, 0),
+    "node_extra_ok": (0, False), "score_static": (0, 0),
+    "node_aff_vals": (0, -1),
+    "group_counts": (1, 0), "zone_idx": (1, -1),
+}
+
+
+def pad_plane(name: str, x, pad: int, xp=np):
+    """One plane padded per PAD_SPEC (identity when unpadded or pad==0).
+    ``xp`` selects the array module: np for a host-side single-plane pad
+    (the executor's residency re-establish), jnp inside traced code."""
+    spec = PAD_SPEC.get(name)
+    if spec is None or pad == 0:
+        return x
+    axis, fill = spec
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return xp.pad(x, widths, constant_values=fill)
+
+
 def pad_inputs_for_mesh(inp: SolverInputs, mesh: Mesh) -> Tuple[SolverInputs, int]:
     """Pad the node axis to a multiple of the "nodes" mesh axis with
-    infeasible nodes. Returns (padded inputs, original N)."""
+    infeasible nodes (PAD_SPEC fills). Returns (padded inputs, original
+    N). Pad widths are memoized per (N, mesh shards); with KTPU_DEBUG
+    set, the padded planes are re-checked for the decision-invariance
+    the fills guarantee."""
     shards = mesh.shape["nodes"]
     n = int(inp.cap.shape[0])
-    pad = (-n) % shards
+    pad = _pad_width(n, shards)
     if pad == 0:
         return inp, n
-
-    def pad_n(x, axis=0, fill=0):
-        widths = [(0, 0)] * x.ndim
-        widths[axis] = (0, pad)
-        return jnp.pad(x, widths, constant_values=fill)
-
-    return SolverInputs(
-        cap=pad_n(inp.cap),
-        advertises=pad_n(inp.advertises, fill=False),
-        fit_used=pad_n(inp.fit_used),
-        fit_exceeded=pad_n(inp.fit_exceeded, fill=True),
-        score_used=pad_n(inp.score_used),
-        node_ports=pad_n(inp.node_ports), node_sel=pad_n(inp.node_sel),
-        node_pds=pad_n(inp.node_pds),
-        node_extra_ok=pad_n(inp.node_extra_ok, fill=False),  # never feasible
-        req=inp.req,
-        pod_ports=inp.pod_ports, pod_sel=inp.pod_sel, pod_pds=inp.pod_pds,
-        pod_host_idx=inp.pod_host_idx, tie_hi=inp.tie_hi, tie_lo=inp.tie_lo,
-        pod_gid=inp.pod_gid, pod_group_member=inp.pod_group_member,
-        group_counts=pad_n(inp.group_counts, axis=1),
-        gang_start=inp.gang_start,
-        score_static=pad_n(inp.score_static),
-        node_aff_vals=pad_n(inp.node_aff_vals, fill=-1),
-        pod_aff_static=inp.pod_aff_static,
-        anchor_vals0=inp.anchor_vals0, has_anchor0=inp.has_anchor0,
-        zone_idx=pad_n(inp.zone_idx, axis=1, fill=-1),  # pad = unlabeled
-        zone_counts0=inp.zone_counts0,
-    ), n
+    padded = SolverInputs(**{name: pad_plane(name, getattr(inp, name),
+                                             pad, xp=jnp)
+                             for name in SolverInputs._fields})
+    if _DEBUG:
+        _assert_padding_invariant(padded, n)
+    return padded, n
 
 
-def _input_shardings(mesh: Mesh) -> SolverInputs:
+def input_shardings(mesh: Mesh) -> SolverInputs:
     """Sharding spec per input: node-axis arrays shard over "nodes"; per-pod
     arrays shard the scan axis over "pods" where legal, else replicate."""
     def s(*spec):
@@ -119,28 +206,34 @@ def shard_memory_report(inp: SolverInputs, mesh: Mesh) -> dict:
     duplicates the mutable planes on-device. The multi-chip dryrun logs
     this for the 5k-node planes so HBM headroom is visible without TPU
     hardware."""
-    padded, _ = pad_inputs_for_mesh(inp, mesh)
-    shardings = _input_shardings(mesh)
+    shardings = input_shardings(mesh)
     shards = mesh.shape["nodes"]
+    pad = _pad_width(int(inp.cap.shape[0]), shards)
 
-    def nbytes(a) -> int:
-        return int(np.prod(a.shape)) * a.dtype.itemsize
+    def nbytes(name: str) -> int:
+        # padded-as-allocated size, by shape arithmetic only: no device
+        # pads are materialized here (MeshExecutor calls this on the
+        # solve thread once per new resident bucket)
+        a = getattr(inp, name)
+        shape = list(a.shape)
+        if name in PAD_SPEC:
+            shape[PAD_SPEC[name][0]] += pad
+        return int(np.prod(shape)) * a.dtype.itemsize
 
     per_device = 0
     replicated = 0
-    for arr, sh in zip(padded, shardings):
-        b = nbytes(arr)
+    for name, sh in zip(SolverInputs._fields, shardings):
+        b = nbytes(name)
         if "nodes" in sh.spec:
             per_device += b // shards  # padded: node axis divides evenly
         else:
             replicated += b
     # the lax.scan carry holds live copies of the mutable planes
     # (kubernetes_tpu.models.batch_solver solve_jit Carry); same layout
-    carry_sharded = sum(nbytes(a) for a in (
-        padded.fit_used, padded.score_used, padded.node_ports,
-        padded.node_pds)) // shards
-    carry_replicated = sum(nbytes(a) for a in (
-        padded.group_counts, padded.anchor_vals0, padded.has_anchor0))
+    carry_sharded = sum(nbytes(f) for f in (
+        "fit_used", "score_used", "node_ports", "node_pds")) // shards
+    carry_replicated = sum(nbytes(f) for f in (
+        "group_counts", "anchor_vals0", "has_anchor0"))
     return {
         "devices": int(np.prod(list(mesh.shape.values()))),
         "node_shards": shards,
@@ -203,14 +296,49 @@ def solve_sharded(inp: SolverInputs, mesh: Optional[Mesh] = None,
 
     mesh = mesh or make_mesh()
     padded, n = pad_inputs_for_mesh(inp, mesh)
-    shardings = _input_shardings(mesh)
-    placed = jax.tree.map(jax.device_put, tuple(padded), tuple(shardings))
-    with mesh:
-        chosen, scores = solve_jit(SolverInputs(*placed), w_lr=w_lr,
-                                   w_spread=w_spread, w_equal=w_equal,
-                                   pol=pol, gangs=gangs)
+    shardings = input_shardings(mesh)
+    resident = tuple(jax.device_put(getattr(padded, f),
+                                    getattr(shardings, f))
+                     for f in RESIDENT_FIELDS)
+    wave = tuple(jax.device_put(getattr(padded, f), getattr(shardings, f))
+                 for f in WAVE_FIELDS)
+    # donate=False: the caller owns inp, and device_put of an
+    # already-placed array aliases it — donation would delete the
+    # caller's buffers. The daemon's mesh executor owns its transfers
+    # and is the donating caller.
+    fn = sharded_program(mesh, p, gangs, donate=False)
+    chosen, scores = fn(resident, wave)
     chosen = np.asarray(chosen)
     scores = np.asarray(scores)
     # padded nodes are infeasible, so indices never point past n; no remap
     assert chosen.max(initial=-1) < n
     return chosen, scores
+
+
+@functools.lru_cache(maxsize=64)
+def sharded_program(mesh: Mesh, pol, gangs: bool, donate: bool = True):
+    """One compiled GSPMD program family per (mesh, policy, gangs): the
+    sequential-commit scan jitted with pre-partitioned in/out shardings
+    (SNIPPETS.md [1-3] — matching specs between back-to-back waves means
+    already-placed inputs are never resharded on entry) and the per-wave
+    pod planes donated (``donate_argnums``): the scan carry reuses their
+    buffers, while the RESIDENT node/group/zone planes are an undonated
+    argument and stay valid — the device-resident plane cache in
+    solver/mesh_exec depends on exactly that split.
+
+    Signature: ``fn(resident_tuple, wave_tuple) -> (chosen, scores)`` with
+    the tuples in RESIDENT_FIELDS / WAVE_FIELDS order; outputs are
+    replicated (one [P] vector each, readable with a single host copy)."""
+    shardings = input_shardings(mesh)
+    res_sh = tuple(getattr(shardings, f) for f in RESIDENT_FIELDS)
+    wave_sh = tuple(getattr(shardings, f) for f in WAVE_FIELDS)
+    rep = NamedSharding(mesh, P())
+
+    def run(resident, wave):
+        kw = dict(zip(RESIDENT_FIELDS, resident))
+        kw.update(zip(WAVE_FIELDS, wave))
+        return solve_jit(SolverInputs(**kw), pol=pol, gangs=gangs)
+
+    return jax.jit(run, in_shardings=(res_sh, wave_sh),
+                   out_shardings=(rep, rep),
+                   donate_argnums=(1,) if donate else ())

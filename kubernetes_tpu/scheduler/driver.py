@@ -171,6 +171,15 @@ class SchedulerConfig:
     # speculative encode is verified against actual commit outcomes before
     # wave k+1 ever dispatches (scheduler/tpu_batch.py divergence protocol).
     pipeline: bool = False
+    # Device-mesh solve for the IN-PROCESS path (kube-scheduler --mesh):
+    # "auto" shards waves above parallel.mesh.DEFAULT_MESH_MIN_NODES over
+    # the attached device mesh when >1 device exists, "on" requires one,
+    # "off" pins single-device. A solver_addr daemon carries its own
+    # --mesh flag; this one covers workers solving in-process (and the
+    # RemoteSolver fallback path). Decisions are bit-identical either way
+    # (parallel/mesh.py contract).
+    mesh: str = "auto"
+    pods_axis: int = 1
 
 
 class Scheduler:
@@ -298,7 +307,8 @@ class ConfigFactory:
                policy: Optional[schedplugins.Policy] = None,
                algorithm_override=None,
                recorder: Optional[EventRecorder] = None,
-               solver_addr: str = "", pipeline: bool = False
+               solver_addr: str = "", pipeline: bool = False,
+               mesh: str = "auto", pods_axis: int = 1
                ) -> SchedulerConfig:
         """ref: factory.go:77-172 CreateFromProvider/CreateFromConfig/
         CreateFromKeys."""
@@ -351,6 +361,8 @@ class ConfigFactory:
             policy=policy,
             solver_addr=solver_addr,
             pipeline=pipeline,
+            mesh=mesh,
+            pods_axis=pods_axis,
         )
 
     def stop(self, join: bool = False, timeout: float = 2.0) -> bool:

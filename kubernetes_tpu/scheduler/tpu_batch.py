@@ -211,6 +211,17 @@ class BatchScheduler:
         # inherits the config's recorded --pipeline flag
         self.pipeline = bool(getattr(config, "pipeline", False)
                              if pipeline is None else pipeline)
+        # in-process device-mesh solve (kube-scheduler --mesh): resolved
+        # once — None when single-device or off. Waves above the node
+        # floor then take parallel.mesh.solve_sharded (its measured
+        # kernel-vs-mesh crossover included); bit-identical either way.
+        from kubernetes_tpu.parallel.mesh import maybe_mesh
+        self._mesh = maybe_mesh(getattr(config, "mesh", "auto"),
+                                getattr(config, "pods_axis", 1))
+        if self.solver is not None and self._mesh is not None:
+            # a daemon wave solves under the daemon's own --mesh; this
+            # covers the in-process fallback when the daemon is away
+            self.solver.fallback_mesh = self._mesh
         try:
             # delta-maintained node planes + sticky vocabularies: per-wave
             # encode cost is O(changed pods), and pow-2 bucketing keeps the
@@ -307,7 +318,7 @@ class BatchScheduler:
         if self.solver is not None:
             chosen, _ = self.solver.solve(snap)
         else:
-            chosen, _ = solve(snap)
+            chosen, _ = solve(snap, mesh=self._mesh)
         _wave_metrics().solve.observe(time.perf_counter() - t0)
         _wave_metrics().pods.inc(by=n_pending)
         return decisions_to_names(snap, chosen)

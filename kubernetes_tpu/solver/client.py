@@ -83,6 +83,9 @@ class RemoteSolver:
         # delta wire (protocol v2): ship O(changed-rows) plane deltas
         # against a daemon-side resident cache; False pins full frames
         self.delta = delta
+        # device mesh for the IN-PROCESS fallback path (the daemon runs
+        # its own MeshExecutor); set by the scheduler from its --mesh flag
+        self.fallback_mesh = None
         self._wid = uuid.uuid4().hex[:12]
         self._local = threading.local()
         self._lock = threading.Lock()
@@ -302,7 +305,7 @@ class RemoteSolver:
             if not self.fallback:
                 raise SolverUnavailable("kube-solverd in unhealthy cooldown")
             self.fallback_waves += 1
-            return solve_in_process(snap)
+            return solve_in_process(snap, mesh=self.fallback_mesh)
         pol = snap.policy or BatchPolicy()
         gangs = snap.has_gangs
         host = snapshot_to_host_inputs(snap)
@@ -314,13 +317,15 @@ class RemoteSolver:
             self.busy_waves += 1
             if not self.fallback:
                 raise
-            return solve_in_process(snap, host=host)
+            return solve_in_process(snap, host=host,
+                                    mesh=self.fallback_mesh)
         except (SolverUnavailable, protocol.SolverProtocolError):
             self._mark_unhealthy()
             if not self.fallback:
                 raise
             self.fallback_waves += 1
-            return solve_in_process(snap, host=host)
+            return solve_in_process(snap, host=host,
+                                    mesh=self.fallback_mesh)
         self.remote_waves += 1
         if gangs:
             chosen = gang.apply_all_or_nothing(snap.pod_rid, chosen)

@@ -207,15 +207,23 @@ def _solverd_metrics() -> _SolverdMetrics:
 
 
 class _Req:
-    __slots__ = ("inp", "pol", "gangs", "p", "conn", "send_lock")
+    __slots__ = ("inp", "pol", "gangs", "p", "conn", "send_lock",
+                 "cache_key", "delta")
 
-    def __init__(self, inp, pol, gangs, p, conn, send_lock):
+    def __init__(self, inp, pol, gangs, p, conn, send_lock,
+                 cache_key=None, delta=None):
         self.inp = inp          # host-side SolverInputs (numpy)
         self.pol = pol
         self.gangs = gangs
         self.p = p              # requester's pod-axis length (reply slice)
         self.conn = conn
         self.send_lock = send_lock
+        # delta-wire residency handles for the mesh executor: the cache
+        # entry this wave belongs to and, per changed plane, the
+        # (base, rows, vals) triple whose device twin can be applied as
+        # an on-device scatter instead of a full re-transfer
+        self.cache_key = cache_key
+        self.delta = delta
 
 
 class SolverService:
@@ -225,12 +233,33 @@ class SolverService:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  gather_window_s: float = 0.003, max_batch: int = 16,
-                 max_queue: int = 64, cache_entries: int = 64):
+                 max_queue: int = 64, cache_entries: int = 64,
+                 mesh: str = "auto", pods_axis: int = 1,
+                 mesh_min_nodes=None, mesh_dispatch: str = "auto",
+                 mesh_probe: str = "first"):
         from kubernetes_tpu.models.batch_solver import ensure_x64
         ensure_x64()  # spread_score's exact-rounding emulation needs x64
         self.gather_window_s = gather_window_s
         self.max_batch = max_batch
         self.max_queue = max_queue
+        # device-mesh production dispatch (solver/mesh_exec.py): auto-on
+        # when more than one device is attached; single-wave groups above
+        # the node floor then solve from device-resident sharded planes
+        self._mesh_exec = None
+        import jax
+
+        from kubernetes_tpu.parallel.mesh import maybe_mesh
+        if maybe_mesh(mesh, pods_axis) is not None:
+            from kubernetes_tpu.solver.mesh_exec import MeshExecutor
+            self._mesh_exec = MeshExecutor(
+                pods_axis=pods_axis, min_nodes=mesh_min_nodes,
+                dispatch=mesh_dispatch, probe=mesh_probe,
+                cache_entries=cache_entries)
+            _log.info("mesh dispatch enabled: %d devices, pods_axis=%d, "
+                      "node_shards=%d, min_nodes=%d, dispatch=%s",
+                      jax.device_count(), pods_axis,
+                      self._mesh_exec.node_shards,
+                      self._mesh_exec.min_nodes, mesh_dispatch)
         # delta-wire resident plane cache: (wid, bucket) -> {"epoch": n,
         # "planes": {field: np.ndarray}} — arrays are immutable by
         # convention (copy-on-write on delta apply), LRU-bounded
@@ -418,6 +447,7 @@ class SolverService:
         shipped = sum(a.nbytes for a in arrays)
         cache_key = epoch = None
         new_planes: Dict[str, np.ndarray] = {}
+        delta_updates: Dict[str, tuple] = {}
         is_delta = False
         if planes is None:
             # v1-style full frame: every field present, nothing cached
@@ -488,6 +518,10 @@ class SolverService:
                         arr = base.copy()
                         arr[rows.astype(np.int64)] = vals
                         new_planes[name] = arr
+                        # the mesh executor can replay this as an
+                        # on-device scatter when its resident buffer
+                        # still matches `base` by identity
+                        delta_updates[name] = (base, rows, vals)
                         cols.append(arr)
                     else:
                         reject("SolverProtocolError",
@@ -503,7 +537,8 @@ class SolverService:
                 reject("SolverProtocolError", "trailing arrays in frame")
                 return
         inp = SolverInputs(*cols)
-        req = _Req(inp, pol, gangs, int(inp.req.shape[0]), conn, send_lock)
+        req = _Req(inp, pol, gangs, int(inp.req.shape[0]), conn, send_lock,
+                   cache_key=cache_key, delta=delta_updates or None)
         with self._cond:
             if len(self._pending) >= self.max_queue:
                 busy = True
@@ -605,6 +640,37 @@ class SolverService:
 
     def _solve_group(self, reqs: List[_Req]) -> None:
         pol, gangs = reqs[0].pol, reqs[0].gangs
+        # kernel-vs-mesh-vs-single dispatch (docs/design/solver.md): a
+        # single-wave group above the mesh executor's node floor solves
+        # from device-resident sharded planes at its EXACT resident shape
+        # (no pow-2 node pad, pod planes donated, deltas applied on
+        # device); coalesced multi-wave groups and small waves keep the
+        # padded jit(vmap) path below, whose pow-2 bucketing exists for
+        # exactly those heterogeneous batches.
+        me = self._mesh_exec
+        if me is not None and len(reqs) == 1 \
+                and me.eligible(reqs[0].inp, pol, gangs):
+            r = reqs[0]
+            t0 = time.perf_counter()
+            chosen, scores = me.solve(r.inp, pol, gangs,
+                                      cache_key=r.cache_key, delta=r.delta)
+            dt = time.perf_counter() - t0
+            self.solve_calls += 1
+            self.waves_served += 1
+            self._m.solves.inc()
+            self._m.waves.inc()
+            self._m.batch.observe(1)
+            self._m.solve_s.observe(dt)
+            self._m.requests.inc("ok")
+            try:
+                with r.send_lock:
+                    protocol.send_msg(
+                        r.conn, {"ok": True, "coalesced": 1},
+                        (np.ascontiguousarray(chosen[:r.p]),
+                         np.ascontiguousarray(scores[:r.p])))
+            except OSError:
+                _log.debug("requester went away before its reply")
+            return
         target = _target_dims([_dims_of(r.inp) for r in reqs])
         padded = [_pad_inputs(r.inp, target) for r in reqs]
         B = _pow2_pad(len(padded), minimum=1)

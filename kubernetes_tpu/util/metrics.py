@@ -14,7 +14,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["Counter", "Gauge", "Histogram", "Registry", "default_registry",
            "DEFAULT_BUCKETS", "APISERVER_BUCKETS",
-           "SolverdDeltaMetrics", "solverd_delta_metrics"]
+           "SolverdDeltaMetrics", "solverd_delta_metrics",
+           "SolverdMeshMetrics", "solverd_mesh_metrics"]
 
 # ref: apiserver.go:60-61 — the expected request-latency envelope, in seconds.
 APISERVER_BUCKETS = (0.125, 0.25, 0.5, 1.0, 2.0, 4.0, 8.0)
@@ -243,3 +244,71 @@ def solverd_delta_metrics() -> SolverdDeltaMetrics:
     if SolverdDeltaMetrics._singleton is None:
         SolverdDeltaMetrics._singleton = SolverdDeltaMetrics()
     return SolverdDeltaMetrics._singleton
+
+
+class SolverdMeshMetrics:
+    """The ``solverd_mesh_*`` family — the device-mesh production solve
+    (solver/mesh_exec.py): mesh topology, per-wave host->device transfer
+    traffic split into delta-applies vs full re-establishes (resharding),
+    the device-resident plane footprint (shard_memory_report), and the
+    single-device parity probe that keeps the mesh path bit-identity
+    evidence live in every run. Scraped into the CHURN_MP record's
+    ``solverd.mesh`` section alongside the solve quantiles (the contract
+    tests/test_bench_record.py enforces from r09 on)."""
+
+    _singleton = None
+
+    def __init__(self, registry: Optional[Registry] = None):
+        reg = registry or default_registry()
+        self.devices = reg.gauge(
+            "solverd_mesh_devices",
+            "Devices in the solver mesh (0 = mesh dispatch disabled)")
+        self.pods_axis = reg.gauge(
+            "solverd_mesh_pods_axis", "Mesh 'pods' axis length")
+        self.node_shards = reg.gauge(
+            "solverd_mesh_node_shards",
+            "Node-axis shards of the ACTIVE solve layout (1 = the "
+            "measured dispatch chose the single-device submesh)")
+        self.waves = reg.counter(
+            "solverd_mesh_waves_total",
+            "Waves solved through the mesh executor's device-resident "
+            "path (vs the padded vmap fallback)")
+        self.transfer_bytes = reg.counter(
+            "solverd_mesh_transfer_bytes_total",
+            "Host->device bytes moved per wave (delta-row scatters + "
+            "per-wave pod planes)")
+        self.reshard_bytes = reg.counter(
+            "solverd_mesh_reshard_bytes_total",
+            "Host->device bytes re-established for planes that SHOULD "
+            "have been resident (cold buckets, evictions, out-of-order "
+            "bases) — the number back-to-back waves must keep near zero")
+        self.resident_bytes = reg.gauge(
+            "solverd_mesh_resident_bytes",
+            "Device-resident solver plane bytes across all cache entries")
+        self.shard_bytes_per_device = reg.gauge(
+            "solverd_mesh_shard_bytes_per_device",
+            "shard_memory_report total for the newest resident bucket "
+            "(planes + scan carry, per device)")
+        self.solve_s = reg.histogram(
+            "solverd_mesh_solve_seconds",
+            "Mesh-executor solve wall time per wave",
+            buckets=(0.05, 0.1, 0.25, 0.5, 0.75, 1.0, 1.5, 2.0, 3.0,
+                     5.0, 10.0))
+        self.single_probe_s = reg.histogram(
+            "solverd_mesh_single_device_seconds",
+            "Single-device probe solves of mesh-path waves (the in-run "
+            "vs-single-device comparison the churn record carries)",
+            buckets=(0.05, 0.1, 0.25, 0.5, 0.75, 1.0, 1.5, 2.0, 3.0,
+                     5.0, 10.0))
+        self.parity_checks = reg.counter(
+            "solverd_mesh_parity_checks_total",
+            "Mesh-path waves re-solved on one device and compared bitwise")
+        self.parity_divergent = reg.counter(
+            "solverd_mesh_parity_divergent_total",
+            "Parity probes whose decisions diverged (must stay 0)")
+
+
+def solverd_mesh_metrics() -> SolverdMeshMetrics:
+    if SolverdMeshMetrics._singleton is None:
+        SolverdMeshMetrics._singleton = SolverdMeshMetrics()
+    return SolverdMeshMetrics._singleton

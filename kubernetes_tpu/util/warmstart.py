@@ -32,7 +32,8 @@ import logging
 import os
 from typing import Optional
 
-__all__ = ["cache_dir", "enable", "enabled", "router_cal_path"]
+__all__ = ["cache_dir", "enable", "enabled", "router_cal_path",
+           "mesh_cal_path"]
 
 _log = logging.getLogger("kubernetes_tpu.util.warmstart")
 
@@ -55,6 +56,15 @@ def cache_dir() -> str:
 
 def router_cal_path(base: Optional[str] = None) -> str:
     return os.path.join(base or cache_dir(), "router_cal.json")
+
+
+def mesh_cal_path(base: Optional[str] = None) -> str:
+    """Mesh-dispatch calibration store (solver/mesh_exec.MeshExecutor):
+    sharded-vs-single-device timings keyed by (backend, device count,
+    pods_axis, plane shape), so a restarted daemon skips the one-time
+    crossover probe the same way the router skips its host-vs-device
+    calibration."""
+    return os.path.join(base or cache_dir(), "mesh_cal.json")
 
 
 def enable(base: Optional[str] = None) -> Optional[str]:

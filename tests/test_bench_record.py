@@ -162,6 +162,31 @@ def test_churn_record_schema_apiserver_fields_gated_by_round():
     assert "apiserver" in churn_mp.validate_record(rec, round_no=8)
 
 
+def test_churn_record_schema_mesh_section_gated_by_round():
+    """r08 records predate the mesh-sharded solve; r09+ must carry the
+    solverd.mesh section (device count, pods_axis, mesh-vs-single solve
+    p50, reshard bytes, parity) whenever the run had a daemon."""
+    churn_mp = _load_churn_mp()
+    rec = _churn_sample_record()
+    assert churn_mp.validate_record(rec, round_no=8) == []
+    missing = churn_mp.validate_record(rec, round_no=9)
+    assert "solverd.mesh" in missing
+    rec["solverd"]["mesh"] = {
+        "devices": 8, "pods_axis": 1, "node_shards": 8, "waves": 50,
+        "transfer_bytes": 1_000_000, "reshard_bytes": 0,
+        "resident_bytes": 90_000_000, "shard_bytes_per_device": 12_000_000,
+        "solve_p50_ms": 700.0, "single_device_p50_ms": 1600.0,
+        "solve_waves": 50, "single_device_probes": 1,
+        "parity_checks": 1, "parity_divergent": 0,
+    }
+    assert churn_mp.validate_record(rec, round_no=9) == []
+    del rec["solverd"]["mesh"]["reshard_bytes"]
+    del rec["solverd"]["mesh"]["parity_divergent"]
+    missing = churn_mp.validate_record(rec, round_no=9)
+    assert "solverd.mesh.reshard_bytes" in missing
+    assert "solverd.mesh.parity_divergent" in missing
+
+
 def test_committed_churn_records_conform():
     """Every committed CHURN_MP record from r07 on must satisfy the
     schema (r08+ additionally the apiserver hot-path fields) — the
