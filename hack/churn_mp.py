@@ -348,7 +348,11 @@ def _hist_quantile(buckets, count: float, q: float) -> float:
     for le, n in buckets:
         if n >= target:
             if le == float("inf"):
-                return prev_le
+                # the rank fell beyond the largest bounded bucket: report
+                # the overflow loudly (Histogram.quantile semantics —
+                # widen the envelope rather than trusting a capped
+                # in-envelope-looking number)
+                return float("inf")
             span = n - prev_n
             frac = (target - prev_n) / span if span else 1.0
             return prev_le + (le - prev_le) * frac
@@ -518,6 +522,14 @@ SOLVERD_MESH_FIELDS = ("devices", "pods_axis", "node_shards", "waves",
                        "shard_bytes_per_device", "solve_p50_ms",
                        "single_device_p50_ms", "parity_checks",
                        "parity_divergent")
+# Pod-lifecycle latency evidence (kube-trace + PodLatencyMetrics),
+# required from r10 on: per-pod e2e quantiles, the bind->watch-observe
+# leg, and the trace-collection health counters (shard count, spans
+# dropped) so a record claiming "overhead proven" also proves the
+# instrument itself wasn't silently lossy.
+LATENCY_FIELDS = ("e2e_count", "e2e_p50_s", "e2e_p95_s", "e2e_p99_s",
+                  "watch_observe_count", "watch_observe_p50_s",
+                  "trace_shards", "spans_dropped")
 
 
 def validate_record(rec: dict, round_no: int = 8) -> list:
@@ -550,10 +562,98 @@ def validate_record(rec: dict, round_no: int = 8) -> list:
         elif "error" not in ap:
             missing += [f"apiserver.{k}" for k in APISERVER_FIELDS
                         if k not in ap]
+    if round_no >= 10:
+        # r10 introduced the pod-lifecycle latency section (kube-trace);
+        # every later record must carry it so the e2e view can't be
+        # silently dropped (earlier records grandfathered by this gate)
+        lat = rec.get("latency")
+        if not isinstance(lat, dict):
+            missing.append("latency")
+        elif "error" not in lat:
+            missing += [f"latency.{k}" for k in LATENCY_FIELDS
+                        if k not in lat]
     cb = rec.get("cpu_budget_s")
     if cb is not None and not isinstance(cb, dict):
         missing.append("cpu_budget_s:not-a-dict")
     return missing
+
+
+def _scrape_pod_latency(ports) -> dict:
+    """Pod-lifecycle latency quantiles (util/metrics.PodLatencyMetrics)
+    merged across every scheduler worker's /metrics: create ->
+    bind-committed (e2e) and bind -> watcher-observed. The histograms
+    are always on; this is the causal per-pod view of where the 1000/s
+    contract's latency goes, scraped into the record's ``latency``
+    section (required for r10+ records)."""
+    merged = {}
+    for port in ports:
+        raw = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+        for base, key in (("pod_e2e_scheduling_seconds", "e2e"),
+                          ("pod_watch_observe_seconds", "watch_observe")):
+            total, count, buckets = _parse_hist(raw, base)
+            m = merged.setdefault(key, [0.0, 0.0, {}])
+            m[0] += total
+            m[1] += count
+            for le, n in buckets:
+                m[2][le] = m[2].get(le, 0.0) + n
+    out = {}
+    for key, (total, count, bmap) in merged.items():
+        buckets = sorted(bmap.items())
+        out[f"{key}_count"] = int(count)
+        # Histogram.quantile semantics (util/metrics.py): an empty
+        # histogram has NO quantiles — emit null, never a fake 0.0, so
+        # a dead instrument fails loudly in the record instead of
+        # conforming with plausible-looking zeros
+        out[f"{key}_mean_s"] = round(total / count, 4) if count else None
+        for q, name in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+            out[f"{key}_{name}_s"] = round(
+                _hist_quantile(buckets, count, q), 4) if count else None
+    return out
+
+
+def _collect_trace_shards(master: str, ports, n_api: int = 1):
+    """Drain every process's GET /debug/trace span ring -> one shard
+    per pid. With N apiserver workers sharing the listen port via
+    SO_REUSEPORT, each GET lands on an arbitrary worker — draining is
+    destructive-read with a cursor, so the shared port is hit until
+    every one of the N worker pids has answered (or the attempt budget
+    runs out — a missed worker is REPORTED, never silently absent), and
+    re-drains of an already-seen pid just merge as incremental spans.
+    Returns (shards, drain_errors, api_workers_seen)."""
+    shards = {}
+    errors = 0
+
+    def merge(sh):
+        pid = sh.get("pid")
+        cur = shards.get(pid)
+        if cur is None:
+            shards[pid] = sh
+        else:
+            cur["spans"] = list(cur.get("spans", ())) + \
+                list(sh.get("spans", ()))
+            cur["dropped"] = int(cur.get("dropped", 0)) + \
+                int(sh.get("dropped", 0))
+            cur["written"] = max(int(cur.get("written", 0)),
+                                 int(sh.get("written", 0)))
+        return pid
+
+    api_pids = set()
+    for _ in range(max(8, 16 * n_api)):
+        if len(api_pids) >= n_api:
+            break
+        try:
+            api_pids.add(merge(json.loads(urllib.request.urlopen(
+                f"{master}/debug/trace", timeout=10).read())))
+        except Exception:
+            errors += 1
+    for port in ports:
+        try:
+            merge(json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/trace", timeout=10).read()))
+        except Exception:
+            errors += 1
+    return list(shards.values()), errors, len(api_pids)
 
 
 def _scrape_pipeline(port: int) -> dict:
@@ -677,6 +777,17 @@ def main(argv=None) -> int:
                     "offered rate is bounded by depth x feeders / server "
                     "latency, so a latency-bound run needs more depth, "
                     "not more feeder CPU")
+    ap.add_argument("--trace", action="store_true",
+                    help="kube-trace: run every child (--trace on "
+                    "apiservers, schedulers, solverd), drain each "
+                    "process's /debug/trace span ring at the end of the "
+                    "run, and merge the shards on the shared monotonic "
+                    "clock into ONE Chrome-trace-event / "
+                    "Perfetto-loadable JSON artifact next to --out")
+    ap.add_argument("--trace-device", default="",
+                    help="pass through to kube-solverd --trace-device: "
+                    "jax.profiler device trace directory (empty "
+                    "disables)")
     ap.add_argument("--port", type=int, default=18410)
     ap.add_argument("--out", default=None)
     ap.add_argument("--platform", choices=["cpu", "ambient"], default="cpu",
@@ -725,10 +836,12 @@ def main(argv=None) -> int:
                 spawn(f"apiserver{w}", PY, "-m",
                       "kubernetes_tpu.cmd.apiserver",
                       "--port", str(args.port), "--reuse-port",
-                      "--store-server", f"127.0.0.1:{store_port}")
+                      "--store-server", f"127.0.0.1:{store_port}",
+                      *(["--trace"] if args.trace else []))
         else:
             spawn("apiserver", PY, "-m", "kubernetes_tpu.cmd.apiserver",
-                  "--port", str(args.port))
+                  "--port", str(args.port),
+                  *(["--trace"] if args.trace else []))
         deadline = time.time() + 60
         while time.time() < deadline:
             try:
@@ -786,6 +899,9 @@ def main(argv=None) -> int:
                   "--mesh", args.mesh,
                   "--pods-axis", str(args.pods_axis),
                   "--mesh-dispatch", args.mesh_dispatch,
+                  *(["--trace"] if args.trace else []),
+                  *(["--trace-device", args.trace_device]
+                    if args.trace_device else []),
                   env=sd_env)
             # the daemon must own its socket before any worker's first
             # wave, or every worker starts in the fallback cooldown
@@ -812,6 +928,8 @@ def main(argv=None) -> int:
                 cmd += ["--solver-addr", solver_addr]
             if args.pipeline:
                 cmd += ["--pipeline"]
+            if args.trace:
+                cmd += ["--trace"]
             spawn(f"scheduler{w}", *cmd)
 
         # Bind counting rides a WATCH, not list polling: a full
@@ -1099,7 +1217,59 @@ def main(argv=None) -> int:
                     for k in pipes[0]}
             except Exception as e:
                 record["pipeline"] = {"error": f"scrape failed: {e}"}
-        missing = validate_record(record)
+        # pod-lifecycle latency: always scraped (the histograms are
+        # metrics, on regardless of --trace) and logged as quantiles at
+        # the end of every run; required in r10+ records
+        try:
+            latency = _scrape_pod_latency(sched_metrics_ports)
+            print("[churn-mp] pod e2e scheduling p50/p95/p99 = "
+                  f"{latency.get('e2e_p50_s', 0)}/"
+                  f"{latency.get('e2e_p95_s', 0)}/"
+                  f"{latency.get('e2e_p99_s', 0)} s over "
+                  f"{latency.get('e2e_count', 0)} pods; bind->watch "
+                  f"observe p50/p95 = "
+                  f"{latency.get('watch_observe_p50_s', 0)}/"
+                  f"{latency.get('watch_observe_p95_s', 0)} s",
+                  file=sys.stderr, flush=True)
+        except Exception as e:
+            latency = {"error": f"latency scrape failed: {e}"}
+        if args.trace:
+            # drain every process's span ring and merge the shards into
+            # one Perfetto-loadable artifact next to --out
+            ports = list(sched_metrics_ports)
+            if solver_addr:
+                ports.append(solverd_metrics_port)
+            shards, drain_errors, api_seen = _collect_trace_shards(
+                master, ports, args.apiservers)
+            latency["trace_shards"] = len(shards)
+            latency["trace_spans"] = sum(
+                len(s.get("spans", ())) for s in shards)
+            latency["spans_dropped"] = sum(
+                int(s.get("dropped", 0)) for s in shards)
+            latency["trace_drain_errors"] = drain_errors
+            if api_seen < args.apiservers:
+                # a whole worker's shard is missing — disclose it in the
+                # record; the merged trace is partial, not lossless
+                latency["trace_api_workers_missed"] = \
+                    args.apiservers - api_seen
+                print(f"[churn-mp] WARNING: drained only {api_seen}/"
+                      f"{args.apiservers} apiserver worker trace shards",
+                      file=sys.stderr, flush=True)
+            if args.out:
+                from kubernetes_tpu.util import tracing
+                trace_path = re.sub(r"\.json$", "", args.out) \
+                    + "_trace.json"
+                tracing.dump_chrome(shards, trace_path)
+                latency["trace_file"] = os.path.basename(trace_path)
+                print(f"[churn-mp] merged trace ({latency['trace_spans']} "
+                      f"spans, {latency['trace_shards']} shards) -> "
+                      f"{trace_path} (open at ui.perfetto.dev)",
+                      file=sys.stderr, flush=True)
+        else:
+            latency.setdefault("trace_shards", 0)
+            latency.setdefault("spans_dropped", 0)
+        record["latency"] = latency
+        missing = validate_record(record, round_no=10)
         if missing:
             print(f"[churn-mp] WARNING: record missing contract fields: "
                   f"{missing}", file=sys.stderr, flush=True)

@@ -36,7 +36,8 @@ if [[ "$RACE" == 1 ]]; then
             tests/test_kubelet.py tests/test_process_runtime.py
             tests/test_controllers.py tests/test_scheduler.py
             tests/test_integration.py tests/test_solverd.py
-            tests/test_incremental.py tests/test_parallel.py)
+            tests/test_incremental.py tests/test_parallel.py
+            tests/test_tracing.py)
     rc=0
     for ((i = 1; i <= ROUNDS; i++)); do
         echo "=== race round ${i}/${ROUNDS} (switchinterval=1e-6) ==="

@@ -42,6 +42,7 @@ from kubernetes_tpu.api import errors
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.auth import AuthRequest
 from kubernetes_tpu.util import metrics as metrics_pkg
+from kubernetes_tpu.util import tracing
 
 _httplog = logging.getLogger("kubernetes_tpu.apiserver.httplog")
 
@@ -377,6 +378,13 @@ class _Handler(BaseHTTPRequestHandler):
         # Always drain the body up front: unread bytes would desync the
         # keep-alive connection (next request parses them as a request line).
         raw_body = self._read_body()
+        # kube-trace: a request carrying X-KTPU-Trace joins its caller's
+        # trace (the scheduler wave's commit leg, a client's list). Only
+        # traced requests record spans — untraced churn traffic must not
+        # fill the ring. One header lookup when tracing is on; zero cost
+        # when off.
+        self._trace_ctx = tracing.parse(
+            self.headers.get(tracing.HEADER)) if tracing.enabled() else None
         try:
             # read-only / rate-limit serving modes. The reference nests
             # ReadOnly(RateLimit(handler)) (handlers.go, wired by
@@ -394,7 +402,15 @@ class _Handler(BaseHTTPRequestHandler):
                                         extra_headers=(("Retry-After", "1"),))
                 return
             user = self._authenticate(apisrv)
-            code = self._dispatch_path(method, parts, query, user, raw_body)
+            if self._trace_ctx is not None:
+                with tracing.span("http." + verb_label,
+                                  parent=self._trace_ctx,
+                                  path=parsed.path):
+                    code = self._dispatch_path(method, parts, query, user,
+                                               raw_body)
+            else:
+                code = self._dispatch_path(method, parts, query, user,
+                                           raw_body)
         except errors.StatusError as e:
             code = e.code
             self._send_status_error(e, self._version_of(parts))
@@ -489,6 +505,15 @@ class _Handler(BaseHTTPRequestHandler):
             return 200 if ok else 500
         if head == "debug" and len(parts) >= 2 and parts[1] == "pprof":
             return self._handle_pprof(parts[2:], query)
+        if head == "debug" and len(parts) >= 2 and parts[1] == "trace":
+            # drain this process's span ring (kube-trace shard); the churn
+            # harness merges every process's shard into one Perfetto file.
+            # ?peek=1 reads without resetting the drain cursor.
+            if method != "GET":
+                raise errors.new_method_not_supported("trace", method)
+            self._send_json(200, json.dumps(tracing.drain(
+                reset=query.get("peek") not in ("1", "true"))))
+            return 200
         if head != "api":
             raise errors.new_not_found("path", "/" + "/".join(parts))
         if len(parts) == 1:
@@ -728,6 +753,10 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
         self.send_header("Transfer-Encoding", "chunked")
+        if getattr(self, "_trace_ctx", None) is not None:
+            # echo the stream's trace context so the client can stamp
+            # frame-observation spans onto the same trace
+            self.send_header(tracing.HEADER, tracing.wire(self._trace_ctx))
         self.end_headers()
         try:
             lagged = False
@@ -769,6 +798,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Connection", "Upgrade")
         self.send_header("Sec-WebSocket-Accept", ws.accept_key(
             self.headers.get("Sec-WebSocket-Key", "")))
+        if getattr(self, "_trace_ctx", None) is not None:
+            self.send_header(tracing.HEADER, tracing.wire(self._trace_ctx))
         self.end_headers()
 
         # one writer lock: PONGs from the reader thread and event frames

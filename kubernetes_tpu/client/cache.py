@@ -58,12 +58,30 @@ class Store:
         self.key_func = key_func
         self._version = 0
         self._log: deque = deque(maxlen=self._LOG_MAX)  # (ver, op, obj)
+        self._observers: list = []
+
+    def subscribe(self, fn: Callable[[Any], None]) -> None:
+        """Register a post-set observer: called with each object as it
+        lands via add/update (NOT replace — a relist is a resync, not a
+        delivery). The seam the wave scheduler uses to timestamp when its
+        own watch stream observes a bound pod
+        (``pod_watch_observe_seconds``). Observers run on the reflector's
+        delivery thread, outside the store lock — they must be cheap and
+        must not raise."""
+        with self._lock:
+            self._observers.append(fn)
 
     def add(self, obj: Any) -> None:
         with self._lock:
             self._items[self.key_func(obj)] = obj
             self._version += 1
             self._log.append((self._version, "set", obj))
+            observers = self._observers
+        for fn in observers:
+            try:
+                fn(obj)
+            except Exception:
+                pass
 
     def update(self, obj: Any) -> None:
         self.add(obj)

@@ -25,6 +25,7 @@ from kubernetes_tpu import watch as watchpkg
 from kubernetes_tpu.api import errors
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.api.latest import scheme as default_scheme
+from kubernetes_tpu.util import tracing
 
 __all__ = ["HTTPTransport"]
 
@@ -242,12 +243,18 @@ class HTTPTransport:
         parsed = urllib.parse.urlsplit(url)
         path = parsed.path + ("?" + parsed.query if parsed.query else "")
         idempotent = method in ("GET", "HEAD")
+        headers = dict(self._headers)
+        if tracing.enabled():
+            # propagate the caller's ambient span (the wave's commit /
+            # list leg) so the apiserver's handler span joins its trace
+            w = tracing.wire()
+            if w:
+                headers[tracing.HEADER] = w
         for attempt in (0, 1):
             conn = self._conn()
             sent = False
             try:
-                conn.request(method, path, body=body,
-                             headers=dict(self._headers))
+                conn.request(method, path, body=body, headers=headers)
                 sent = True
                 resp = conn.getresponse()
                 raw = resp.read()
@@ -320,6 +327,10 @@ class HTTPTransport:
         path = parsed.path + ("?" + parsed.query if parsed.query else "")
         headers = {k: v for k, v in self._headers.items()
                    if k.lower() != "content-type"}
+        if tracing.enabled():
+            w = tracing.wire()
+            if w:
+                headers[tracing.HEADER] = w
         conn.request("GET", path, headers=headers)
         resp = conn.getresponse()
         if resp.status >= 400:

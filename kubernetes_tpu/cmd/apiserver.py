@@ -68,6 +68,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "watcher lagging past it is dropped to resync "
                         "(410 ERROR frame; the client re-lists). "
                         "0 disables.")
+    p.add_argument("--trace", action="store_true",
+                   help="kube-trace: record handler/store spans for "
+                        "requests carrying an X-KTPU-Trace header (a "
+                        "scheduler wave's commit leg); drain via "
+                        "GET /debug/trace. Default OFF — untraced "
+                        "requests never record.")
     return p
 
 
@@ -148,6 +154,9 @@ def apiserver_server(argv: List[str],
     except argparse.ArgumentError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    if getattr(opts, "trace", False):
+        from kubernetes_tpu.util import tracing
+        tracing.enable("apiserver")
     srv = build_server(opts)
     srv.start()
     print(f"kube-apiserver listening on {srv.base_url}", file=sys.stderr)

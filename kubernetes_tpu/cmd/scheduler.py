@@ -67,6 +67,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="serve /metrics, /healthz and /debug/pprof on this "
                         "port (0 disables; ref: the reference's healthz+"
                         "pprof mounts on every binary, master.go:431-435)")
+    p.add_argument("--trace", action="store_true",
+                   help="kube-trace: record spans for every wave "
+                        "(drain/prepare/encode/solve/commit) into this "
+                        "process's ring buffer and propagate trace context "
+                        "to the apiserver and kube-solverd; drain via "
+                        "GET /debug/trace on --metrics-port. Default OFF — "
+                        "the disabled path is a single branch per call "
+                        "site (docs/design/observability.md).")
     return p
 
 
@@ -94,6 +102,19 @@ def _serve_debug(port: int) -> None:
                 code, body = 200, "ok"
             elif self.path == "/metrics":
                 code, body = 200, default_registry().render_text()
+            elif self.path.startswith("/debug/trace"):
+                # kube-trace shard drain (?peek=1 reads without resetting
+                # the cursor) — the churn harness merges every process's
+                # shard into one Perfetto-loadable file
+                import json
+                import urllib.parse
+
+                from kubernetes_tpu.util import tracing
+                q = dict(urllib.parse.parse_qsl(
+                    urllib.parse.urlsplit(self.path).query))
+                code = 200
+                body = json.dumps(tracing.drain(
+                    reset=q.get("peek") not in ("1", "true")))
             else:
                 code, body = 404, "not found"
             raw = body.encode()
@@ -172,6 +193,9 @@ def scheduler_server(argv: List[str],
     except argparse.ArgumentError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    if getattr(opts, "trace", False):
+        from kubernetes_tpu.util import tracing
+        tracing.enable("scheduler")
     factory, sched = build_scheduler(opts)
     if getattr(opts, "metrics_port", 0):
         _serve_debug(opts.metrics_port)

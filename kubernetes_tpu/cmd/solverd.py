@@ -74,6 +74,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="live bit-identity probe: re-solve mesh-path "
                         "waves in the other layout and compare bitwise "
                         "(first = once per daemon run)")
+    p.add_argument("--trace", action="store_true",
+                   help="kube-trace: record queue-wait + solve spans, "
+                        "attached to the requesting wave's trace when the "
+                        "v3 frame carries one; drain via GET /debug/trace "
+                        "on --metrics-port. Default OFF.")
+    p.add_argument("--trace-device", "--trace_device", default="",
+                   help="directory for a jax.profiler device trace of the "
+                        "daemon's solves (open in Perfetto/TensorBoard "
+                        "alongside the kube-trace host spans). Empty "
+                        "disables. Orthogonal to --trace: this is XLA's "
+                        "own profiler, started at daemon boot and stopped "
+                        "on shutdown.")
     return p
 
 
@@ -91,6 +103,23 @@ def solverd_server(argv: List[str],
     # the daemon owns the hottest solver runtime in the topology: reuse
     # compiled wave programs + router calibrations across restarts
     warmstart.enable()
+    if opts.trace:
+        from kubernetes_tpu.util import tracing
+        tracing.enable("solverd")
+    device_trace = None
+    if opts.trace_device:
+        # XLA's own device profiler rides alongside the kube-trace host
+        # spans; failures are non-fatal (the CPU backend's profiler is
+        # optional in some jax builds)
+        try:
+            import jax.profiler as _jprof
+            _jprof.start_trace(opts.trace_device)
+            device_trace = _jprof
+            print(f"kube-solverd: jax device trace -> {opts.trace_device}",
+                  file=sys.stderr)
+        except Exception as e:  # pragma: no cover - env-dependent
+            print(f"kube-solverd: --trace-device unavailable: {e}",
+                  file=sys.stderr)
 
     srv = SolverService(host=opts.address, port=opts.port,
                         gather_window_s=opts.gather_window,
@@ -116,6 +145,13 @@ def solverd_server(argv: List[str],
           file=sys.stderr, flush=True)
     if ready is not None:
         ready.set()
+    def _stop_device_trace():
+        if device_trace is not None:
+            try:
+                device_trace.stop_trace()
+            except Exception:  # pragma: no cover - profiler teardown
+                pass
+
     if stop is None:
         try:
             srv.serve_forever()
@@ -123,10 +159,12 @@ def solverd_server(argv: List[str],
             pass
         finally:
             srv.stop()
+            _stop_device_trace()
         return 0
     srv.start()
     stop.wait()
     srv.stop()
+    _stop_device_trace()
     return 0
 
 

@@ -28,6 +28,7 @@ from kubernetes_tpu.api.labels import Selector
 from kubernetes_tpu.api.meta import accessor
 from kubernetes_tpu.runtime.serialize import now_rfc3339
 from kubernetes_tpu.storage.helper import StoreHelper
+from kubernetes_tpu.util import tracing
 
 __all__ = ["Context", "Strategy", "GenericRegistry", "default_attr_func"]
 
@@ -154,8 +155,13 @@ class GenericRegistry:
         if errs:
             raise errors.new_invalid(self.kind, m.name, errs)
         ttl = self.ttl_func(obj) if self.ttl_func else None
-        return self.helper.create_obj(self.key(ctx.with_namespace(m.namespace), m.name),
-                                      obj, ttl=ttl)
+        # store-write leg of the request's trace; child_span records only
+        # when this thread is inside a traced request (untraced churn
+        # creates stay out of the span ring)
+        with tracing.child_span("store.create", kind=self.kind):
+            return self.helper.create_obj(
+                self.key(ctx.with_namespace(m.namespace), m.name),
+                obj, ttl=ttl)
 
     def get(self, ctx: Context, name: str) -> Any:
         return self.helper.extract_obj(self.key(ctx, name), self.kind, name)
@@ -193,7 +199,8 @@ class GenericRegistry:
             # the caller's job on conflict (matches reference SetObj semantics)
             m.resource_version = accessor.resource_version(old)
         ttl = self.ttl_func(obj) if self.ttl_func else None
-        return self.helper.set_obj(key, obj, ttl=ttl)
+        with tracing.child_span("store.update", kind=self.kind):
+            return self.helper.set_obj(key, obj, ttl=ttl)
 
     def delete(self, ctx: Context, name: str) -> api.Status:
         self.helper.delete_obj(self.key(ctx, name), self.kind, name)

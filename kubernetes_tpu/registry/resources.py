@@ -27,6 +27,7 @@ from kubernetes_tpu.api import validation
 from kubernetes_tpu.api.meta import accessor
 from kubernetes_tpu.registry.generic import Context, GenericRegistry, Strategy
 from kubernetes_tpu.storage.helper import StoreHelper
+from kubernetes_tpu.util import tracing
 
 __all__ = [
     "make_pod_registry", "BindingREST", "PodStatusREST",
@@ -143,7 +144,8 @@ class BindingREST:
             updates.append((self.pods.key(ctx, name),
                             self._assign_fn(name, b.host)))
             slot_map.append(i)
-        outcomes = self.pods.helper.atomic_update_many(api.Pod, updates)
+        with tracing.child_span("store.bind_batch", bindings=len(updates)):
+            outcomes = self.pods.helper.atomic_update_many(api.Pod, updates)
         for i, oc in zip(slot_map, outcomes):
             if isinstance(oc, errors.StatusError):
                 results[i].error = oc.status.message

@@ -58,6 +58,8 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from collections import OrderedDict
+from datetime import timezone
 from typing import List, NamedTuple, Optional
 
 from kubernetes_tpu.api import types as api
@@ -70,7 +72,7 @@ from kubernetes_tpu.models.snapshot import encode_snapshot
 from kubernetes_tpu.runtime.clone import deep_clone
 from kubernetes_tpu.scheduler.driver import ConfigFactory, SchedulerConfig
 from kubernetes_tpu.scheduler.generic import FitError
-from kubernetes_tpu.util import metrics
+from kubernetes_tpu.util import metrics, tracing
 
 __all__ = ["BatchScheduler"]
 
@@ -171,6 +173,7 @@ class _Inflight(NamedTuple):
 
     fut: object            # Future -> decision host names
     pending: list          # the wave's ordered pods (snap row order)
+    tctx: object = None    # kube-trace wave context (None = untraced)
 
 
 class BatchScheduler:
@@ -235,6 +238,47 @@ class BatchScheduler:
         # until the first full sync establishes the resident planes
         self._delta_token = None
         self._stop = threading.Event()
+        # pod-lifecycle latency (always-on metrics; the kube-trace span
+        # layer is the opt-in causal complement): bind instants by uid,
+        # consumed when the assigned-pods reflector delivers the bound pod
+        # back through the scheduler's own watch stream. Bounded — a pod
+        # whose confirm never arrives must not leak the map.
+        self._pod_lat = metrics.pod_latency_metrics()
+        self._bind_t: "OrderedDict[str, float]" = OrderedDict()
+        # deliveries that beat the arming loop: the batch bind commits
+        # server-side before bind_many returns, so the reflector can
+        # deliver a bound pod while the commit loop is still arming —
+        # the observer stashes the instant here and the arming loop
+        # consumes it (losing the race must not lose the sample)
+        self._obs_t: "OrderedDict[str, float]" = OrderedDict()
+        self._bind_t_lock = threading.Lock()
+        store = getattr(factory, "scheduled_pods", None)
+        if store is not None and hasattr(store, "subscribe"):
+            store.subscribe(self._observe_scheduled)
+
+    _BIND_T_MAX = 1 << 16
+
+    def _observe_scheduled(self, pod) -> None:
+        """Store.subscribe hook (reflector delivery thread): the bound
+        pod came back through the watch — the fan-out leg of its path."""
+        try:
+            uid = pod.metadata.uid
+        except AttributeError:
+            return
+        now = time.monotonic()
+        with self._bind_t_lock:
+            t0 = self._bind_t.pop(uid, None)
+            if t0 is None:
+                # not armed (yet): either a re-delivery of an already-
+                # observed pod, a foreign scheduler's bind, or a delivery
+                # that RACED ahead of this scheduler's own arming loop.
+                # Stash the instant; the arming loop consumes it so the
+                # fastest deliveries are recorded (~0 s), not dropped.
+                self._obs_t[uid] = now
+                while len(self._obs_t) > self._BIND_T_MAX:
+                    self._obs_t.popitem(last=False)
+                return
+        self._pod_lat.watch_observe.observe(now - t0)
 
     # -- wave assembly ------------------------------------------------------
     def _drain_wave(self, timeout: Optional[float]) -> List[api.Pod]:
@@ -297,36 +341,42 @@ class BatchScheduler:
         return gang.order_wave(pending), nodes, services, get_existing
 
     # -- solving ------------------------------------------------------------
-    def _encode_wave(self, nodes, pending, services, get_existing):
+    def _encode_wave(self, nodes, pending, services, get_existing,
+                     tctx=None):
         t0 = time.perf_counter()
-        if self._encoder is not None:
-            snap = self._encode_incremental(nodes, pending, services,
-                                            get_existing)
-        else:
-            snap = encode_snapshot(nodes, get_existing(), pending, services,
-                                   policy=self.batch_policy)
+        with tracing.span("wave.encode", parent=tctx, pods=len(pending)):
+            if self._encoder is not None:
+                snap = self._encode_incremental(nodes, pending, services,
+                                                get_existing)
+            else:
+                snap = encode_snapshot(nodes, get_existing(), pending,
+                                       services, policy=self.batch_policy)
         _wave_metrics().encode.observe(time.perf_counter() - t0)
         return snap
 
-    def _solve_snap(self, snap, n_pending: int):
+    def _solve_snap(self, snap, n_pending: int, tctx=None):
         """One wave's solve (in-process or via the shared daemon) ->
         decision host names. Thread-safe: runs on the pipelined loop's
         solve thread; both paths include the gang all-or-nothing post-pass
         and RemoteSolver falls back in-process when the daemon is
-        absent/busy."""
+        absent/busy. ``tctx`` carries the wave's trace across the thread
+        boundary; the span's ambient context is what RemoteSolver ships
+        on the v3 frame so solverd's spans join this trace."""
         t0 = time.perf_counter()
-        if self.solver is not None:
-            chosen, _ = self.solver.solve(snap)
-        else:
-            chosen, _ = solve(snap, mesh=self._mesh)
+        with tracing.span("wave.solve", parent=tctx, pods=n_pending):
+            if self.solver is not None:
+                chosen, _ = self.solver.solve(snap)
+            else:
+                chosen, _ = solve(snap, mesh=self._mesh)
         _wave_metrics().solve.observe(time.perf_counter() - t0)
         _wave_metrics().pods.inc(by=n_pending)
         return decisions_to_names(snap, chosen)
 
-    def _default_solve(self, nodes, existing, pending, services):
+    def _default_solve(self, nodes, existing, pending, services, tctx=None):
         get_existing = existing if callable(existing) else lambda: existing
-        snap = self._encode_wave(nodes, pending, services, get_existing)
-        return self._solve_snap(snap, len(pending))
+        snap = self._encode_wave(nodes, pending, services, get_existing,
+                                 tctx=tctx)
+        return self._solve_snap(snap, len(pending), tctx=tctx)
 
     def _encode_incremental(self, nodes, pending, services, get_existing):
         """O(changed + pending) when the modeler's changelog covers the
@@ -412,13 +462,18 @@ class BatchScheduler:
                 placed.append((pod, host))
         return placed
 
-    def _commit_wave(self, placed, assumed: Optional[list] = None):
+    def _commit_wave(self, placed, assumed: Optional[list] = None,
+                     tctx=None):
         """Bind the wave's placements, event every outcome, assume the
         winners. ``assumed`` optionally supplies the pre-built post-bind
         clones — the pipelined path shares them with the speculative
         encode so the encoder and the modeler account the IDENTICAL
         objects. Returns (outcomes, bound): outcomes[i] is None on
         success, else the bind error (aligned with ``placed``)."""
+        with tracing.span("wave.commit", parent=tctx, pods=len(placed)):
+            return self._commit_wave_inner(placed, assumed)
+
+    def _commit_wave_inner(self, placed, assumed: Optional[list] = None):
         t_commit0 = time.perf_counter()
         c = self.config
 
@@ -477,6 +532,8 @@ class BatchScheduler:
                 assumed.append(cl)
 
         bound = 0
+        now_m = time.monotonic()
+        now_w = time.time()
         for (pod, host), cl, err in zip(placed, assumed, outcomes):
             if err is not None:
                 # lost a CAS race: requeue; next wave sees fresh state
@@ -488,6 +545,26 @@ class BatchScheduler:
                          pod.metadata.name, host)
             c.modeler.assume_pod(cl)
             bound += 1
+            # pod-lifecycle latency: create -> bind committed (the
+            # creationTimestamp is second-granular — fine at contract
+            # load, where e2e is dominated by wave queueing), and arm
+            # the bind -> watch-observe leg for the reflector hook
+            ct = pod.metadata.creation_timestamp
+            if ct is not None:
+                ts = ct.timestamp() if ct.tzinfo is not None else \
+                    ct.replace(tzinfo=timezone.utc).timestamp()
+                self._pod_lat.e2e.observe(max(0.0, now_w - ts))
+            with self._bind_t_lock:
+                obs = self._obs_t.pop(pod.metadata.uid, None)
+                if obs is None:
+                    self._bind_t[pod.metadata.uid] = now_m
+                    while len(self._bind_t) > self._BIND_T_MAX:
+                        self._bind_t.popitem(last=False)
+            if obs is not None:
+                # the watch delivery beat this arming loop (the bind was
+                # already committed server-side): the fan-out leg was
+                # effectively instantaneous relative to the commit
+                self._pod_lat.watch_observe.observe(max(0.0, obs - now_m))
         _wave_metrics().commit.observe(time.perf_counter() - t_commit0)
         return outcomes, bound
 
@@ -495,8 +572,21 @@ class BatchScheduler:
         """Drain, solve, commit — the causal wave. Returns the number of
         pods bound."""
         c = self.config
+        t_dr0 = time.monotonic_ns()
         pods = self._drain_wave(timeout)
+        # one trace per wave: a bare root context (no span of its own) the
+        # stage spans attach to — drain/prepare are recorded retroactively
+        # so the context need not exist while they run. Empty idle ticks
+        # are not waves and must not churn the ring.
+        tctx = tracing.new_ctx() if pods else None
+        if pods:
+            tracing.record("wave.drain", t_dr0, time.monotonic_ns(),
+                           parent=tctx, pods=len(pods))
+        t_pr0 = time.monotonic_ns()
         prep = self._prepare_wave(pods)
+        if tctx is not None:
+            tracing.record("wave.prepare", t_pr0, time.monotonic_ns(),
+                           parent=tctx)
         if prep is None:
             return 0
         pending, nodes, services, get_existing = prep
@@ -504,7 +594,8 @@ class BatchScheduler:
             if self._using_default_solve:
                 # the default solve resolves `existing` lazily (delta path)
                 decisions = self._default_solve(nodes, get_existing,
-                                                pending, services)
+                                                pending, services,
+                                                tctx=tctx)
             else:
                 decisions = self.solve_fn(nodes, get_existing(), pending,
                                           services)
@@ -521,7 +612,7 @@ class BatchScheduler:
         placed = self._split_decisions(pending, decisions)
         if not placed:
             return 0
-        _, bound = self._commit_wave(placed)
+        _, bound = self._commit_wave(placed, tctx=tctx)
         return bound
 
     # -- pipelined wave loop ------------------------------------------------
@@ -538,7 +629,7 @@ class BatchScheduler:
         return "modeler lacks the token/delta changelog"
 
     def _speculate(self, pods: List[api.Pod],
-                   predicted: List[api.Pod]) -> _SpecResult:
+                   predicted: List[api.Pod], tctx=None) -> _SpecResult:
         """Encode wave k+1 against the PREDICTED post-commit state: the
         encoder's resident planes plus wave k's not-yet-committed
         placements. Runs on the loop thread while the commit thread binds
@@ -560,7 +651,10 @@ class BatchScheduler:
             return _SpecResult(None, None, False, "lister_error",
                                time.perf_counter() - t0)
         pending = gang.order_wave(pods)  # identity: wave is gang-free
+        t_enc0 = time.monotonic_ns()
         snap = enc.encode_delta(nodes, predicted, [], pending, services)
+        tracing.record("wave.encode", t_enc0, time.monotonic_ns(),
+                       parent=tctx, pods=len(pending), speculative=True)
         if snap is None:
             # encode_delta declines before applying anything when the
             # node/service planes changed, but an overflow is detected
@@ -610,19 +704,29 @@ class BatchScheduler:
         return "", token, failed_uids
 
     def _dispatch_causal(self, pods, solve_pool,
-                         pm: _PipelineMetrics) -> Optional[_Inflight]:
+                         pm: _PipelineMetrics, tctx=None
+                         ) -> Optional[_Inflight]:
         """Prepare + causally encode + dispatch a wave (bootstrap, and the
-        restart path after a divergence or an unspeculated wave)."""
+        restart path after a divergence or an unspeculated wave).
+        ``tctx`` reuses a trace the caller already opened for these pods
+        (the pipelined drain leg); None starts a fresh wave trace."""
         if not pods:
             return None
+        if tctx is None:
+            tctx = tracing.new_ctx()
+        t_pr0 = time.monotonic_ns()
         prep = self._prepare_wave(pods)
+        tracing.record("wave.prepare", t_pr0, time.monotonic_ns(),
+                       parent=tctx)
         if prep is None:
             return None
         pending, nodes, services, get_existing = prep
-        snap = self._encode_wave(nodes, pending, services, get_existing)
+        snap = self._encode_wave(nodes, pending, services, get_existing,
+                                 tctx=tctx)
         pm.waves.inc()
         return _Inflight(solve_pool.submit(self._solve_snap, snap,
-                                           len(pending)), pending)
+                                           len(pending), tctx),
+                         pending, tctx)
 
     def _pipelined_cycle(self, inflight: Optional[_Inflight], solve_pool,
                          commit_pool, pm: _PipelineMetrics
@@ -650,19 +754,32 @@ class BatchScheduler:
             # for an empty drain (the stale in-flight wave would then be
             # committed twice by the next iteration).
             try:
+                t_dr0 = time.monotonic_ns()
                 pods = self._drain_wave(timeout=0.2)
             except TimeoutError:
                 return None
-            return self._dispatch_causal(pods, solve_pool, pm)
+            tctx = tracing.new_ctx() if pods else None
+            if pods:
+                tracing.record("wave.drain", t_dr0, time.monotonic_ns(),
+                               parent=tctx, pods=len(pods))
+            return self._dispatch_causal(pods, solve_pool, pm, tctx=tctx)
         pending = inflight.pending
         # overlap 1: drain wave k+1 while wave k solves
         t0 = time.perf_counter()
+        t_dr0 = time.monotonic_ns()
         next_pods: List[api.Pod] = []
         try:
             next_pods = self._drain_wave(timeout=self.wave_linger_s)
         except TimeoutError:
             pass
         drain_s = time.perf_counter() - t0
+        # wave k+1's trace opens at its drain; every later leg (spec
+        # encode, solve, commit — or the causal re-encode on divergence)
+        # attaches to this context
+        next_tctx = tracing.new_ctx() if next_pods else None
+        if next_pods:
+            tracing.record("wave.drain", t_dr0, time.monotonic_ns(),
+                           parent=next_tctx, pods=len(next_pods))
         try:
             decisions = inflight.fut.result()
         except Exception as e:
@@ -670,12 +787,14 @@ class BatchScheduler:
                 self._record(pod, "FailedScheduling",
                              "Error scheduling wave: %s", e)
                 c.error(pod, e)
-            return self._dispatch_causal(next_pods, solve_pool, pm)
+            return self._dispatch_causal(next_pods, solve_pool, pm,
+                                         tctx=next_tctx)
         solve_s = time.perf_counter() - t0
         pm.overlap.inc(by=min(drain_s, solve_s))
         placed = self._split_decisions(pending, decisions)
         if not placed:
-            return self._dispatch_causal(next_pods, solve_pool, pm)
+            return self._dispatch_causal(next_pods, solve_pool, pm,
+                                         tctx=next_tctx)
         # the predicted post-bind clones: shared verbatim between the
         # speculative encode and assume_pod, so a verified hit leaves the
         # encoder accounting the very objects the modeler holds
@@ -688,15 +807,16 @@ class BatchScheduler:
         # wave k's bindings commit on the commit thread; the speculative
         # encode (overlap 2) and wave k+1's solve (overlap 3) ride it
         t_c0 = time.perf_counter()
-        commit_fut = commit_pool.submit(self._commit_wave, placed, predicted)
+        commit_fut = commit_pool.submit(self._commit_wave, placed, predicted,
+                                        inflight.tctx)
         spec = None
         next_fut = None
         if next_pods and self._delta_token is not None and \
                 not any(gang.gang_key(p) is not None for p in next_pods):
-            spec = self._speculate(next_pods, predicted)
+            spec = self._speculate(next_pods, predicted, tctx=next_tctx)
             if spec.snap is not None:
                 next_fut = solve_pool.submit(self._solve_snap, spec.snap,
-                                             len(spec.pending))
+                                             len(spec.pending), next_tctx)
         elif next_pods:
             pm.unspeculated.inc()
         try:
@@ -718,7 +838,8 @@ class BatchScheduler:
             raise
         commit_s = time.perf_counter() - t_c0
         if spec is None:
-            return self._dispatch_causal(next_pods, solve_pool, pm)
+            return self._dispatch_causal(next_pods, solve_pool, pm,
+                                         tctx=next_tctx)
         pm.overlap.inc(by=min(commit_s, spec.encode_s))
         reason, token, failed_uids = self._verify_speculation(
             spec, predicted, outcomes)
@@ -728,7 +849,7 @@ class BatchScheduler:
             self._delta_token = token
             pm.hits.inc()
             pm.waves.inc()
-            return _Inflight(next_fut, spec.pending)
+            return _Inflight(next_fut, spec.pending, next_tctx)
         # divergence: the in-flight speculative solve (if any) is
         # discarded — its results never commit
         if reason == "bind_failed" and spec.applied:
@@ -750,15 +871,18 @@ class BatchScheduler:
             if snap2 is not None:
                 pm.waves.inc()
                 return _Inflight(solve_pool.submit(self._solve_snap, snap2,
-                                                   len(pending2)), pending2)
-            return self._dispatch_causal(next_pods, solve_pool, pm)
+                                                   len(pending2), next_tctx),
+                                 pending2, next_tctx)
+            return self._dispatch_causal(next_pods, solve_pool, pm,
+                                         tctx=next_tctx)
         # foreign interference: exact rollback of every speculative row;
         # the un-advanced token re-delivers the actual events (including
         # this wave's real binds) to the causal encode below
         if spec.applied:
             self._encoder.forget_pods([cl.metadata.uid for cl in predicted])
         pm.invalidations.inc(reason or spec.reason or "speculation_failed")
-        return self._dispatch_causal(next_pods, solve_pool, pm)
+        return self._dispatch_causal(next_pods, solve_pool, pm,
+                                     tctx=next_tctx)
 
     # -- loop ---------------------------------------------------------------
     def run(self) -> "BatchScheduler":

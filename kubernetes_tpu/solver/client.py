@@ -39,6 +39,7 @@ import numpy as np
 
 from kubernetes_tpu.models.policy import BatchPolicy
 from kubernetes_tpu.solver import protocol
+from kubernetes_tpu.util import tracing
 
 __all__ = ["RemoteSolver", "SolverBusy", "SolverUnavailable"]
 
@@ -244,6 +245,12 @@ class RemoteSolver:
             "policy": protocol.policy_to_wire(pol),
             "gangs": bool(gangs),
         }
+        # v3 trace context: the wave's ambient span rides the header so
+        # the daemon's queue/solve spans join this trace (advisory only
+        # — see protocol.parse_trace; absent when tracing is off)
+        ctx = tracing.current()
+        if ctx is not None:
+            base["trace"] = [ctx[0], ctx[1]]
         if not self.delta:
             resp_header, arrays = self._call(base, tuple(host_inputs))
             return self._parse_solve_reply(resp_header, arrays)

@@ -64,7 +64,7 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
-from kubernetes_tpu.util import metrics, warmstart
+from kubernetes_tpu.util import metrics, tracing, warmstart
 
 __all__ = ["MeshExecutor"]
 
@@ -317,6 +317,10 @@ class MeshExecutor:
         import jax.numpy as jnp
 
         t_wave = time.perf_counter()
+        # kube-trace: the service's mesh path installs the wave's ambient
+        # span before calling in; tctx None = untraced (free)
+        tctx = tracing.current()
+        t_pl0 = time.monotonic_ns()
         mesh, probed = self._active_mesh(inp, pol, gangs)
         self.mesh_waves += 1
         self._m.waves.inc()
@@ -403,10 +407,20 @@ class MeshExecutor:
             wave_dev.append(jax.device_put(np.ascontiguousarray(arr),
                                            getattr(sh, name)))
             transfer += arr.nbytes
+        if tctx is not None:
+            # plane residency/transfer leg vs the device program itself —
+            # the split the reshard-bytes wall analysis had to infer
+            tracing.record("mesh.planes", t_pl0, time.monotonic_ns(),
+                           parent=tctx, transfer=transfer, reshard=reshard)
+        t_dv0 = time.monotonic_ns()
         fn = pm.sharded_program(mesh, pol, gangs, donate=True)
         with _donation_warnings_scoped():
             chosen, scores = fn(tuple(resident_dev), tuple(wave_dev))
             both = np.asarray(jnp.stack([chosen, scores]))
+        if tctx is not None:
+            tracing.record("mesh.device_solve", t_dv0, time.monotonic_ns(),
+                           parent=tctx,
+                           node_shards=int(mesh.shape["nodes"]))
         self._m.transfer_bytes.inc(by=transfer)
         self._m.reshard_bytes.inc(by=reshard)
         self._m.solve_s.observe(time.perf_counter() - t_wave)

@@ -60,6 +60,17 @@ them with the request's own version. The fingerprint exists so the
 daemon can group compatible requests for coalescing (same compiled
 program family) and reject requests from a scheduler built against an
 incompatible protocol revision without decoding the tensor payload.
+
+**Trace context (v3).** A v3 ``solve`` header may carry
+``"trace": [trace_id, parent_span_id]`` — the kube-trace span context
+(util/tracing.py) of the scheduler wave that shipped the frame. The
+daemon attaches its queue-wait and solve spans to that trace so the
+merged per-run artifact shows the wave's full causal path across the
+process boundary. The field is OPTIONAL and advisory: it never affects
+solving, is ignored by tracing-disabled daemons, and v1/v2 clients that
+omit it are served exactly as before (untraced). It deliberately rides
+the JSON header, not the fingerprint — two waves differing only in
+trace context must still coalesce into one compiled program family.
 """
 
 from __future__ import annotations
@@ -78,10 +89,21 @@ from kubernetes_tpu.models.policy import BatchPolicy
 __all__ = ["PROTOCOL_VERSION", "MIN_PROTOCOL_VERSION", "MAX_FRAME",
            "DELTA_FIELDS", "SolverProtocolError",
            "send_msg", "recv_msg", "policy_to_wire", "policy_from_wire",
-           "solver_fingerprint", "shape_bucket"]
+           "solver_fingerprint", "shape_bucket", "parse_trace"]
 
-PROTOCOL_VERSION = 2      # v2: delta frames + resident plane cache
-MIN_PROTOCOL_VERSION = 1  # v1 full-plane clients still served
+PROTOCOL_VERSION = 3      # v3: optional trace context on solve frames
+MIN_PROTOCOL_VERSION = 1  # v1 full-plane / v2 delta clients still served
+
+
+def parse_trace(header: dict):
+    """The solve header's optional trace context -> (trace_id,
+    parent_span_id) tuple, or None when absent/malformed. Tolerant by
+    design: a bad trace field must never fail a solve."""
+    tr = header.get("trace")
+    if (isinstance(tr, (list, tuple)) and len(tr) == 2
+            and all(isinstance(x, str) and 0 < len(x) <= 64 for x in tr)):
+        return (tr[0], tr[1])
+    return None
 
 # SolverInputs fields the daemon may cache between waves and the client
 # may ship as row deltas: everything keyed on the node/group/zone axes

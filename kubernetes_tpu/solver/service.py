@@ -65,7 +65,7 @@ import numpy as np
 from kubernetes_tpu.models.policy import BatchPolicy
 from kubernetes_tpu.models.snapshot import _pow2_pad
 from kubernetes_tpu.solver import protocol
-from kubernetes_tpu.util import metrics
+from kubernetes_tpu.util import metrics, tracing
 
 __all__ = ["SolverService"]
 
@@ -208,10 +208,10 @@ def _solverd_metrics() -> _SolverdMetrics:
 
 class _Req:
     __slots__ = ("inp", "pol", "gangs", "p", "conn", "send_lock",
-                 "cache_key", "delta")
+                 "cache_key", "delta", "trace", "t_enq")
 
     def __init__(self, inp, pol, gangs, p, conn, send_lock,
-                 cache_key=None, delta=None):
+                 cache_key=None, delta=None, trace=None):
         self.inp = inp          # host-side SolverInputs (numpy)
         self.pol = pol
         self.gangs = gangs
@@ -224,6 +224,11 @@ class _Req:
         # an on-device scatter instead of a full re-transfer
         self.cache_key = cache_key
         self.delta = delta
+        # v3 trace context of the requesting wave (protocol.parse_trace)
+        # + enqueue instant: the daemon's queue-wait and solve spans
+        # attach to the wave's trace in the merged per-run artifact
+        self.trace = trace
+        self.t_enq = time.monotonic_ns()
 
 
 class SolverService:
@@ -538,7 +543,8 @@ class SolverService:
                 return
         inp = SolverInputs(*cols)
         req = _Req(inp, pol, gangs, int(inp.req.shape[0]), conn, send_lock,
-                   cache_key=cache_key, delta=delta_updates or None)
+                   cache_key=cache_key, delta=delta_updates or None,
+                   trace=protocol.parse_trace(header))
         with self._cond:
             if len(self._pending) >= self.max_queue:
                 busy = True
@@ -638,6 +644,23 @@ class SolverService:
         both = np.asarray(jnp.stack([chosen, scores]))
         return both[0], both[1]
 
+    @staticmethod
+    def _trace_group(reqs: List[_Req], t0_ns: int, end_ns: int,
+                     mesh: bool) -> None:
+        """Attach the daemon's per-wave spans (queue wait + batched
+        solve) to each requesting wave's trace — the cross-process leg
+        of the wave timeline. No-op unless the daemon runs with --trace
+        AND the frame carried a v3 trace context."""
+        if not tracing.enabled():
+            return
+        for r in reqs:
+            if r.trace is None:
+                continue
+            tracing.record("solverd.queue", r.t_enq, t0_ns, parent=r.trace)
+            tracing.record("solverd.solve", t0_ns, end_ns, parent=r.trace,
+                           coalesced=len(reqs), mesh=mesh, pods=r.p,
+                           nodes=int(r.inp.cap.shape[0]))
+
     def _solve_group(self, reqs: List[_Req]) -> None:
         pol, gangs = reqs[0].pol, reqs[0].gangs
         # kernel-vs-mesh-vs-single dispatch (docs/design/solver.md): a
@@ -652,9 +675,20 @@ class SolverService:
                 and me.eligible(reqs[0].inp, pol, gangs):
             r = reqs[0]
             t0 = time.perf_counter()
-            chosen, scores = me.solve(r.inp, pol, gangs,
-                                      cache_key=r.cache_key, delta=r.delta)
+            t0_ns = time.monotonic_ns()
+            if r.trace is not None and tracing.enabled():
+                # ambient install so MeshExecutor's plane/device sub-spans
+                # attach to this wave's trace
+                with tracing.span("solverd.mesh", parent=r.trace):
+                    chosen, scores = me.solve(r.inp, pol, gangs,
+                                              cache_key=r.cache_key,
+                                              delta=r.delta)
+            else:
+                chosen, scores = me.solve(r.inp, pol, gangs,
+                                          cache_key=r.cache_key,
+                                          delta=r.delta)
             dt = time.perf_counter() - t0
+            self._trace_group(reqs, t0_ns, time.monotonic_ns(), mesh=True)
             self.solve_calls += 1
             self.waves_served += 1
             self._m.solves.inc()
@@ -680,8 +714,10 @@ class SolverService:
         stacked = type(padded[0])(*(np.stack(cols)
                                     for cols in zip(*padded)))
         t0 = time.perf_counter()
+        t0_ns = time.monotonic_ns()
         chosen, scores = self._device_solve(stacked, pol, gangs)
         dt = time.perf_counter() - t0
+        self._trace_group(reqs, t0_ns, time.monotonic_ns(), mesh=False)
         self.solve_calls += 1
         self.waves_served += len(reqs)
         self._m.solves.inc()

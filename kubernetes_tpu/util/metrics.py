@@ -13,13 +13,19 @@ import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["Counter", "Gauge", "Histogram", "Registry", "default_registry",
-           "DEFAULT_BUCKETS", "APISERVER_BUCKETS",
+           "DEFAULT_BUCKETS", "APISERVER_BUCKETS", "POD_E2E_BUCKETS",
            "SolverdDeltaMetrics", "solverd_delta_metrics",
-           "SolverdMeshMetrics", "solverd_mesh_metrics"]
+           "SolverdMeshMetrics", "solverd_mesh_metrics",
+           "PodLatencyMetrics", "pod_latency_metrics"]
 
 # ref: apiserver.go:60-61 — the expected request-latency envelope, in seconds.
 APISERVER_BUCKETS = (0.125, 0.25, 0.5, 1.0, 2.0, 4.0, 8.0)
 DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+# Pod-lifecycle latency envelope: at the 1000/s contract a pod's
+# create->bind path rides one wave (sub-second steady state) but can
+# queue behind a burst or a cold compile for tens of seconds.
+POD_E2E_BUCKETS = (0.05, 0.1, 0.25, 0.5, 0.75, 1.0, 1.5, 2.5, 5.0,
+                   10.0, 30.0, 60.0, 120.0)
 
 
 def _escape(v: str) -> str:
@@ -115,12 +121,29 @@ class Histogram(_Metric):
         return s[1] if s else 0
 
     def quantile(self, q: float, *label_values: str) -> Optional[float]:
-        """Approximate quantile from bucket boundaries (upper bound)."""
+        """Interpolation-free bucket quantile: the UPPER BOUND of the
+        first bucket whose cumulative count reaches ``rank = q * n``.
+
+        Semantics (the contract latency records in CHURN_MP_* rely on):
+
+        - returns None when the series has no observations (an empty
+          histogram has no quantiles, not 0.0);
+        - always one of the configured bucket bounds — a conservative
+          over-estimate of the true quantile, never an interpolated
+          value between bounds (a single-bucket histogram therefore
+          reports that bucket's bound for every in-range quantile);
+        - returns +inf when the rank falls beyond the largest bounded
+          bucket (observations overflowed the envelope — widen the
+          buckets rather than trusting the number);
+        - ``q`` is clamped to a minimum rank of one observation, so
+          q=0 (or pathological tiny q) reports the first non-empty
+          bucket instead of buckets[0] unconditionally.
+        """
         s = self._series.get(tuple(str(v) for v in label_values))
         if not s or s[1] == 0:
             return None
         counts, n, _ = s
-        rank = q * n
+        rank = max(1.0, q * n)
         for i, b in enumerate(self.buckets):
             if counts[i] >= rank:
                 return b
@@ -312,3 +335,34 @@ def solverd_mesh_metrics() -> SolverdMeshMetrics:
     if SolverdMeshMetrics._singleton is None:
         SolverdMeshMetrics._singleton = SolverdMeshMetrics()
     return SolverdMeshMetrics._singleton
+
+
+class PodLatencyMetrics:
+    """Pod-lifecycle latency — the causal, per-pod view of where the
+    1000/s contract's latency goes (docs/design/observability.md).
+    Observed by the wave scheduler (scheduler/tpu_batch.py), exported
+    via the default-registry /metrics merge, scraped into the churn
+    record's ``latency`` section and logged as quantiles at the end of
+    every churn run. These are METRICS, always on — the kube-trace span
+    layer (util/tracing.py) is the opt-in causal complement."""
+
+    _singleton = None
+
+    def __init__(self, registry: Optional[Registry] = None):
+        reg = registry or default_registry()
+        self.e2e = reg.histogram(
+            "pod_e2e_scheduling_seconds",
+            "Pod end-to-end scheduling latency: apiserver create "
+            "(metadata.creationTimestamp) -> bind committed by the wave "
+            "scheduler", buckets=POD_E2E_BUCKETS)
+        self.watch_observe = reg.histogram(
+            "pod_watch_observe_seconds",
+            "Bind committed -> the bound pod observed back through the "
+            "scheduler's own watch stream (the fan-out leg of the "
+            "pod's path)", buckets=POD_E2E_BUCKETS)
+
+
+def pod_latency_metrics() -> PodLatencyMetrics:
+    if PodLatencyMetrics._singleton is None:
+        PodLatencyMetrics._singleton = PodLatencyMetrics()
+    return PodLatencyMetrics._singleton

@@ -187,6 +187,34 @@ def test_churn_record_schema_mesh_section_gated_by_round():
     assert "solverd.mesh.parity_divergent" in missing
 
 
+def test_churn_record_schema_latency_section_gated_by_round():
+    """r09 records predate kube-trace; r10+ must carry the latency
+    section (per-pod e2e quantiles, bind->watch-observe leg, and the
+    trace-collection health counters) so the causal per-pod evidence —
+    and the proof the instrument itself wasn't lossy — can't be
+    silently dropped."""
+    churn_mp = _load_churn_mp()
+    rec = _churn_sample_record()
+    rec["solverd"]["mesh"] = {k: 1 for k in churn_mp.SOLVERD_MESH_FIELDS}
+    assert churn_mp.validate_record(rec, round_no=9) == []
+    assert "latency" in churn_mp.validate_record(rec, round_no=10)
+    rec["latency"] = {
+        "e2e_count": 50_000, "e2e_mean_s": 0.8, "e2e_p50_s": 0.6,
+        "e2e_p95_s": 2.1, "e2e_p99_s": 4.2,
+        "watch_observe_count": 50_000, "watch_observe_mean_s": 0.07,
+        "watch_observe_p50_s": 0.05, "watch_observe_p95_s": 0.2,
+        "watch_observe_p99_s": 0.4,
+        "trace_shards": 12, "trace_spans": 30_000, "spans_dropped": 0,
+        "trace_file": "CHURN_MP_r10_fullshape_trace.json",
+    }
+    assert churn_mp.validate_record(rec, round_no=10) == []
+    del rec["latency"]["e2e_p99_s"]
+    del rec["latency"]["spans_dropped"]
+    missing = churn_mp.validate_record(rec, round_no=10)
+    assert "latency.e2e_p99_s" in missing
+    assert "latency.spans_dropped" in missing
+
+
 def test_committed_churn_records_conform():
     """Every committed CHURN_MP record from r07 on must satisfy the
     schema (r08+ additionally the apiserver hot-path fields) — the
@@ -194,6 +222,8 @@ def test_committed_churn_records_conform():
     future round's record."""
     churn_mp = _load_churn_mp()
     for path in glob.glob(os.path.join(_REPO, "CHURN_MP_r*.json")):
+        if path.endswith("_trace.json"):
+            continue  # merged kube-trace sidecar, not a churn record
         round_no = int(path.rsplit("_r", 1)[1].split("_")[0].split(".")[0])
         if round_no < 7:
             continue  # pre-contract records are historical evidence
