@@ -530,6 +530,14 @@ SOLVERD_MESH_FIELDS = ("devices", "pods_axis", "node_shards", "waves",
 LATENCY_FIELDS = ("e2e_count", "e2e_p50_s", "e2e_p95_s", "e2e_p99_s",
                   "watch_observe_count", "watch_observe_p50_s",
                   "trace_shards", "spans_dropped")
+# kube-flightrec evidence, required from r11 on: the continuous
+# control-plane time-series (the curves every wall to date had to be
+# reconstructed without) and the SLO alarm transition log. A clean
+# contract run carries alarms: [] — proven quiet, not assumed. The
+# downsampled headline series ride the record; the full-resolution
+# merged series live in the <out>_timeline.json sidecar.
+TIMELINE_FIELDS = ("sample_period_s", "series", "headline")
+TIMELINE_MIN_SERIES = 5
 
 
 def validate_record(rec: dict, round_no: int = 8) -> list:
@@ -572,6 +580,23 @@ def validate_record(rec: dict, round_no: int = 8) -> list:
         elif "error" not in lat:
             missing += [f"latency.{k}" for k in LATENCY_FIELDS
                         if k not in lat]
+    if round_no >= 11:
+        # r11 introduced kube-flightrec: the timeline section (>= 5
+        # headline series spanning the run) and the SLO alarm transition
+        # log are part of the record contract from here on
+        tl = rec.get("timeline")
+        if not isinstance(tl, dict):
+            missing.append("timeline")
+        elif "error" not in tl:
+            missing += [f"timeline.{k}" for k in TIMELINE_FIELDS
+                        if k not in tl]
+            series = tl.get("series")
+            if isinstance(series, dict) and \
+                    len(series) < TIMELINE_MIN_SERIES:
+                missing.append(
+                    f"timeline.series:{len(series)}<{TIMELINE_MIN_SERIES}")
+        if not isinstance(rec.get("alarms"), list):
+            missing.append("alarms")
     cb = rec.get("cpu_budget_s")
     if cb is not None and not isinstance(cb, dict):
         missing.append("cpu_budget_s:not-a-dict")
@@ -772,6 +797,12 @@ def main(argv=None) -> int:
                     "has): each receives every pod event, so the "
                     "encode-once fan-out is exercised at width instead "
                     "of the minimum the scheduler alone provides")
+    ap.add_argument("--wave-period", type=float, default=0.1,
+                    help="scheduler wave linger seconds: longer waves "
+                    "amortize the fixed per-wave cost (drain + HTTP "
+                    "commit round-trip) over more pods; shorter waves "
+                    "cut per-pod latency. The contract runs measure "
+                    "sustained throughput, so the default leans large")
     ap.add_argument("--depth", type=int, default=32,
                     help="per-feeder pipelined requests in flight; the "
                     "offered rate is bounded by depth x feeders / server "
@@ -788,6 +819,34 @@ def main(argv=None) -> int:
                     help="pass through to kube-solverd --trace-device: "
                     "jax.profiler device trace directory (empty "
                     "disables)")
+    ap.add_argument("--flightrec", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="kube-flightrec (default ON, r11+ records "
+                    "require it): run every control-plane child with "
+                    "--flightrec, pull each process's /debug/vars "
+                    "time-series shard incrementally through a live "
+                    "FlightAggregator, evaluate the churn SLO rule set "
+                    "during the run, and emit the timeline + alarms "
+                    "record sections plus the full-resolution "
+                    "<out>_timeline.json sidecar")
+    ap.add_argument("--flightrec-poll", type=float, default=2.0,
+                    help="aggregator pull period, seconds (children "
+                    "sample their rings at 1 s regardless)")
+    ap.add_argument("--rss-ceiling-gb", type=float, default=8.0,
+                    help="per-process RSS SLO ceiling, GiB")
+    ap.add_argument("--binds-floor", type=float, default=50.0,
+                    help="sustained binds/s SLO floor while load is "
+                    "offered")
+    ap.add_argument("--lag-storm", type=int, default=0,
+                    help="induce a watcher-lag storm: N deliberately "
+                    "throttled observer watch streams (tiny reads, long "
+                    "sleeps) whose queues must blow the apiserver's "
+                    "--watch-lag-limit and 410-resync — the watch-lag "
+                    "SLO alarm demonstration")
+    ap.add_argument("--watch-lag-limit", type=int, default=0,
+                    help="pass through to the apiserver(s); 0 keeps the "
+                    "server default (65536). Lag-storm runs set this "
+                    "low so the storm trips inside the run's span")
     ap.add_argument("--port", type=int, default=18410)
     ap.add_argument("--out", default=None)
     ap.add_argument("--platform", choices=["cpu", "ambient"], default="cpu",
@@ -825,6 +884,48 @@ def main(argv=None) -> int:
                 pass
         return agg
 
+    flight_agg = None  # the in-run kube-flightrec aggregator
+    solverd_stop = None      # supervisor controls (set when --solverd)
+    solverd_restarts = [0]
+
+    def flush_flightrec(record: dict) -> None:
+        """Timeline + alarms into the record (and the full-resolution
+        sidecar next to --out) — called on BOTH the success and the
+        abort path: the failure runs are exactly the ones where the
+        curves matter."""
+        if flight_agg is None:
+            return
+        try:
+            flight_agg.stop()  # joins the poll thread + one final pull
+            sidecar_path = sidecar_name = ""
+            if args.out:
+                sidecar_path = re.sub(r"\.json$", "", args.out) \
+                    + "_timeline.json"
+                sidecar_name = os.path.basename(sidecar_path)
+            record["timeline"] = flight_agg.timeline(sidecar=sidecar_name)
+            record["alarms"] = flight_agg.alarms()
+            if sidecar_path:
+                with open(sidecar_path, "w") as f:
+                    json.dump(flight_agg.sidecar_payload(), f)
+            n_series = len(record["timeline"].get("series", ()))
+            firing = [a for a in record["alarms"]
+                      if a.get("state") == "firing"]
+            print(f"[churn-mp] flightrec: {n_series} headline series, "
+                  f"{len(record['alarms'])} alarm transitions "
+                  f"({len(firing)} firing)"
+                  + (f" -> {sidecar_name}" if sidecar_name else ""),
+                  file=sys.stderr, flush=True)
+        except Exception as e:
+            record["timeline"] = {"error": f"flightrec flush failed: {e}"}
+            record.setdefault("alarms", [])
+
+    api_extra = []
+    if args.trace:
+        api_extra.append("--trace")
+    if args.flightrec:
+        api_extra.append("--flightrec")
+    if args.watch_lag_limit:
+        api_extra += ["--watch-lag-limit", str(args.watch_lag_limit)]
     try:
         if args.apiservers > 1:
             # reference topology at scale: one store process (etcd analog)
@@ -837,11 +938,10 @@ def main(argv=None) -> int:
                       "kubernetes_tpu.cmd.apiserver",
                       "--port", str(args.port), "--reuse-port",
                       "--store-server", f"127.0.0.1:{store_port}",
-                      *(["--trace"] if args.trace else []))
+                      *api_extra)
         else:
             spawn("apiserver", PY, "-m", "kubernetes_tpu.cmd.apiserver",
-                  "--port", str(args.port),
-                  *(["--trace"] if args.trace else []))
+                  "--port", str(args.port), *api_extra)
         deadline = time.time() + 60
         while time.time() < deadline:
             try:
@@ -900,6 +1000,7 @@ def main(argv=None) -> int:
                   "--pods-axis", str(args.pods_axis),
                   "--mesh-dispatch", args.mesh_dispatch,
                   *(["--trace"] if args.trace else []),
+                  *(["--flightrec"] if args.flightrec else []),
                   *(["--trace-device", args.trace_device]
                     if args.trace_device else []),
                   env=sd_env)
@@ -917,12 +1018,40 @@ def main(argv=None) -> int:
             else:
                 raise RuntimeError("kube-solverd never came up")
 
+            # supervisor: a daemon that dies mid-run (native crashes
+            # included) is respawned instead of leaving every scheduler
+            # in the in-process fallback for the rest of the run — the
+            # RemoteSolver cooldown reconnects within ~5 s and the delta
+            # wire resyncs with one full frame. Restarts are DISCLOSED
+            # in the record (solverd_restarts); a clean run carries 0.
+            import threading as _threading
+            solverd_stop = _threading.Event()
+            solverd_cmd = list(procs[-1][1].args)
+
+            def _supervise_solverd():
+                while not solverd_stop.wait(2.0):
+                    _name, p = next(np_ for np_ in reversed(procs)
+                                    if np_[0] == "solverd")
+                    if p.poll() is None:
+                        continue
+                    if solverd_stop.is_set():
+                        return  # teardown began after this tick's wait
+                    solverd_restarts[0] += 1
+                    print(f"[churn-mp] WARNING: kube-solverd exited "
+                          f"rc={p.returncode}; respawning "
+                          f"(restart #{solverd_restarts[0]})",
+                          file=sys.stderr, flush=True)
+                    spawn("solverd", *solverd_cmd, env=sd_env)
+
+            _threading.Thread(target=_supervise_solverd, daemon=True,
+                              name="solverd-supervisor").start()
+
         sched_metrics_ports = [args.port + 9 + w
                                for w in range(args.schedulers)]
         for w in range(args.schedulers):
             cmd = [PY, "-m", "kubernetes_tpu.cmd.scheduler",
                    "--master", master, "--algorithm", "tpu-batch",
-                   "--wave-period", "0.1",
+                   "--wave-period", str(args.wave_period),
                    "--metrics-port", str(sched_metrics_ports[w])]
             if solver_addr:
                 cmd += ["--solver-addr", solver_addr]
@@ -930,7 +1059,35 @@ def main(argv=None) -> int:
                 cmd += ["--pipeline"]
             if args.trace:
                 cmd += ["--trace"]
+            if args.flightrec:
+                cmd += ["--flightrec"]
             spawn(f"scheduler{w}", *cmd)
+
+        if args.flightrec:
+            # the live aggregator: discovers every control-plane process
+            # (incl. all SO_REUSEPORT apiserver worker pids via the
+            # drain-until-all-pids-answer pattern), pulls /debug/vars
+            # incrementally, and evaluates the churn SLO set during the
+            # run — alarms fire live, not in post-mortem
+            from kubernetes_tpu.addons.monitoring import (
+                FlightAggregator,
+                default_churn_rules,
+            )
+            targets = [{"name": "apiserver", "url": master,
+                        "workers": args.apiservers}]
+            targets += [{"name": f"scheduler{w}",
+                         "url": f"http://127.0.0.1:{p}"}
+                        for w, p in enumerate(sched_metrics_ports)]
+            if solver_addr:
+                targets.append({"name": "solverd",
+                                "url": f"http://127.0.0.1:"
+                                       f"{solverd_metrics_port}"})
+            flight_agg = FlightAggregator(
+                targets,
+                rules=default_churn_rules(
+                    binds_floor=args.binds_floor,
+                    rss_ceil_bytes=args.rss_ceiling_gb * (1 << 30)),
+                period_s=args.flightrec_poll).start()
 
         # Bind counting rides a WATCH, not list polling: a full
         # field-selected LIST costs O(all pods) server CPU per poll
@@ -1025,6 +1182,36 @@ def main(argv=None) -> int:
             threadinglib.Thread(target=observer, args=(w,),
                                 daemon=True).start()
 
+        # induced watcher-lag storm: observers that deliberately cannot
+        # keep up (tiny reads, long sleeps). Their per-watcher queues
+        # must blow past --watch-lag-limit, take the 410 drop-to-resync,
+        # and fire the watch-lag SLO alarm — the live demonstration that
+        # the watchdog catches a sick watcher while the run is still
+        # going, with the triggering samples in the transition record.
+        lag_resyncs_seen = [0] * args.lag_storm
+
+        def throttled_observer(slot):
+            while not churn_done.is_set():
+                try:
+                    s = socketlib.create_connection(("127.0.0.1",
+                                                     args.port))
+                    s.sendall(b"GET /api/v1/pods?watch=1 HTTP/1.1\r\n"
+                              b"Host: a\r\n\r\n")
+                    while not churn_done.is_set():
+                        chunk = s.recv(2048)
+                        if not chunk:
+                            break
+                        if b'"reason": "Expired"' in chunk:
+                            lag_resyncs_seen[slot] += 1
+                        time.sleep(0.25)
+                    s.close()
+                except OSError:
+                    time.sleep(0.2)
+
+        for w in range(args.lag_storm):
+            threadinglib.Thread(target=throttled_observer, args=(w,),
+                                daemon=True).start()
+
         def wait_all_bound(total_created, timeout=180.0):
             deadline = time.monotonic() + timeout
             while time.monotonic() < deadline:
@@ -1073,6 +1260,10 @@ def main(argv=None) -> int:
         print(f"[churn-mp] replay logs rendered in {render_s:.2f}s",
               file=sys.stderr, flush=True)
 
+        if flight_agg is not None:
+            # the offered-load window opens: the active-only SLO rules
+            # (the sustained-binds floor) start judging from here
+            flight_agg.set_active(True)
         t0 = time.perf_counter()
         feeders = [subprocess.Popen(
             [PY, os.path.abspath(__file__), "--_feed", f"churn{f}",
@@ -1121,6 +1312,34 @@ def main(argv=None) -> int:
                       "created": sum(s.get("created", 0) for s in stats
                                      if isinstance(s, dict)),
                       "cpu_budget_s": cpu_budget()}
+            # the failure runs are exactly the ones where the curves
+            # matter: scrape whatever /metrics are still answering into
+            # the partial record instead of writing metrics: {}, and
+            # flush the flightrec timeline + alarms the same as a clean
+            # run (each scrape independently best-effort — a dead
+            # apiserver must not cost us the scheduler's evidence)
+            try:
+                record["apiserver"] = _scrape_apiserver(master)
+            except Exception as e:
+                record["apiserver"] = {"error": f"scrape failed: {e}"}
+            try:
+                ends = [_scrape_wave_raw(p) for p in sched_metrics_ports]
+                per_worker = [_wave_stats_delta(b, e)
+                              for b, e in zip(waves_baseline, ends)]
+                record["scheduler_waves"] = per_worker[0] \
+                    if len(per_worker) == 1 else {"workers": per_worker}
+            except Exception as e:
+                record["scheduler_waves"] = {"error": f"scrape failed: {e}"}
+            if solver_addr:
+                try:
+                    record["solverd"] = _scrape_solverd(solverd_metrics_port)
+                except Exception as e:
+                    record["solverd"] = {"error": f"scrape failed: {e}"}
+            try:
+                record["latency"] = _scrape_pod_latency(sched_metrics_ports)
+            except Exception as e:
+                record["latency"] = {"error": f"scrape failed: {e}"}
+            flush_flightrec(record)
             print(json.dumps(record, indent=1))
             if args.out:
                 with open(args.out, "w") as f:
@@ -1128,6 +1347,10 @@ def main(argv=None) -> int:
             return 1
         ok = wait_all_bound(warm_total + args.pods)
         total_s = time.perf_counter() - t0
+        if flight_agg is not None:
+            # load window closed: active-only rules stand down (a binds
+            # floor alarm after the last pod bound would be noise)
+            flight_agg.set_active(False)
         offered = sum(s["created"] for s in stats) / feed_s
         sustained = args.pods / total_s if ok else 0.0
         # per-wave encode/solve stats from the scheduler's /metrics —
@@ -1171,6 +1394,7 @@ def main(argv=None) -> int:
             "all_bound": ok,
             "feed_s": round(feed_s, 2),
             "total_s": round(total_s, 2),
+            "wave_period_s": args.wave_period,
             "replay_render_s": round(render_s, 2),
             "feeder_behind_max_s": max(s["behind_max_s"] for s in stats),
             "scheduler_waves": wave_stats,
@@ -1207,6 +1431,9 @@ def main(argv=None) -> int:
                 record["solverd"] = _scrape_solverd(solverd_metrics_port)
             except Exception as e:
                 record["solverd"] = {"error": f"scrape failed: {e}"}
+            # supervisor evidence: 0 on a clean run; a respawned daemon
+            # (native crash mid-churn) is disclosed, never hidden
+            record["solverd_restarts"] = solverd_restarts[0]
         if args.pipeline:
             try:
                 pipes = [_scrape_pipeline(p) for p in sched_metrics_ports]
@@ -1269,7 +1496,13 @@ def main(argv=None) -> int:
             latency.setdefault("trace_shards", 0)
             latency.setdefault("spans_dropped", 0)
         record["latency"] = latency
-        missing = validate_record(record, round_no=10)
+        if args.lag_storm:
+            # marks the record as an induced-storm shape: perfgate's
+            # shape key keeps it out of the clean trajectory's baselines
+            record["lag_storm"] = args.lag_storm
+            record["lag_storm_resyncs_seen"] = sum(lag_resyncs_seen)
+        flush_flightrec(record)
+        missing = validate_record(record, round_no=11)
         if missing:
             print(f"[churn-mp] WARNING: record missing contract fields: "
                   f"{missing}", file=sys.stderr, flush=True)
@@ -1280,8 +1513,19 @@ def main(argv=None) -> int:
                 f.write(out + "\n")
         return 0 if ok else 1
     finally:
-        for _name, p in procs:
+        if solverd_stop is not None:
+            solverd_stop.set()  # the supervisor must not respawn a
+            #                     daemon this teardown just terminated
+        for _name, p in list(procs):
             p.terminate()
+        if solverd_stop is not None:
+            # second sweep: a supervisor tick in flight when stop was
+            # set may have appended one last respawn mid-iteration —
+            # nothing this harness started may outlive it
+            time.sleep(0.2)
+            for _name, p in list(procs):
+                if p.poll() is None:
+                    p.terminate()
 
 
 if __name__ == "__main__":

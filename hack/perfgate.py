@@ -1,0 +1,249 @@
+"""perfgate — the regression gate over the record trajectory.
+
+The sustained-rate trajectory at the contract shape (182/s in r04 ->
+496.8/s in r10) only exists because every round re-measured the same
+shape; nothing so far STOPPED a round from silently giving some of it
+back. perfgate compares a fresh CHURN_MP record's required keys against
+the best committed prior record of the SAME SHAPE, with per-key
+tolerance bands:
+
+- **required** keys (sustained rate, frame-cache hit rate) turn the
+  verdict red when they regress beyond their band — or when the fresh
+  record dropped a key its baseline carried;
+- **advisory** keys (solve p50, per-bind cost, apiserver CPU, e2e p50)
+  produce warnings only: they legitimately trade against each other
+  between rounds (r08 improved sustained 232->426 while its solve p50
+  rose — a red gate there would have rejected the apiserver PR).
+
+"Same shape" means the same ``config`` line (pods/rate/nodes) AND the
+same load topology class: a fan-out record (observer watchers) or a
+lag-storm record never gates against the clean full-shape series.
+"Best" is the highest sustained rate among all-bound, non-error priors.
+
+Runnable standalone::
+
+    python hack/perfgate.py CHURN_MP_r11_fullshape.json        # vs best prior
+    python hack/perfgate.py NEW.json --against OLD.json        # explicit
+    python hack/perfgate.py --check-committed                  # whole series
+
+and as a tier-1 test (tests/test_perfgate.py) over the committed
+r08-r10 records, so the gate itself can never rot. Exit codes: 0 green,
+1 red, 2 usage/IO error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import List, Optional, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# (key, record path, direction, relative tolerance band, required)
+# direction "higher": regression = fresh < base * (1 - band)
+# direction "lower":  regression = fresh > base * (1 + band)
+KEYS: Tuple[Tuple[str, str, str, float, bool], ...] = (
+    ("sustained_pods_per_s", "sustained_pods_per_s", "higher", 0.05, True),
+    ("frame_cache_hit_rate", "apiserver.frame_cache_hit_rate", "higher",
+     0.02, True),
+    ("solve_p50_ms", "scheduler_waves.solve.p50_ms", "lower", 0.35, False),
+    ("per_bind_ms_live", "apiserver.per_bind_ms_live", "lower", 0.35, False),
+    ("apiserver_cpu_s", "cpu_budget_s.apiserver", "lower", 0.35, False),
+    ("e2e_p50_s", "latency.e2e_p50_s", "lower", 0.35, False),
+)
+
+
+def _get_path(rec: dict, path: str):
+    cur = rec
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur if isinstance(cur, (int, float)) else None
+
+
+def shape_key(rec: dict) -> str:
+    """Shape identity: the config line plus the load-topology class.
+    Observer fan-out and induced-lag-storm runs measure deliberately
+    different regimes and must never gate against the clean series."""
+    cfg = rec.get("config", "")
+    ap = rec.get("apiserver") or {}
+    suffix = ""
+    if isinstance(ap, dict) and ap.get("observer_watchers"):
+        suffix += "+watchers"
+    if rec.get("lag_storm"):
+        suffix += "+lagstorm"
+    return cfg + suffix
+
+
+def round_of(path: str) -> int:
+    m = re.search(r"_r(\d+)", os.path.basename(path))
+    return int(m.group(1)) if m else -1
+
+
+def committed_records(repo: str = _REPO) -> List[Tuple[str, dict]]:
+    out = []
+    for path in sorted(glob.glob(os.path.join(repo, "CHURN_MP_r*.json"))):
+        if path.endswith(("_trace.json", "_timeline.json")):
+            continue  # kube-trace / flightrec sidecars, not churn records
+        try:
+            with open(path) as fh:
+                out.append((path, json.load(fh)))
+        except (OSError, ValueError):
+            continue
+    return out
+
+
+def _eligible_baseline(rec: dict) -> bool:
+    return ("error" not in rec and rec.get("all_bound")
+            and isinstance(rec.get("sustained_pods_per_s"), (int, float)))
+
+
+def find_baseline(fresh: dict, fresh_round: int,
+                  repo: str = _REPO) -> Tuple[Optional[str], Optional[dict]]:
+    """Best committed prior record of the same shape: highest sustained
+    rate among strictly-earlier rounds."""
+    shape = shape_key(fresh)
+    best_path, best = None, None
+    for path, rec in committed_records(repo):
+        if round_of(path) >= fresh_round and fresh_round >= 0:
+            continue
+        if not _eligible_baseline(rec) or shape_key(rec) != shape:
+            continue
+        if best is None or rec["sustained_pods_per_s"] > \
+                best["sustained_pods_per_s"]:
+            best_path, best = path, rec
+    return best_path, best
+
+
+def compare(fresh: dict, base: dict) -> dict:
+    """-> {"verdict": "green"|"red", "keys": {...}, "failures": [...],
+    "warnings": [...]}. A key is compared only when the baseline carries
+    it; a REQUIRED key the baseline carries but the fresh record dropped
+    is itself a failure (evidence must not silently disappear)."""
+    keys = {}
+    failures, warnings = [], []
+    for name, path, direction, band, required in KEYS:
+        b = _get_path(base, path)
+        f = _get_path(fresh, path)
+        if b is None:
+            keys[name] = {"status": "skipped", "reason": "no baseline value"}
+            continue
+        if f is None:
+            entry = {"status": "missing", "baseline": b, "required": required}
+            keys[name] = entry
+            (failures if required else warnings).append(
+                f"{name}: present in baseline ({b}) but missing from the "
+                f"fresh record")
+            continue
+        if direction == "higher":
+            limit = b * (1.0 - band)
+            regressed = f < limit
+            delta = (f - b) / b if b else 0.0
+        else:
+            limit = b * (1.0 + band)
+            regressed = f > limit
+            delta = (f - b) / b if b else 0.0
+        entry = {"status": "regressed" if regressed else "ok",
+                 "fresh": f, "baseline": b, "limit": round(limit, 4),
+                 "delta_pct": round(delta * 100.0, 1),
+                 "band_pct": round(band * 100.0, 1),
+                 "direction": direction, "required": required}
+        keys[name] = entry
+        if regressed:
+            msg = (f"{name}: {f} vs baseline {b} "
+                   f"({entry['delta_pct']:+.1f}%, band "
+                   f"{entry['band_pct']:.0f}%, {direction} is better)")
+            (failures if required else warnings).append(msg)
+    return {"verdict": "red" if failures else "green",
+            "keys": keys, "failures": failures, "warnings": warnings}
+
+
+def gate(fresh_path: str, against: str = "", repo: str = _REPO) -> dict:
+    """Full verdict for one record file."""
+    with open(fresh_path) as fh:
+        fresh = json.load(fh)
+    if "error" in fresh:
+        return {"verdict": "skipped", "record": fresh_path,
+                "reason": "aborted run (error record)"}
+    if against:
+        base_path = against
+        with open(base_path) as fh:
+            base = json.load(fh)
+    else:
+        base_path, base = find_baseline(fresh, round_of(fresh_path), repo)
+    if base is None:
+        return {"verdict": "green", "record": fresh_path, "baseline": None,
+                "no_baseline": True,
+                "reason": "no committed prior record of this shape"}
+    out = compare(fresh, base)
+    out["record"] = os.path.basename(fresh_path)
+    out["baseline"] = os.path.basename(base_path)
+    return out
+
+
+def check_committed(repo: str = _REPO, min_round: int = 8) -> List[dict]:
+    """Gate every committed record from ``min_round`` on against its own
+    best prior — the tier-1 regression test over the record trajectory."""
+    results = []
+    for path, rec in committed_records(repo):
+        if round_of(path) < min_round or "error" in rec:
+            continue
+        results.append(gate(path, repo=repo))
+    return results
+
+
+def _print_verdict(res: dict) -> None:
+    print(json.dumps(res, indent=1))
+    if res.get("warnings"):
+        for w in res["warnings"]:
+            print(f"[perfgate] WARNING {w}", file=sys.stderr)
+    if res.get("failures"):
+        for f in res["failures"]:
+            print(f"[perfgate] FAIL {f}", file=sys.stderr)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="perfgate", description=__doc__.splitlines()[0])
+    ap.add_argument("record", nargs="?", help="fresh CHURN_MP record")
+    ap.add_argument("--against", default="",
+                    help="explicit baseline record (default: best "
+                         "committed prior of the same shape)")
+    ap.add_argument("--repo", default=_REPO)
+    ap.add_argument("--check-committed", action="store_true",
+                    help="gate every committed r8+ record against its "
+                         "best prior")
+    args = ap.parse_args(argv)
+    if args.check_committed:
+        results = check_committed(args.repo)
+        red = [r for r in results if r["verdict"] == "red"]
+        for r in results:
+            tag = r["verdict"].upper()
+            print(f"[perfgate] {tag:5s} {r.get('record')} vs "
+                  f"{r.get('baseline')}"
+                  + (f"  ({len(r.get('warnings', []))} warnings)"
+                     if r.get("warnings") else ""))
+            for f in r.get("failures", ()):
+                print(f"[perfgate]   FAIL {f}")
+        print(f"[perfgate] {len(results)} records gated, "
+              f"{len(red)} red")
+        return 1 if red else 0
+    if not args.record:
+        ap.print_usage(sys.stderr)
+        return 2
+    try:
+        res = gate(args.record, against=args.against, repo=args.repo)
+    except (OSError, ValueError) as e:
+        print(f"perfgate: {e}", file=sys.stderr)
+        return 2
+    _print_verdict(res)
+    return 0 if res["verdict"] in ("green", "skipped") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

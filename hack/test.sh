@@ -37,7 +37,7 @@ if [[ "$RACE" == 1 ]]; then
             tests/test_controllers.py tests/test_scheduler.py
             tests/test_integration.py tests/test_solverd.py
             tests/test_incremental.py tests/test_parallel.py
-            tests/test_tracing.py)
+            tests/test_tracing.py tests/test_flightrec.py)
     rc=0
     for ((i = 1; i <= ROUNDS; i++)); do
         echo "=== race round ${i}/${ROUNDS} (switchinterval=1e-6) ==="
@@ -63,4 +63,10 @@ echo "=== tier-2: solver suites under xla_force_host_platform_device_count=8 ===
 XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
     python -m pytest tests/test_parallel.py tests/test_solverd.py \
     tests/test_batch_solver.py -q "$@" || rc=$?
+
+# perfgate: every committed CHURN_MP record from r08 on must still gate
+# green against its own best prior — the sustained-rate trajectory
+# (182/s r04 -> 496.8/s r10) can never silently regress in-tree.
+echo "=== perfgate over committed records ==="
+python hack/perfgate.py --check-committed || rc=$?
 exit "$rc"

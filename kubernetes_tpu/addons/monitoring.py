@@ -1,4 +1,5 @@
-"""Cluster monitoring addon — the heapster analog.
+"""Cluster monitoring addon — the heapster analog, grown into the
+kube-flightrec aggregator.
 
 ref: cluster/addons/cluster-monitoring/ (heapster + influxdb/grafana):
 the reference runs an aggregator that discovers nodes through the API,
@@ -14,6 +15,17 @@ resource metrics. Same shape here:
   memory usage, pods per node via the pod cache) re-exposed as
   Prometheus gauges on its own /metrics endpoint plus a JSON summary at
   /api/v1/model (heapster's model-API path).
+
+kube-flightrec (this file's second half) is the control-plane analog:
+``FlightAggregator`` discovers every control-plane process — including
+each SO_REUSEPORT apiserver worker pid behind one shared port, using the
+drain-until-all-pids-answer pattern kube-trace collection established —
+pulls each process's ``GET /debug/vars?since=<ns>`` metric time-series
+shard incrementally, merges shards on the shared CLOCK_MONOTONIC axis,
+evaluates declarative ``SLORule``s live (``SLOWatchdog`` records alarm
+TRANSITIONS with the offending samples, deduplicated while a rule stays
+in violation), and assembles the ``timeline``/``alarms`` record sections
+the CHURN_MP r11+ contract requires (docs/design/observability.md).
 """
 
 from __future__ import annotations
@@ -24,13 +36,15 @@ import time
 import urllib.request
 from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.client.cache import Reflector, Store
 from kubernetes_tpu.util import metrics as metrics_pkg
 
-__all__ = ["Monitoring", "http_kubelet_fetcher"]
+__all__ = ["Monitoring", "http_kubelet_fetcher",
+           "SLORule", "SLOWatchdog", "FlightAggregator",
+           "default_churn_rules"]
 
 
 def http_kubelet_fetcher(kubelet_port: int = 10250,
@@ -86,6 +100,9 @@ class Monitoring:
         self._g_pods = self.registry.gauge(
             "cluster_pods_assigned", "pods bound to nodes")
         self.model: Dict[str, dict] = {"nodes": {}, "cluster": {}}
+        # optional kube-flightrec aggregator (cmd/monitoring wires it);
+        # the handler then serves /api/v1/timeline + /api/v1/alarms
+        self.flight: Optional["FlightAggregator"] = None
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._srv = ThreadingHTTPServer((host, port), _Handler)
@@ -181,12 +198,19 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         addon: Monitoring = self.server.addon  # type: ignore[attr-defined]
+        flight = getattr(addon, "flight", None)
         if self.path.startswith("/metrics"):
             body = addon.registry.render_text().encode()
             ctype = "text/plain; version=0.0.4"
         elif self.path.startswith("/api/v1/model"):
             with addon._lock:
                 body = json.dumps(addon.model).encode()
+            ctype = "application/json"
+        elif self.path.startswith("/api/v1/timeline") and flight is not None:
+            body = json.dumps(flight.timeline()).encode()
+            ctype = "application/json"
+        elif self.path.startswith("/api/v1/alarms") and flight is not None:
+            body = json.dumps(flight.alarms()).encode()
             ctype = "application/json"
         elif self.path.startswith("/healthz"):
             body, ctype = b"ok", "text/plain"
@@ -199,3 +223,505 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+
+# -- kube-flightrec aggregation ---------------------------------------------
+
+
+class SLORule:
+    """One declarative service-level objective over merged flightrec
+    series.
+
+    ``series``: one name or a tuple of names, summed (exact flightrec
+    series names, labels included; for ``reduce='p50'/'p95'`` the BASE
+    histogram name — bucket series are located by prefix).
+    ``reduce``: how the window of samples becomes one value —
+    ``last`` (newest sample), ``rate`` (window delta / window seconds,
+    for counters), ``p50``/``p95`` (windowed interpolated quantile from
+    histogram bucket deltas).
+    ``op``: ``ceil`` fires when value > threshold, ``floor`` when
+    value < threshold.
+    ``for_s``: the violation must persist this long before the alarm
+    transitions to firing (threshold-crossing debounce).
+    ``service``: restrict to pids whose shard's service name starts with
+    this (None = every process).
+    ``scope``: combine per-pid values with ``sum`` or ``max`` (max keeps
+    the offending pid for the transition record — the per-process RSS
+    ceiling's shape).
+    ``active_only``: rules meaningful only while load is offered (the
+    sustained-binds floor) are suppressed until the harness marks the
+    run active and auto-resolve when it ends.
+    """
+
+    def __init__(self, name: str, series, *, op: str, threshold: float,
+                 reduce: str = "last", window_s: float = 15.0,
+                 for_s: float = 0.0, service: Optional[str] = None,
+                 scope: str = "sum", active_only: bool = False):
+        assert op in ("ceil", "floor"), op
+        assert reduce in ("last", "rate", "p50", "p95"), reduce
+        assert scope in ("sum", "max"), scope
+        self.name = name
+        self.series = (series,) if isinstance(series, str) else tuple(series)
+        self.op = op
+        self.threshold = float(threshold)
+        self.reduce = reduce
+        self.window_s = float(window_s)
+        self.for_s = float(for_s)
+        self.service = service
+        self.scope = scope
+        self.active_only = active_only
+
+    def violated(self, value: float) -> bool:
+        return value > self.threshold if self.op == "ceil" \
+            else value < self.threshold
+
+    def describe(self) -> Dict[str, object]:
+        return {"name": self.name, "series": list(self.series),
+                "reduce": self.reduce, "op": self.op,
+                "threshold": self.threshold, "window_s": self.window_s,
+                "for_s": self.for_s, "service": self.service,
+                "scope": self.scope, "active_only": self.active_only}
+
+
+def default_churn_rules(binds_floor: float = 50.0,
+                        solve_p50_ceil_s: float = 2.0,
+                        queue_ceil: float = 48.0,
+                        rss_ceil_bytes: float = 8 << 30) -> List[SLORule]:
+    """The churn-contract SLO set the r11+ records are judged against:
+    a clean run must end with zero alarm transitions.
+
+    Quantile-ceiling thresholds MUST sit at or below the histogram's
+    top finite bucket (solve: 2.5 s, e2e: 120 s): the windowed quantile
+    conservatively reports that bound when the rank overflows the
+    envelope, so a threshold above it could never fire — silent exactly
+    when the regression is largest."""
+    return [
+        # the headline: work must keep flowing while load is offered
+        SLORule("sustained_binds_floor", "scheduler_wave_pods_total",
+                reduce="rate", op="floor", threshold=binds_floor,
+                window_s=20.0, for_s=30.0, service="scheduler",
+                scope="sum", active_only=True),
+        # the r08 wall, as a live ceiling instead of a post-mortem
+        SLORule("solve_p50_ceiling", "scheduler_wave_solve_seconds",
+                reduce="p50", op="ceil", threshold=solve_p50_ceil_s,
+                window_s=60.0, for_s=10.0, service="scheduler",
+                scope="sum", active_only=True),
+        # per-pod queueing envelope (the r10 latency section, live;
+        # threshold below the 120 s top bucket so overflow still fires)
+        SLORule("e2e_p50_ceiling", "pod_e2e_scheduling_seconds",
+                reduce="p50", op="ceil", threshold=100.0,
+                window_s=60.0, for_s=10.0, service="scheduler",
+                scope="sum", active_only=True),
+        # per-WORKER apiserver core share (ROADMAP item 2's width
+        # visibility): a healthy worker rides ~1.4 cores at full shape;
+        # 4 sustained means a runaway loop, not load
+        SLORule("apiserver_cpu_ceiling", "process_cpu_seconds_total",
+                reduce="rate", op="ceil", threshold=4.0,
+                window_s=20.0, for_s=10.0, service="apiserver",
+                scope="max"),
+        # BUSY backpressure starts at max-queue; alarm at 75% of default
+        SLORule("solverd_queue_saturation", "solverd_queue_depth",
+                reduce="last", op="ceil", threshold=queue_ceil,
+                for_s=5.0, service="solverd", scope="max"),
+        # the three may-never-happen counters, as == 0 invariants
+        SLORule("watch_lag_zero",
+                ("apiserver_watch_lag_drops_total",
+                 "watch_lag_resyncs_total", "watch_events_dropped_total"),
+                reduce="last", op="ceil", threshold=0.0, scope="sum"),
+        SLORule("parity_divergence_zero",
+                ("solverd_mesh_parity_divergent_total",),
+                reduce="last", op="ceil", threshold=0.0, scope="sum"),
+        SLORule("spans_dropped_zero", ("tracing_spans_dropped",),
+                reduce="last", op="ceil", threshold=0.0, scope="sum"),
+        # leak detection: any single control-plane process past the lid
+        SLORule("process_rss_ceiling", "process_resident_bytes",
+                reduce="last", op="ceil", threshold=rss_ceil_bytes,
+                for_s=5.0, scope="max"),
+    ]
+
+
+class SLOWatchdog:
+    """Alarm state machine over rule evaluations: records TRANSITIONS
+    (pending->firing after ``for_s`` of sustained violation, firing->
+    resolved on recovery) with the offending samples — never one entry
+    per bad tick (transition dedup), never a silent recovery."""
+
+    def __init__(self, rules: Sequence[SLORule]):
+        self.rules = list(rules)
+        self._state = {r.name: {"bad_since": None, "firing": False}
+                       for r in self.rules}
+        self.transitions: List[dict] = []
+
+    def firing(self) -> List[str]:
+        return [n for n, st in self._state.items() if st["firing"]]
+
+    def observe(self, rule: SLORule, value: Optional[float], now_ns: int,
+                samples: Sequence = (), active: bool = True,
+                pid: Optional[int] = None) -> Optional[dict]:
+        """Feed one evaluation; returns the transition recorded (if any).
+        ``value=None`` (no data yet) neither fires nor resolves."""
+        st = self._state[rule.name]
+        if value is None:
+            return None
+        violated = rule.violated(value) and \
+            (active or not rule.active_only)
+        if violated:
+            if st["bad_since"] is None:
+                st["bad_since"] = now_ns
+            if not st["firing"] and \
+                    (now_ns - st["bad_since"]) / 1e9 >= rule.for_s:
+                st["firing"] = True
+                tr = {"rule": rule.name, "state": "firing", "t_ns": now_ns,
+                      "value": value, "threshold": rule.threshold,
+                      "op": rule.op, "samples": [list(s) for s in samples]}
+                if pid is not None:
+                    tr["pid"] = pid
+                self.transitions.append(tr)
+                return tr
+        else:
+            st["bad_since"] = None
+            if st["firing"]:
+                st["firing"] = False
+                tr = {"rule": rule.name, "state": "resolved",
+                      "t_ns": now_ns, "value": value,
+                      "threshold": rule.threshold, "op": rule.op}
+                self.transitions.append(tr)
+                return tr
+        return None
+
+
+def _http_vars_fetcher(timeout: float = 5.0) -> Callable[[str], dict]:
+    def fetch(url: str) -> dict:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return json.loads(r.read())
+    return fetch
+
+
+class FlightAggregator:
+    """Pulls every control-plane process's /debug/vars shard, merges the
+    series on the shared monotonic axis, evaluates SLO rules, and
+    assembles the record's ``timeline``/``alarms`` sections.
+
+    ``targets``: ``[{"name": ..., "url": "http://host:port",
+    "workers": N}, ...]`` — ``workers > 1`` means N processes share the
+    URL's listen port via SO_REUSEPORT (apiserver workers) and each poll
+    round keeps GETting until all N distinct pids answered or the
+    attempt budget runs out (a missed worker is counted in
+    ``workers_missed``, never silently absent).
+    """
+
+    # Merged-series bound per (pid, series): plenty for a churn run
+    # (<= ~600 samples at 1 s), a hard lid for the long-lived
+    # cluster-monitoring deployment — without it the aggregator's own
+    # RSS grows ~linearly forever and eventually trips the very
+    # process_rss_ceiling it watches. Oldest half pruned on overflow
+    # (amortized O(1) per append).
+    MAX_SAMPLES_PER_SERIES = 4096
+
+    def __init__(self, targets: Sequence[dict],
+                 rules: Optional[Sequence[SLORule]] = None,
+                 period_s: float = 2.0,
+                 fetch: Optional[Callable[[str], dict]] = None):
+        self.targets = [dict(t) for t in targets]
+        self.period_s = period_s
+        self.watchdog = SLOWatchdog(default_churn_rules()
+                                    if rules is None else rules)
+        self._fetch = fetch or _http_vars_fetcher()
+        self._pids: Dict[int, dict] = {}
+        self._slo: Dict[str, List[list]] = {}
+        self._lock = threading.Lock()
+        self._active = False
+        self._t0_ns: Optional[int] = None
+        self.sample_period_s: Optional[float] = None
+        self.poll_errors = 0
+        self.workers_missed = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "FlightAggregator":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name="flightrec-aggregator")
+            self._thread.start()
+        return self
+
+    def stop(self, final_poll: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=max(5.0, self.period_s * 2))
+            self._thread = None
+        if final_poll:
+            try:
+                self.poll_once()
+            except Exception:
+                pass
+
+    def set_active(self, active: bool) -> None:
+        """The harness marks the offered-load window; ``active_only``
+        rules (the binds floor) evaluate only inside it."""
+        self._active = bool(active)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception:
+                self.poll_errors += 1
+            self._stop.wait(self.period_s)
+
+    # -- pulling -----------------------------------------------------------
+
+    def ingest(self, payload: dict, target: str = "") -> Optional[int]:
+        """Merge one /debug/vars payload (tests feed these directly; the
+        poll loop feeds fetched ones). Dedup is per (pid, series): only
+        samples newer than the newest already merged are appended, so
+        SO_REUSEPORT re-drains and overlapping cursors are idempotent."""
+        pid = payload.get("pid")
+        if pid is None:
+            return None
+        with self._lock:
+            st = self._pids.setdefault(
+                pid, {"service": "", "target": target, "series": {},
+                      "cursor": 0})
+            st["service"] = payload.get("service") or st["service"]
+            if payload.get("period_s"):
+                self.sample_period_s = payload["period_s"]
+            max_t = st["cursor"]
+            for name, s in (payload.get("series") or {}).items():
+                dst = st["series"].setdefault(
+                    name, {"type": s.get("type", ""), "samples": []})
+                last = dst["samples"][-1][0] if dst["samples"] else -1
+                for p in s.get("samples", ()):
+                    if p[0] > last:
+                        dst["samples"].append([p[0], p[1]])
+                        last = p[0]
+                        if p[0] > max_t:
+                            max_t = p[0]
+                        if self._t0_ns is None or p[0] < self._t0_ns:
+                            self._t0_ns = p[0]
+                if len(dst["samples"]) > self.MAX_SAMPLES_PER_SERIES:
+                    del dst["samples"][:len(dst["samples"]) // 2]
+            st["cursor"] = max_t
+        return pid
+
+    def poll_once(self) -> None:
+        for t in self.targets:
+            workers = int(t.get("workers", 1) or 1)
+            with self._lock:
+                cursors = [st["cursor"] for st in self._pids.values()
+                           if st["target"] == t["name"]]
+            since = min(cursors) if len(cursors) >= workers else 0
+            seen = set()
+            for _ in range(max(2, 4 * workers)):
+                if len(seen) >= workers:
+                    break
+                try:
+                    payload = self._fetch(
+                        f"{t['url'].rstrip('/')}/debug/vars?since={since}")
+                except Exception:
+                    self.poll_errors += 1
+                    break
+                pid = self.ingest(payload, target=t["name"])
+                if pid is not None:
+                    seen.add(pid)
+            if len(seen) < workers:
+                self.workers_missed += workers - len(seen)
+        self.evaluate()
+
+    # -- series access -----------------------------------------------------
+
+    def _match_pids(self, service: Optional[str]) -> List[int]:
+        return [pid for pid, st in self._pids.items()
+                if service is None or st["service"].startswith(service)]
+
+    def series_samples(self, name: str,
+                       service: Optional[str] = None) -> List[Tuple[int, list]]:
+        """[(pid, samples)] for one exact series name."""
+        with self._lock:
+            out = []
+            for pid in self._match_pids(service):
+                s = self._pids[pid]["series"].get(name)
+                if s and s["samples"]:
+                    out.append((pid, list(s["samples"])))
+        return out
+
+    def now_ns(self) -> int:
+        with self._lock:
+            cursors = [st["cursor"] for st in self._pids.values()]
+        return max(cursors) if cursors else time.monotonic_ns()
+
+    # -- evaluation --------------------------------------------------------
+
+    @staticmethod
+    def _window(samples: List[list], lo: int) -> List[list]:
+        i = len(samples)
+        while i > 0 and samples[i - 1][0] >= lo:
+            i -= 1
+        return samples[i:]
+
+    def _reduce(self, rule: SLORule, now_ns: int):
+        """-> (value, pid-or-None). None value = no data yet."""
+        lo = now_ns - int(rule.window_s * 1e9)
+        if rule.reduce in ("p50", "p95"):
+            return self._reduce_quantile(rule, lo), None
+        per_pid: Dict[int, float] = {}
+        for name in rule.series:
+            for pid, samples in self.series_samples(name, rule.service):
+                if rule.reduce == "last":
+                    # windowed like rate: a dead pid's frozen final
+                    # sample (crashed solverd at queue_depth=64, OOMed
+                    # worker at peak RSS) must age out of the
+                    # evaluation instead of pinning the alarm firing
+                    # for the rest of the run — its replacement's
+                    # healthy samples are the live truth
+                    if samples[-1][0] < lo:
+                        continue
+                    val = samples[-1][1]
+                else:  # rate: window delta over window seconds
+                    win = self._window(samples, lo)
+                    if len(win) < 2:
+                        continue
+                    dt = (win[-1][0] - win[0][0]) / 1e9
+                    if dt <= 0:
+                        continue
+                    val = max(0.0, (win[-1][1] - win[0][1]) / dt)
+                per_pid[pid] = per_pid.get(pid, 0.0) + val
+        if not per_pid:
+            return None, None
+        if rule.scope == "max":
+            pid = max(per_pid, key=lambda p: per_pid[p])
+            return per_pid[pid], pid
+        return sum(per_pid.values()), None
+
+    def _reduce_quantile(self, rule: SLORule, lo: int) -> Optional[float]:
+        """Windowed quantile: per-pid cumulative bucket deltas over the
+        window, summed across pids, interpolated like the record-side
+        histogram quantiles."""
+        q = 0.5 if rule.reduce == "p50" else 0.95
+        deltas: Dict[float, float] = {}
+        any_series = False
+        for base in rule.series:
+            prefix = base + "_bucket"
+            with self._lock:
+                for pid in self._match_pids(rule.service):
+                    for name, s in self._pids[pid]["series"].items():
+                        if not name.startswith(prefix) or not s["samples"]:
+                            continue
+                        le_s = name.rsplit('le="', 1)[-1].split('"', 1)[0]
+                        le = float("inf") if le_s == "+Inf" else float(le_s)
+                        any_series = True
+                        win = self._window(s["samples"], lo)
+                        if not win:
+                            continue
+                        # window delta of the cumulative count: newest
+                        # in-window value minus the last PRE-window value
+                        # (0 at series birth — the whole history is then
+                        # inside the window)
+                        first_idx = len(s["samples"]) - len(win)
+                        base = s["samples"][first_idx - 1][1] \
+                            if first_idx > 0 else 0.0
+                        deltas[le] = deltas.get(le, 0.0) + win[-1][1] - base
+        if not any_series:
+            return None
+        buckets = sorted(deltas.items())
+        count = buckets[-1][1] if buckets else 0.0
+        if count <= 0:
+            return None
+        target = q * count
+        prev_le, prev_n = 0.0, 0.0
+        for le, n in buckets:
+            if n >= target:
+                if le == float("inf"):
+                    # rank past the finite envelope: report the largest
+                    # finite bound — a conservative UNDER-estimate, so
+                    # ceiling rules must keep their thresholds at or
+                    # below the top finite bucket to stay fireable
+                    return prev_le
+                span = n - prev_n
+                frac = (target - prev_n) / span if span else 1.0
+                return prev_le + (le - prev_le) * frac
+            prev_le, prev_n = le, n
+        return prev_le
+
+    def evaluate(self, now_ns: Optional[int] = None) -> List[dict]:
+        """Evaluate every rule once; appends each evaluated value to its
+        ``slo:<rule>`` derived series (the timeline's headline curves)
+        and feeds the watchdog. Returns new transitions."""
+        now = now_ns if now_ns is not None else self.now_ns()
+        new = []
+        for rule in self.watchdog.rules:
+            value, pid = self._reduce(rule, now)
+            with self._lock:
+                curve = self._slo.setdefault(rule.name, [])
+                if value is not None:
+                    curve.append([now, value])
+                    if len(curve) > self.MAX_SAMPLES_PER_SERIES:
+                        del curve[:len(curve) // 2]
+                window = curve[-30:]
+            tr = self.watchdog.observe(rule, value, now, samples=window,
+                                       active=self._active, pid=pid)
+            if tr is not None:
+                new.append(tr)
+        return new
+
+    # -- record assembly ---------------------------------------------------
+
+    def alarms(self) -> List[dict]:
+        return list(self.watchdog.transitions)
+
+    def timeline(self, max_points: int = 120,
+                 sidecar: str = "") -> Dict[str, object]:
+        """The record's ``timeline`` section: the evaluated SLO curves
+        (one per rule — the headline series), downsampled to
+        ``max_points``, timestamps rebased to seconds from the first
+        merged sample. The full-resolution per-pid series live in the
+        ``_timeline.json`` sidecar, not the record."""
+        with self._lock:
+            t0 = self._t0_ns or 0
+            series = {}
+            for name, pts in self._slo.items():
+                if not pts:
+                    continue
+                stride = max(1, (len(pts) + max_points - 1) // max_points)
+                kept = pts[::stride]
+                if kept[-1] is not pts[-1]:
+                    kept.append(pts[-1])
+                series[f"slo:{name}"] = [
+                    [round((t - t0) / 1e9, 1), round(v, 4)]
+                    for t, v in kept]
+            out = {
+                "sample_period_s": self.sample_period_s or 0.0,
+                "poll_period_s": self.period_s,
+                "t0_ns": t0,
+                "pids": len(self._pids),
+                "poll_errors": self.poll_errors,
+                "workers_missed": self.workers_missed,
+                "series": series,
+                "headline": sorted(series),
+                "rules": [r.describe() for r in self.watchdog.rules],
+            }
+            if sidecar:
+                out["sidecar"] = sidecar
+        return out
+
+    def sidecar_payload(self) -> Dict[str, object]:
+        """The ``<out>_timeline.json`` body: every merged series at full
+        resolution (bucket series excluded — the evaluated quantile
+        curves are the derived view; raw buckets would triple the file
+        for data the SLO curves already summarize), plus the SLO curves
+        and the full alarm transition log."""
+        with self._lock:
+            pids = {}
+            for pid, st in self._pids.items():
+                pids[str(pid)] = {
+                    "service": st["service"], "target": st["target"],
+                    "series": {name: s for name, s in st["series"].items()
+                               if s.get("type") != "bucket"},
+                }
+            return {"t0_ns": self._t0_ns or 0,
+                    "sample_period_s": self.sample_period_s or 0.0,
+                    "pids": pids, "slo": dict(self._slo),
+                    "alarms": list(self.watchdog.transitions)}

@@ -505,6 +505,20 @@ class _Handler(BaseHTTPRequestHandler):
             return 200 if ok else 500
         if head == "debug" and len(parts) >= 2 and parts[1] == "pprof":
             return self._handle_pprof(parts[2:], query)
+        if head == "debug" and len(parts) >= 2 and parts[1] == "vars":
+            # kube-flightrec shard: this process's metric time-series
+            # rings, incremental past the caller's ?since=<ns> cursor.
+            # The first pull ARMS the recorder (lazily, like the span
+            # ring) so aggregator discovery is also activation.
+            if method != "GET":
+                raise errors.new_method_not_supported("vars", method)
+            try:
+                since = int(query.get("since", "0") or "0")
+            except ValueError:
+                since = 0
+            self._send_json(200, json.dumps(self.server.api.flightrec_vars(
+                since)))
+            return 200
         if head == "debug" and len(parts) >= 2 and parts[1] == "trace":
             # drain this process's span ring (kube-trace shard); the churn
             # harness merges every process's shard into one Perfetto file.
@@ -677,11 +691,18 @@ class _Handler(BaseHTTPRequestHandler):
         return 200
 
     def _handle_healthz(self, subpath) -> int:
+        """Deep health (ref: pkg/healthz grown toward ComponentStatus):
+        /healthz probes the components this server actually depends on —
+        store reachability and watch-hub liveness — and answers 503 with
+        the per-component verdicts when any fails. /healthz/ping stays
+        the unconditional liveness answer (process up, serving)."""
         if subpath and subpath[0] == "ping":
             self._send_text(200, "ok")
             return 200
-        self._send_text(200, "ok")
-        return 200
+        payload, ok = self.server.api.health_components()
+        code = 200 if ok else 503
+        self._send_json(code, json.dumps(payload))
+        return code
 
     # ----- watch streaming (ref: pkg/apiserver/watch.go:62-142) ----------
 
@@ -697,7 +718,8 @@ class _Handler(BaseHTTPRequestHandler):
         from kubernetes_tpu.util import pprof
 
         which = rest[0] if rest else ""
-        body = pprof.handle(which, query.get("seconds", ""))
+        body = pprof.handle(which, query.get("seconds", ""),
+                            query.get("format", ""))
         if body is None:
             raise errors.new_not_found("pprof", which)
         self._send_text(200, body)
@@ -1249,6 +1271,55 @@ class APIServer:
     def untrack_watcher(self, w) -> None:
         with self._watch_lock:
             self._watchers.discard(w)
+
+    # -- deep health (ref: pkg/healthz + ComponentStatus) ------------------
+
+    def health_components(self) -> Tuple[Dict[str, Any], bool]:
+        """/healthz body: componentstatus-style per-dependency verdicts
+        using the probe package's result vocabulary. Probes the two
+        things this server cannot serve without: the backing store
+        (in-process, durable, or a remote kube-store — one cheap list
+        proves the round trip) and the watch hub (a subscribe+cancel
+        proves the fan-out layer still accepts watchers)."""
+        from kubernetes_tpu import probe
+
+        items = []
+        ok = True
+        try:
+            self.master.dispatch("list", "namespaces")
+            items.append({"name": "store", "status": probe.SUCCESS,
+                          "message": "list round-trip ok"})
+        except Exception as e:
+            items.append({"name": "store", "status": probe.FAILURE,
+                          "message": repr(e)})
+            ok = False
+        try:
+            w, _translate = self.master.dispatch(
+                "watch_raw", "namespaces", namespace="", label_selector="",
+                field_selector="", resource_version="", user=None,
+                lag_limit=16)
+            w.stop()
+            items.append({"name": "watch-hub", "status": probe.SUCCESS,
+                          "message": "subscribe ok"})
+        except Exception as e:
+            items.append({"name": "watch-hub", "status": probe.FAILURE,
+                          "message": repr(e)})
+            ok = False
+        return ({"kind": "ComponentStatusList", "healthy": ok,
+                 "items": items}, ok)
+
+    # -- kube-flightrec ----------------------------------------------------
+
+    def flightrec_vars(self, since_ns: int = 0) -> Dict[str, Any]:
+        """The /debug/vars shard. First pull arms the sampler (lazy, like
+        the kube-trace ring) and registers this server's per-instance
+        metrics Registry alongside the process default registry."""
+        if not metrics_pkg.flightrec_armed():
+            metrics_pkg.flightrec_arm(service="apiserver", sample=False)
+        metrics_pkg.flightrec_watch(self.metrics_registry)
+        if since_ns == 0:
+            metrics_pkg.flightrec_sample_now()
+        return metrics_pkg.flightrec_vars(since_ns)
 
     # -- cluster validation (ref: master.go:516-551) ----------------------
 

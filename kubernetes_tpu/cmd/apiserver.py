@@ -74,6 +74,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "scheduler wave's commit leg); drain via "
                         "GET /debug/trace. Default OFF — untraced "
                         "requests never record.")
+    p.add_argument("--flightrec", action="store_true",
+                   help="kube-flightrec: sample every metric series into "
+                        "the per-process (monotonic_ns, value) ring from "
+                        "boot, served incrementally at GET /debug/vars. "
+                        "Default OFF (lazy: the first /debug/vars pull "
+                        "arms sampling anyway; this flag just makes the "
+                        "rings span the whole run).")
+    p.add_argument("--flightrec-period", "--flightrec_period", type=float,
+                   default=1.0, help="flight recorder sample period, "
+                        "seconds")
     return p
 
 
@@ -158,6 +168,11 @@ def apiserver_server(argv: List[str],
         from kubernetes_tpu.util import tracing
         tracing.enable("apiserver")
     srv = build_server(opts)
+    if getattr(opts, "flightrec", False):
+        from kubernetes_tpu.util import metrics as metrics_pkg
+        metrics_pkg.flightrec_arm(
+            "apiserver", period_s=getattr(opts, "flightrec_period", 1.0))
+        metrics_pkg.flightrec_watch(srv.metrics_registry)
     srv.start()
     print(f"kube-apiserver listening on {srv.base_url}", file=sys.stderr)
     ro = getattr(srv, "read_only_server", None)

@@ -1,5 +1,10 @@
 """cluster-monitoring binary — the heapster-analog aggregator
-(ref: cluster/addons/cluster-monitoring deployment)."""
+(ref: cluster/addons/cluster-monitoring deployment), grown into the
+kube-flightrec control-plane aggregator: with ``--flightrec-target``
+it also pulls every named process's /debug/vars metric time-series
+shard, merges them on the shared monotonic axis, evaluates the churn
+SLO rule set live, and serves the merged timeline + alarm transitions
+at /api/v1/timeline and /api/v1/alarms."""
 
 from __future__ import annotations
 
@@ -22,7 +27,30 @@ def build_parser() -> argparse.ArgumentParser:
                    default=10250)
     p.add_argument("--period", type=float, default=5.0,
                    help="scrape period seconds")
+    p.add_argument("--flightrec-target", "--flightrec_target",
+                   action="append", default=[],
+                   help="NAME=URL[,WORKERS] of a control-plane process "
+                        "debug server to pull /debug/vars from "
+                        "(repeatable; WORKERS>1 = SO_REUSEPORT worker "
+                        "processes sharing the URL's port, each poll "
+                        "drains until all pids answered). E.g. "
+                        "apiserver=http://127.0.0.1:8080,4")
+    p.add_argument("--flightrec-period", "--flightrec_period", type=float,
+                   default=2.0, help="flightrec pull period seconds")
     return p
+
+
+def parse_flightrec_targets(specs: List[str]) -> List[dict]:
+    out = []
+    for spec in specs:
+        name, _, rest = spec.partition("=")
+        url, _, workers = rest.partition(",")
+        if not name or not url:
+            raise ValueError(f"bad --flightrec-target {spec!r} "
+                             "(want NAME=URL[,WORKERS])")
+        out.append({"name": name, "url": url,
+                    "workers": int(workers) if workers else 1})
+    return out
 
 
 def monitoring_server(argv: List[str],
@@ -43,9 +71,23 @@ def monitoring_server(argv: List[str],
     client = Client(HTTPTransport(opts.master))
     mon = Monitoring(client, fetch=http_kubelet_fetcher(opts.kubelet_port),
                      period_s=opts.period, host=opts.address,
-                     port=opts.port).start()
+                     port=opts.port)
+    flight = None
+    if opts.flightrec_target:
+        from kubernetes_tpu.addons.monitoring import FlightAggregator
+        try:
+            targets = parse_flightrec_targets(opts.flightrec_target)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        flight = FlightAggregator(targets,
+                                  period_s=opts.flightrec_period).start()
+        mon.flight = flight
+    mon.start()
     print(f"cluster-monitoring on http://{opts.address}:{mon.port} "
-          f"(/metrics, /api/v1/model)", file=sys.stderr)
+          f"(/metrics, /api/v1/model"
+          + (", /api/v1/timeline, /api/v1/alarms" if flight else "")
+          + ")", file=sys.stderr)
     if ready is not None:
         ready.set()
     stop = stop or threading.Event()
@@ -53,6 +95,8 @@ def monitoring_server(argv: List[str],
         stop.wait()
     except KeyboardInterrupt:
         pass
+    if flight is not None:
+        flight.stop(final_poll=False)
     mon.stop()
     return 0
 

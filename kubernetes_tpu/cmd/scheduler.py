@@ -75,13 +75,33 @@ def build_parser() -> argparse.ArgumentParser:
                         "GET /debug/trace on --metrics-port. Default OFF — "
                         "the disabled path is a single branch per call "
                         "site (docs/design/observability.md).")
+    p.add_argument("--flightrec", action="store_true",
+                   help="kube-flightrec: sample every metric series into "
+                        "a per-process (monotonic_ns, value) ring from "
+                        "boot, served incrementally at GET /debug/vars on "
+                        "--metrics-port. Default OFF (the first "
+                        "/debug/vars pull arms sampling lazily anyway).")
+    p.add_argument("--flightrec-period", "--flightrec_period", type=float,
+                   default=1.0,
+                   help="flight recorder sample period, seconds")
     return p
 
 
-def _serve_debug(port: int) -> None:
-    """Minimal observability server for the scheduler binary."""
+def _serve_debug(port: int, service: str = "scheduler",
+                 health=None) -> None:
+    """Shared observability server for the non-apiserver binaries
+    (scheduler, solverd): /metrics, deep /healthz (+ /healthz/ping
+    liveness), /debug/pprof, /debug/trace, /debug/vars.
+
+    ``health`` is a zero-arg callable returning componentstatus-style
+    ``(payload dict, ok bool)`` — each binary probes ITS dependencies
+    (scheduler: master + solverd connectivity; solverd: solver backend +
+    mesh devices). None keeps the bare liveness 200."""
+    import json
+    import urllib.parse
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+    from kubernetes_tpu.util import metrics as metrics_pkg
     from kubernetes_tpu.util import pprof as pprof_util
     from kubernetes_tpu.util.metrics import default_registry
 
@@ -90,25 +110,49 @@ def _serve_debug(port: int) -> None:
             pass
 
         def do_GET(self):
+            ctype = "text/plain; charset=utf-8"
             if self.path.startswith("/debug/pprof"):
-                import urllib.parse
                 parsed = urllib.parse.urlsplit(self.path)
                 which = parsed.path[len("/debug/pprof"):].strip("/")
                 q = dict(urllib.parse.parse_qsl(parsed.query))
-                body = pprof_util.handle(which, q.get("seconds", ""))
+                body = pprof_util.handle(which, q.get("seconds", ""),
+                                         q.get("format", ""))
                 code = 200 if body is not None else 404
                 body = body if body is not None else "not found"
-            elif self.path == "/healthz":
-                code, body = 200, "ok"
+            elif self.path == "/healthz/ping":
+                code, body = 200, "ok"  # liveness: process up, serving
+            elif self.path.startswith("/healthz"):
+                if health is None:
+                    code, body = 200, "ok"
+                else:
+                    try:
+                        payload, ok = health()
+                    except Exception as e:
+                        payload, ok = {"healthy": False,
+                                       "error": repr(e)}, False
+                    code = 200 if ok else 503
+                    body, ctype = json.dumps(payload), "application/json"
             elif self.path == "/metrics":
                 code, body = 200, default_registry().render_text()
+            elif self.path.startswith("/debug/vars"):
+                # kube-flightrec shard: incremental metric time-series
+                # past the ?since=<ns> cursor; the first pull arms the
+                # sampler (lazy, like the kube-trace span ring)
+                q = dict(urllib.parse.parse_qsl(
+                    urllib.parse.urlsplit(self.path).query))
+                if not metrics_pkg.flightrec_armed():
+                    metrics_pkg.flightrec_arm(service)
+                try:
+                    since = int(q.get("since", "0") or "0")
+                except ValueError:
+                    since = 0
+                code = 200
+                body = json.dumps(metrics_pkg.flightrec_vars(since))
+                ctype = "application/json"
             elif self.path.startswith("/debug/trace"):
                 # kube-trace shard drain (?peek=1 reads without resetting
                 # the cursor) — the churn harness merges every process's
                 # shard into one Perfetto-loadable file
-                import json
-                import urllib.parse
-
                 from kubernetes_tpu.util import tracing
                 q = dict(urllib.parse.parse_qsl(
                     urllib.parse.urlsplit(self.path).query))
@@ -119,7 +163,7 @@ def _serve_debug(port: int) -> None:
                 code, body = 404, "not found"
             raw = body.encode()
             self.send_response(code)
-            self.send_header("Content-Type", "text/plain; charset=utf-8")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(raw)))
             self.end_headers()
             self.wfile.write(raw)
@@ -127,7 +171,39 @@ def _serve_debug(port: int) -> None:
     srv = ThreadingHTTPServer(("127.0.0.1", port), H)
     srv.daemon_threads = True
     threading.Thread(target=srv.serve_forever, daemon=True,
-                     name="scheduler-debug-http").start()
+                     name=f"{service}-debug-http").start()
+
+
+def _scheduler_health(master: str, solver_addr: str):
+    """Deep-health probe set for the scheduler binary: can it reach the
+    binder (the apiserver it commits waves to) and — when configured —
+    the shared solver daemon. componentstatus-style payload, non-200
+    handled by the caller."""
+    import urllib.parse
+
+    from kubernetes_tpu import probe
+
+    def health():
+        items = []
+        ok = True
+        u = urllib.parse.urlparse(master)
+        st, msg = probe.probe_http(u.hostname, u.port, "/healthz/ping")
+        items.append({"name": "binder", "status": st,
+                      "message": msg if st != probe.SUCCESS else
+                      f"apiserver {master} reachable"})
+        ok &= st == probe.SUCCESS
+        if solver_addr:
+            host, _, sport = solver_addr.partition(":")
+            st, msg = probe.probe_tcp(host or "127.0.0.1", int(sport))
+            items.append({"name": "solver", "status": st,
+                          "message": msg if st != probe.SUCCESS else
+                          f"kube-solverd {solver_addr} reachable"})
+            # a dead daemon is DEGRADED, not down: RemoteSolver falls
+            # back to in-process solves, so it does not fail liveness
+        return ({"kind": "ComponentStatusList", "healthy": bool(ok),
+                 "items": items}, bool(ok))
+
+    return health
 
 
 def build_scheduler(opts):
@@ -196,9 +272,15 @@ def scheduler_server(argv: List[str],
     if getattr(opts, "trace", False):
         from kubernetes_tpu.util import tracing
         tracing.enable("scheduler")
+    if getattr(opts, "flightrec", False):
+        from kubernetes_tpu.util import metrics as metrics_pkg
+        metrics_pkg.flightrec_arm(
+            "scheduler", period_s=getattr(opts, "flightrec_period", 1.0))
     factory, sched = build_scheduler(opts)
     if getattr(opts, "metrics_port", 0):
-        _serve_debug(opts.metrics_port)
+        _serve_debug(opts.metrics_port, service="scheduler",
+                     health=_scheduler_health(
+                         opts.master, getattr(opts, "solver_addr", "")))
     sched.run()
     print(f"kube-scheduler running ({opts.algorithm})", file=sys.stderr)
     if ready is not None:

@@ -79,6 +79,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "attached to the requesting wave's trace when the "
                         "v3 frame carries one; drain via GET /debug/trace "
                         "on --metrics-port. Default OFF.")
+    p.add_argument("--flightrec", action="store_true",
+                   help="kube-flightrec: sample every metric series into "
+                        "a per-process (monotonic_ns, value) ring from "
+                        "boot, served incrementally at GET /debug/vars on "
+                        "--metrics-port. Default OFF (the first "
+                        "/debug/vars pull arms sampling lazily anyway).")
+    p.add_argument("--flightrec-period", "--flightrec_period", type=float,
+                   default=1.0,
+                   help="flight recorder sample period, seconds")
     p.add_argument("--trace-device", "--trace_device", default="",
                    help="directory for a jax.profiler device trace of the "
                         "daemon's solves (open in Perfetto/TensorBoard "
@@ -87,6 +96,42 @@ def build_parser() -> argparse.ArgumentParser:
                         "own profiler, started at daemon boot and stopped "
                         "on shutdown.")
     return p
+
+
+def _solverd_health(srv):
+    """Deep-health probe set for the daemon: the solver backend (a JAX
+    runtime that lost its devices cannot serve waves) and — when the
+    mesh dispatch is on — the device mesh itself. componentstatus-style
+    payload; the metrics-port server answers 503 when unhealthy."""
+    from kubernetes_tpu import probe
+
+    def health():
+        items = []
+        ok = True
+        try:
+            import jax
+            n = jax.device_count()
+            backend = jax.default_backend()
+            st = probe.SUCCESS if n >= 1 else probe.FAILURE
+            items.append({"name": "backend", "status": st,
+                          "message": f"{backend}, {n} device(s)"})
+            ok &= st == probe.SUCCESS
+        except Exception as e:
+            items.append({"name": "backend", "status": probe.FAILURE,
+                          "message": repr(e)})
+            ok = False
+        me = getattr(srv, "_mesh_exec", None)
+        if me is not None:
+            shards = getattr(me, "node_shards", 0)
+            st = probe.SUCCESS if shards >= 1 else probe.FAILURE
+            items.append({"name": "mesh", "status": st,
+                          "message": f"{shards} node-shard(s) x "
+                                     f"{getattr(me, 'pods_axis', 1)} pods"})
+            ok &= st == probe.SUCCESS
+        return ({"kind": "ComponentStatusList", "healthy": bool(ok),
+                 "items": items}, bool(ok))
+
+    return health
 
 
 def solverd_server(argv: List[str],
@@ -130,9 +175,14 @@ def solverd_server(argv: List[str],
                         mesh_min_nodes=opts.mesh_min_nodes,
                         mesh_dispatch=opts.mesh_dispatch,
                         mesh_probe=opts.mesh_probe)
+    if opts.flightrec:
+        from kubernetes_tpu.util import metrics as metrics_pkg
+        metrics_pkg.flightrec_arm("solverd",
+                                  period_s=opts.flightrec_period)
     if opts.metrics_port:
         from kubernetes_tpu.cmd.scheduler import _serve_debug
-        _serve_debug(opts.metrics_port)
+        _serve_debug(opts.metrics_port, service="solverd",
+                     health=_solverd_health(srv))
     me = srv._mesh_exec
     mesh_desc = (f", mesh {me.node_shards} node-shards x "
                  f"{me.pods_axis} pods (min {me.min_nodes} nodes, "
@@ -170,6 +220,11 @@ def solverd_server(argv: List[str],
 
 def main() -> int:
     signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
+    # the Go-runtime SIGQUIT affordance: kill -USR1 <pid> dumps every
+    # thread's stack to stderr (the child log) — the tool of last resort
+    # when the daemon wedges hard enough that /debug/pprof can't answer
+    import faulthandler
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
     return solverd_server(sys.argv[1:])
 
 

@@ -85,18 +85,30 @@ def _donation_warnings_scoped():
 
 
 @functools.lru_cache(maxsize=256)
-def _scatter_fn(sharding):
-    """Row scatter that keeps the plane's sharding and donates the old
-    buffer: the copy-on-write delta apply, on device. Donation is safe
-    because the executor owns the resident buffer exclusively and the
+def _scatter_fn(sharding, donate: bool = True):
+    """Row scatter that keeps the plane's sharding and (by default)
+    donates the old buffer: the copy-on-write delta apply, on device.
+
+    Donation is safe ONLY for buffers XLA itself produced (a previous
+    scatter's output): the executor owns those exclusively and the
     previous wave's solve has been read back before the next delta
-    arrives (the solve thread is single)."""
+    arrives (the solve thread is single). A buffer that came from
+    ``jax.device_put`` of a host numpy array may ALIAS that array's
+    memory on the CPU backend (zero-copy when alignment allows) — the
+    delta cache keeps the host array alive for identity chaining, so
+    donating the aliased device buffer frees memory numpy still owns and
+    corrupts the native heap (observed live as ``malloc(): unsorted
+    double linked list corrupted`` killing the daemon mid-churn; the
+    in-process path in parallel/mesh.py documents the same hazard).
+    The first delta after a fresh establish therefore uses the
+    non-donating variant; every later delta donates."""
     import jax
 
     def f(base, rows, vals):
         return base.at[rows].set(vals)
 
-    return jax.jit(f, out_shardings=sharding, donate_argnums=(0,))
+    return jax.jit(f, out_shardings=sharding,
+                   donate_argnums=(0,) if donate else ())
 
 
 def _pow2_rows(rows: np.ndarray, vals: np.ndarray):
@@ -144,7 +156,13 @@ class MeshExecutor:
         self.probe = probe
         self.cache_entries = cache_entries
         self._pm = pm
-        # (wid, bucket) -> {"mesh": Mesh, "planes": {name: (src, dev)}}
+        # (wid, bucket) -> {"mesh": Mesh,
+        #                   "planes": {name: (src, dev, xla_owned)}}
+        # src: the host numpy object (identity chain anchor); dev: the
+        # device buffer; xla_owned: True only when dev came out of an
+        # XLA program (scatter output) — a device_put-established dev
+        # may ALIAS src on the CPU backend and must NEVER be donated
+        # (see _scatter_fn)
         self._resident: "OrderedDict[tuple, dict]" = OrderedDict()
         self._resident_bytes = 0
         # keys whose residency was LRU-evicted: their next wave's full
@@ -334,7 +352,7 @@ class MeshExecutor:
         entry = self._resident.get(cache_key) if cache_key else None
         # freed covers the entry as it WAS, so a layout flip (same key
         # rebuilt under the other mesh) can't leak resident_bytes upward
-        freed = sum(d.nbytes for _s, d in entry["planes"].values()) \
+        freed = sum(rec[1].nbytes for rec in entry["planes"].values()) \
             if entry is not None else 0
         lost_layout = entry is not None and entry["mesh"] is not mesh
         # residency lost wholesale (layout flip, or this key was LRU-
@@ -358,36 +376,44 @@ class MeshExecutor:
                 vals = self._pad_vals(name, vals, pad)
                 rows, vals = _pow2_rows(np.ascontiguousarray(rows),
                                         np.ascontiguousarray(vals))
+                # donate only XLA-owned bases: a device_put-established
+                # base may alias the cached host array (see _scatter_fn)
                 with _donation_warnings_scoped():
-                    dev = _scatter_fn(getattr(sh, name))(rec[1], rows, vals)
+                    dev = _scatter_fn(getattr(sh, name),
+                                      donate=rec[2])(rec[1], rows, vals)
                 transfer += rows.nbytes + vals.nbytes
+                xla_owned = True
             else:
                 # host-side single-plane pad (PAD_SPEC): only THIS plane
-                # is re-established — never a full padded input set
+                # is re-established — never a full padded input set.
+                # The device buffer may ALIAS arr on the CPU backend
+                # (zero-copy device_put): xla_owned=False keeps it out of
+                # every donation path
                 arr = pm.pad_plane(name, cur, pad)
                 dev = jax.device_put(np.ascontiguousarray(arr),
                                      getattr(sh, name))
                 transfer += arr.nbytes
+                xla_owned = False
                 if rec is not None or lost_residency:
                     # had residency, lost the identity chain (out-of-
                     # order base, eviction, layout flip): the cost this
                     # path must keep near zero between back-to-back waves
                     reshard += arr.nbytes
-            entry["planes"][name] = (cur, dev)
+            entry["planes"][name] = (cur, dev, xla_owned)
             resident_dev.append(dev)
         if cache_key is not None:
             self._resident[cache_key] = entry
             self._resident.move_to_end(cache_key)
             self._evicted.discard(cache_key)
             self._resident_bytes += sum(
-                d.nbytes for _s, d in entry["planes"].values()) - freed
+                rec[1].nbytes for rec in entry["planes"].values()) - freed
             while len(self._resident) > self.cache_entries:
                 _k, old = self._resident.popitem(last=False)
                 if len(self._evicted) > 16 * self.cache_entries:
                     self._evicted.clear()
                 self._evicted.add(_k)
                 self._resident_bytes -= sum(
-                    d.nbytes for _s, d in old["planes"].values())
+                    rec[1].nbytes for rec in old["planes"].values())
             self._m.resident_bytes.set(self._resident_bytes)
             if was_new:
                 # once per bucket: the per-device footprint evidence
@@ -413,7 +439,13 @@ class MeshExecutor:
             tracing.record("mesh.planes", t_pl0, time.monotonic_ns(),
                            parent=tctx, transfer=transfer, reshard=reshard)
         t_dv0 = time.monotonic_ns()
-        fn = pm.sharded_program(mesh, pol, gangs, donate=True)
+        # donate=False: every wave plane above came from device_put of a
+        # request-owned host array and may alias it on the CPU backend —
+        # donating an aliased buffer hands numpy-owned memory to XLA's
+        # allocator and corrupts the native heap (the malloc() abort that
+        # killed the daemon mid-churn until flightrec pinned the timing).
+        # The wave planes are [P]-scale; forgoing their reuse costs ~KBs.
+        fn = pm.sharded_program(mesh, pol, gangs, donate=False)
         with _donation_warnings_scoped():
             chosen, scores = fn(tuple(resident_dev), tuple(wave_dev))
             both = np.asarray(jnp.stack([chosen, scores]))

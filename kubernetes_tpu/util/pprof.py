@@ -32,11 +32,15 @@ __all__ = ["dump_stacks", "cpu_profile", "heap_profile", "index", "handle"]
 _profile_slot = None  # created lazily; one sampler at a time process-wide
 
 
-def handle(which: str, seconds_arg: str = "") -> "str | None":
+def handle(which: str, seconds_arg: str = "",
+           format_arg: str = "") -> "str | None":
     """Shared endpoint dispatch for every binary's /debug/pprof mount.
     Returns the response text, or None for an unknown endpoint. At most
     one CPU profile runs at a time — stacked 100Hz all-thread samplers
-    under the GIL would degrade the very loops being profiled."""
+    under the GIL would degrade the very loops being profiled.
+    ``format_arg`` applies to the CPU profile: '' (flat text report) or
+    'collapsed' (folded stacks, one ``frame;frame;frame count`` line per
+    distinct stack — pipe straight into flamegraph.pl / speedscope)."""
     global _profile_slot
     if which in ("", "index"):
         return index()
@@ -52,7 +56,7 @@ def handle(which: str, seconds_arg: str = "") -> "str | None":
         if not _profile_slot.acquire(blocking=False):
             return "a profile is already in progress; retry later\n"
         try:
-            return cpu_profile(seconds)
+            return cpu_profile(seconds, fmt=format_arg)
         finally:
             _profile_slot.release()
     if which == "heap":
@@ -63,7 +67,8 @@ def handle(which: str, seconds_arg: str = "") -> "str | None":
 def index() -> str:
     return ("/debug/pprof/\n"
             "  goroutine  — live thread stacks\n"
-            "  profile    — CPU profile (?seconds=N, default 5)\n"
+            "  profile    — CPU profile (?seconds=N, default 5; "
+            "&format=collapsed for flamegraph folded stacks)\n"
             "  heap       — top allocation sites (tracemalloc)\n")
 
 
@@ -79,15 +84,24 @@ def dump_stacks() -> str:
     return "\n".join(out)
 
 
-def cpu_profile(seconds: float = 5.0, hz: int = 100) -> str:
+def cpu_profile(seconds: float = 5.0, hz: int = 100, fmt: str = "") -> str:
     """Statistical whole-process CPU profile: sample every thread's stack
     for ``seconds`` and report where time is spent. Self = frames on top,
-    cumulative = frames anywhere on a sampled stack."""
+    cumulative = frames anywhere on a sampled stack.
+
+    ``fmt='collapsed'`` emits Brendan Gregg folded stacks instead of the
+    flat report: one ``root;...;leaf count`` line per distinct sampled
+    stack (root first), the input format of flamegraph.pl, speedscope,
+    and inferno — a profile drops straight into flamegraph tooling with
+    no converter. Frames are ``file.py:func`` (semicolons in paths are
+    replaced — they would split the frame)."""
     seconds = max(0.1, min(seconds, 60.0))
     interval = 1.0 / hz
     me = threading.get_ident()
+    collapsed = fmt == "collapsed"
     self_counts: Dict[Tuple[str, int, str], int] = collections.Counter()
     cum_counts: Dict[Tuple[str, int, str], int] = collections.Counter()
+    stack_counts: Dict[Tuple[str, ...], int] = collections.Counter()
     samples = 0
     deadline = time.monotonic() + seconds
     while time.monotonic() < deadline:
@@ -97,9 +111,12 @@ def cpu_profile(seconds: float = 5.0, hz: int = 100) -> str:
             samples += 1
             seen = set()
             top = True
+            stack = [] if collapsed else None
             f = frame
             while f is not None:
                 key = (f.f_code.co_filename, f.f_lineno, f.f_code.co_name)
+                if stack is not None:
+                    stack.append(_fold_frame(f))
                 if top:
                     self_counts[key] += 1
                     top = False
@@ -107,7 +124,15 @@ def cpu_profile(seconds: float = 5.0, hz: int = 100) -> str:
                     cum_counts[key] += 1
                     seen.add(key)
                 f = f.f_back
+            if stack is not None:
+                stack.reverse()  # folded format reads root -> leaf
+                stack_counts[tuple(stack)] += 1
         time.sleep(interval)
+    if collapsed:
+        return "\n".join(
+            ";".join(stack) + f" {count}"
+            for stack, count in sorted(stack_counts.items(),
+                                       key=lambda kv: -kv[1])) + "\n"
     lines = [f"cpu profile: {samples} samples over {seconds:.1f}s "
              f"({hz}Hz, all threads except profiler)",
              f"{'self':>6} {'cum':>6}  location"]
@@ -118,6 +143,15 @@ def cpu_profile(seconds: float = 5.0, hz: int = 100) -> str:
         lines.append(f"{self_counts[key]:>6} {cum_counts[key]:>6}  "
                      f"{name} ({fn}:{line})")
     return "\n".join(lines) + "\n"
+
+
+def _fold_frame(f) -> str:
+    """One folded-stack frame label: basename:function, sanitized of the
+    two characters the folded format reserves (';' splits frames, ' '
+    splits the count)."""
+    import os
+    name = f"{os.path.basename(f.f_code.co_filename)}:{f.f_code.co_name}"
+    return name.replace(";", ",").replace(" ", "_")
 
 
 def heap_profile(top: int = 30) -> str:

@@ -65,7 +65,7 @@ from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 __all__ = ["HEADER", "enabled", "enable", "disable", "span", "child_span",
            "start", "record", "current", "new_ctx", "wire", "parse",
-           "drain", "chrome_trace", "NOP"]
+           "drain", "loss_peek", "chrome_trace", "NOP"]
 
 HEADER = "X-KTPU-Trace"
 
@@ -331,6 +331,26 @@ def _emit(name, ctx, psid, t0, end, attrs) -> None:
 
 
 # -- collection -------------------------------------------------------------
+
+def loss_peek() -> Optional[int]:
+    """Unread-span loss estimate WITHOUT draining: spans evicted since
+    the last drain (the flight recorder samples this once per second as
+    the ``tracing_spans_dropped`` gauge feeding the spans-dropped SLO).
+    None when tracing was never enabled — the sampler then records no
+    series rather than a fake healthy zero."""
+    ring = _state.ring
+    if ring is None:
+        return None
+    with ring._drain_lock:
+        lo = ring._drained_through
+        live = hi = 0
+        for s in ring.slots:
+            if s is not None and s[0] >= lo:
+                live += 1
+                if s[0] >= hi:
+                    hi = s[0] + 1
+        return max(0, (hi - lo) - live)
+
 
 def drain(reset: bool = True) -> Dict[str, Any]:
     """The ``GET /debug/trace`` payload: this process's span shard.

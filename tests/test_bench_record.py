@@ -215,6 +215,43 @@ def test_churn_record_schema_latency_section_gated_by_round():
     assert "latency.spans_dropped" in missing
 
 
+def test_churn_record_schema_timeline_section_gated_by_round():
+    """r10 records predate kube-flightrec; r11+ must carry the timeline
+    section (>= 5 headline series) and the SLO alarm transition log, so
+    the continuous-series evidence — and the proof the clean run fired
+    zero alarms — can't be silently dropped."""
+    churn_mp = _load_churn_mp()
+    rec = _churn_sample_record()
+    rec["solverd"]["mesh"] = {k: 1 for k in churn_mp.SOLVERD_MESH_FIELDS}
+    rec["latency"] = {k: 1 for k in churn_mp.LATENCY_FIELDS}
+    assert churn_mp.validate_record(rec, round_no=10) == []
+    missing = churn_mp.validate_record(rec, round_no=11)
+    assert "timeline" in missing and "alarms" in missing
+    rec["timeline"] = {
+        "sample_period_s": 1.0, "poll_period_s": 2.0, "t0_ns": 123,
+        "pids": 4, "poll_errors": 0, "workers_missed": 0,
+        "series": {f"slo:rule{i}": [[0.0, 1.0], [2.0, 1.5]]
+                   for i in range(6)},
+        "headline": [f"slo:rule{i}" for i in range(6)],
+    }
+    rec["alarms"] = []
+    assert churn_mp.validate_record(rec, round_no=11) == []
+    # fewer than the contract's 5 headline series is non-conformant
+    rec["timeline"]["series"] = {"slo:rule0": [[0.0, 1.0]]}
+    missing = churn_mp.validate_record(rec, round_no=11)
+    assert any(m.startswith("timeline.series:") for m in missing)
+    rec["timeline"]["series"] = {f"slo:rule{i}": [[0.0, 1.0]]
+                                 for i in range(6)}
+    del rec["timeline"]["headline"]
+    assert "timeline.headline" in churn_mp.validate_record(rec,
+                                                           round_no=11)
+    rec["timeline"]["headline"] = list(rec["timeline"]["series"])
+    # alarms must be a LIST (a clean run records []; a dict or absence
+    # would let "zero alarms" be claimed without the log)
+    rec["alarms"] = {}
+    assert "alarms" in churn_mp.validate_record(rec, round_no=11)
+
+
 def test_committed_churn_records_conform():
     """Every committed CHURN_MP record from r07 on must satisfy the
     schema (r08+ additionally the apiserver hot-path fields) — the
@@ -222,8 +259,8 @@ def test_committed_churn_records_conform():
     future round's record."""
     churn_mp = _load_churn_mp()
     for path in glob.glob(os.path.join(_REPO, "CHURN_MP_r*.json")):
-        if path.endswith("_trace.json"):
-            continue  # merged kube-trace sidecar, not a churn record
+        if path.endswith(("_trace.json", "_timeline.json")):
+            continue  # kube-trace / flightrec sidecars, not churn records
         round_no = int(path.rsplit("_r", 1)[1].split("_")[0].split(".")[0])
         if round_no < 7:
             continue  # pre-contract records are historical evidence

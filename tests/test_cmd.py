@@ -2,6 +2,7 @@
 binary; each server built from its flag surface, run in-thread)."""
 
 import io
+import json
 import socket
 import threading
 import time
@@ -114,7 +115,8 @@ def test_standalone_binary(tmp_path):
     try:
         with urllib.request.urlopen(
                 f"http://127.0.0.1:{port}/healthz", timeout=5) as r:
-            assert r.read() == b"ok"
+            # deep healthz: componentstatus-style JSON, 200 when healthy
+            assert json.loads(r.read())["healthy"] is True
         with urllib.request.urlopen(
                 f"http://127.0.0.1:{port}/api/v1/nodes", timeout=5) as r:
             assert b"node-0" in r.read()

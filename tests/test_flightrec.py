@@ -1,0 +1,578 @@
+"""kube-flightrec: the sampler ring (bound/evict/cursor semantics,
+counter-rate derivation, disarmed-path discipline), the SLO watchdog
+(threshold crossing, transition dedup, recovery, active gating), the
+aggregator's multi-pid merge incl. the SO_REUSEPORT drain-until-all-
+pids-answer pattern, the /debug/vars endpoints, and the deep /healthz
+componentstatus contract on every control-plane binary."""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.addons.monitoring import (FlightAggregator, SLORule,
+                                              SLOWatchdog,
+                                              default_churn_rules)
+from kubernetes_tpu.apiserver.http import APIServer
+from kubernetes_tpu.apiserver.master import Master, MasterConfig
+from kubernetes_tpu.util import metrics as metrics_pkg
+from kubernetes_tpu.util.metrics import FlightRecorder, Registry, _SeriesRing
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    """Flightrec is module-global per process (like the span ring);
+    every test leaves the process disarmed."""
+    yield
+    metrics_pkg.flightrec_disarm()
+
+
+# -- the sampler ring --------------------------------------------------------
+
+
+class TestSeriesRing:
+    def test_bound_and_evict(self):
+        r = _SeriesRing("gauge", 4)
+        for i in range(10):
+            r.put(i * 100, float(i))
+        pts = r.since(0)
+        # capacity 4: only the newest 4 survive, oldest first
+        assert [p[1] for p in pts] == [6.0, 7.0, 8.0, 9.0]
+        assert r.evicted == 6
+
+    def test_cursor_drain_semantics(self):
+        r = _SeriesRing("gauge", 8)
+        for i in range(5):
+            r.put((i + 1) * 100, float(i))
+        assert len(r.since(0)) == 5
+        # a cursor pull is non-destructive and idempotent
+        assert len(r.since(0)) == 5
+        # incremental: only samples strictly newer than the cursor
+        cursor = r.since(0)[-1][0]
+        assert r.since(cursor) == []
+        r.put(999, 42.0)
+        assert [p[1] for p in r.since(cursor)] == [42.0]
+
+    def test_since_walks_backward_not_whole_ring(self):
+        # incremental pulls must be O(new), which since() achieves by
+        # walking newest->oldest and stopping at the cursor; observable
+        # contract: samples AT the cursor are excluded, order preserved
+        r = _SeriesRing("counter", 1000)
+        for i in range(1000):
+            r.put(i, float(i))
+        assert [p[1] for p in r.since(997)] == [998.0, 999.0]
+
+
+class TestFlightRecorder:
+    def test_registry_sampling_and_counter_rate(self):
+        reg = Registry()
+        c = reg.counter("work_total", "w")
+        fr = FlightRecorder(service="t", period_s=3600)
+        fr._registries = [reg]  # isolate from the process default registry
+        c.inc(by=10)
+        fr.sample_now()
+        c.inc(by=25)
+        time.sleep(0.02)
+        fr.sample_now()
+        raw = fr._rings["work_total"].since(0)
+        assert [p[1] for p in raw] == [10.0, 35.0]
+        # rate derived against the hand-computed delta over the actual
+        # sample spacing
+        rates = fr._rings["work_total:rate"].since(0)
+        assert len(rates) == 1
+        dt_s = (raw[1][0] - raw[0][0]) / 1e9
+        assert rates[0][1] == pytest.approx(25.0 / dt_s, rel=1e-6)
+
+    def test_counter_reset_clamps_rate_to_zero(self):
+        reg = Registry()
+        c = reg.counter("x_total", "x")
+        fr = FlightRecorder(period_s=3600)
+        fr._registries = [reg]
+        c.inc(by=100)
+        fr.sample_now()
+        with c._lock:
+            c._values[()] = 5.0  # a restarted process's counter
+        time.sleep(0.002)
+        fr.sample_now()
+        assert fr._rings["x_total:rate"].since(0)[-1][1] == 0.0
+
+    def test_histogram_sampled_as_buckets_sum_count(self):
+        reg = Registry()
+        h = reg.histogram("lat_s", "l", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5)
+        fr = FlightRecorder(period_s=3600)
+        fr._registries = [reg]
+        fr.sample_now()
+        assert fr._rings['lat_s_bucket{le="0.1"}'].since(0)[-1][1] == 1.0
+        assert fr._rings['lat_s_bucket{le="1"}'].since(0)[-1][1] == 2.0
+        assert fr._rings["lat_s_count"].since(0)[-1][1] == 2.0
+        # bucket series derive no :rate (quantiles come from deltas)
+        assert 'lat_s_bucket{le="1"}:rate' not in fr._rings
+        assert "lat_s_count:rate" not in fr._rings  # only after 2 ticks
+        # +Inf bucket rides along: observations past the envelope must
+        # still count toward windowed quantiles (h.observe(5.0) lands
+        # in no finite bucket)
+        h.observe(5.0)
+        fr.sample_now()
+        assert fr._rings['lat_s_bucket{le="+Inf"}'].since(0)[-1][1] == 3.0
+        assert fr._rings["lat_s_count"].since(0)[-1][1] == 3.0
+        assert 'lat_s_bucket{le="+Inf"}:rate' not in fr._rings
+        assert "lat_s_count:rate" in fr._rings
+
+    def test_process_builtin_series(self):
+        fr = FlightRecorder(period_s=3600)
+        fr._registries = []
+        fr.sample_now()
+        assert fr._rings["process_resident_bytes"].since(0)[-1][1] > 1e6
+        assert "process_cpu_seconds_total" in fr._rings
+
+    def test_vars_payload_cursor_contract(self):
+        reg = Registry()
+        g = reg.gauge("depth", "d")
+        fr = FlightRecorder(service="svc", period_s=3600)
+        fr._registries = [reg]
+        g.set(1)
+        fr.sample_now()
+        p1 = fr.vars_payload(0)
+        assert p1["armed"] and p1["service"] == "svc"
+        cursor = p1["series"]["depth"]["samples"][-1][0]
+        g.set(2)
+        fr.sample_now()
+        p2 = fr.vars_payload(cursor)
+        assert [s[1] for s in p2["series"]["depth"]["samples"]] == [2.0]
+        # fully-drained cursor: series with nothing new are omitted
+        p3 = fr.vars_payload(p2["t_ns"] + 10**12)
+        assert p3["series"] == {}
+
+    def test_disarmed_process_pays_nothing(self):
+        # never-armed: the module global stays None — no ring arrays, no
+        # sampler thread; the /debug/vars body is a marker, not an error
+        assert not metrics_pkg.flightrec_armed()
+        assert metrics_pkg.flightrec() is None
+        payload = metrics_pkg.flightrec_vars(0)
+        assert payload["armed"] is False and payload["series"] == {}
+        assert metrics_pkg.flightrec_sample_now() == 0
+        assert not metrics_pkg.flightrec_armed()  # still nothing allocated
+
+    def test_arm_is_lazy_idempotent_and_disarmable(self):
+        fr = metrics_pkg.flightrec_arm("one", period_s=3600)
+        assert metrics_pkg.flightrec_arm("two", period_s=3600) is fr
+        assert fr.service == "one"
+        assert metrics_pkg.flightrec_armed()
+        # the arm took an immediate first snapshot
+        assert metrics_pkg.flightrec_vars(0)["series"]
+        metrics_pkg.flightrec_disarm()
+        assert not metrics_pkg.flightrec_armed()
+
+
+# -- SLO rules + watchdog ----------------------------------------------------
+
+
+def _ns(s: float) -> int:
+    return int(s * 1e9)
+
+
+class TestSLOWatchdog:
+    def test_threshold_crossing_debounce_dedup_recovery(self):
+        rule = SLORule("queue", "q", op="ceil", threshold=10, for_s=5.0)
+        dog = SLOWatchdog([rule])
+        # below threshold: nothing
+        assert dog.observe(rule, 3.0, _ns(0)) is None
+        # crossing starts the debounce clock, no transition yet
+        assert dog.observe(rule, 50.0, _ns(1)) is None
+        assert dog.firing() == []
+        # sustained past for_s: ONE firing transition...
+        tr = dog.observe(rule, 60.0, _ns(7), samples=[[_ns(7), 60.0]])
+        assert tr["state"] == "firing" and tr["value"] == 60.0
+        assert tr["samples"] == [[_ns(7), 60.0]]
+        # ...and staying in violation records nothing more (dedup)
+        assert dog.observe(rule, 70.0, _ns(8)) is None
+        assert dog.observe(rule, 80.0, _ns(20)) is None
+        assert dog.firing() == ["queue"]
+        # recovery records exactly one resolved transition
+        tr = dog.observe(rule, 1.0, _ns(30))
+        assert tr["state"] == "resolved"
+        assert dog.firing() == []
+        assert [t["state"] for t in dog.transitions] == \
+            ["firing", "resolved"]
+
+    def test_bounce_below_for_s_never_fires(self):
+        rule = SLORule("r", "s", op="ceil", threshold=10, for_s=5.0)
+        dog = SLOWatchdog([rule])
+        for t in range(0, 20, 2):
+            dog.observe(rule, 50.0, _ns(t))      # bad...
+            dog.observe(rule, 1.0, _ns(t + 1))   # ...but recovers at once
+        assert dog.transitions == []
+
+    def test_floor_rule_and_active_gating(self):
+        rule = SLORule("binds", "b", op="floor", threshold=100.0,
+                       for_s=0.0, active_only=True)
+        dog = SLOWatchdog([rule])
+        # below the floor while INACTIVE (warmup / drain): suppressed
+        assert dog.observe(rule, 0.0, _ns(0), active=False) is None
+        tr = dog.observe(rule, 20.0, _ns(5), active=True)
+        assert tr["state"] == "firing"
+        # deactivation auto-resolves (end of run is not an outage)
+        tr = dog.observe(rule, 0.0, _ns(9), active=False)
+        assert tr["state"] == "resolved"
+
+    def test_no_data_neither_fires_nor_resolves(self):
+        rule = SLORule("r", "s", op="ceil", threshold=0.0, for_s=0.0)
+        dog = SLOWatchdog([rule])
+        dog.observe(rule, 5.0, _ns(0))
+        assert dog.firing() == ["r"]
+        assert dog.observe(rule, None, _ns(1)) is None
+        assert dog.firing() == ["r"]  # a dead feed must not fake recovery
+
+    def test_default_churn_rules_cover_the_contract(self):
+        names = {r.name for r in default_churn_rules()}
+        assert {"sustained_binds_floor", "solve_p50_ceiling",
+                "solverd_queue_saturation", "watch_lag_zero",
+                "parity_divergence_zero", "spans_dropped_zero",
+                "process_rss_ceiling"} <= names
+
+
+# -- aggregator multi-pid merge ---------------------------------------------
+
+
+def _payload(pid, service, series, t_ns):
+    return {"armed": True, "pid": pid, "service": service,
+            "period_s": 1.0, "t_ns": t_ns,
+            "series": {k: {"type": typ, "samples": pts}
+                       for k, (typ, pts) in series.items()}}
+
+
+class TestFlightAggregator:
+    def test_multi_pid_merge_dedup_and_scopes(self):
+        agg = FlightAggregator([], rules=[
+            SLORule("total_q", "q", op="ceil", threshold=100, scope="sum"),
+            SLORule("max_rss", "rss", op="ceil", threshold=100,
+                    scope="max"),
+        ], fetch=lambda url: (_ for _ in ()).throw(RuntimeError))
+        agg.ingest(_payload(1, "scheduler", {
+            "q": ("gauge", [[_ns(1), 5.0], [_ns(2), 7.0]]),
+            "rss": ("gauge", [[_ns(2), 30.0]])}, _ns(2)), target="s0")
+        agg.ingest(_payload(2, "scheduler", {
+            "q": ("gauge", [[_ns(2), 11.0]]),
+            "rss": ("gauge", [[_ns(2), 80.0]])}, _ns(2)), target="s1")
+        # overlapping re-ingest (the SO_REUSEPORT re-drain): idempotent
+        agg.ingest(_payload(1, "scheduler", {
+            "q": ("gauge", [[_ns(1), 5.0], [_ns(2), 7.0]])}, _ns(2)),
+            target="s0")
+        assert [s for _pid, s in sorted(agg.series_samples("q"))] == \
+            [[[_ns(1), 5.0], [_ns(2), 7.0]], [[_ns(2), 11.0]]]
+        v, pid = agg._reduce(agg.watchdog.rules[0], _ns(2))
+        assert (v, pid) == (18.0, None)            # sum of last values
+        v, pid = agg._reduce(agg.watchdog.rules[1], _ns(2))
+        assert (v, pid) == (80.0, 2)               # max keeps the pid
+
+    def test_rate_reduce_sums_across_pids(self):
+        rule = SLORule("binds", "pods_total", op="floor", threshold=1.0,
+                       reduce="rate", window_s=100.0, scope="sum")
+        agg = FlightAggregator([], rules=[rule], fetch=None)
+        for pid, v0, v1 in ((1, 0.0, 50.0), (2, 10.0, 30.0)):
+            agg.ingest(_payload(pid, "scheduler", {
+                "pods_total": ("counter",
+                               [[_ns(0), v0], [_ns(10), v1]])}, _ns(10)))
+        v, _pid = agg._reduce(rule, _ns(10))
+        assert v == pytest.approx((50.0 - 0.0) / 10 + (30.0 - 10.0) / 10)
+
+    def test_windowed_quantile_from_bucket_deltas(self):
+        # window [5s, 10s]: the t=0 cumulative counts are pre-window
+        # history and must be subtracted out by the delta
+        rule = SLORule("p50", "solve_s", op="ceil", threshold=1.0,
+                       reduce="p50", window_s=5.0)
+        agg = FlightAggregator([], rules=[rule], fetch=None)
+        # pid 1: 10 observations <= 0.5 inside the window (cum 5 -> 15);
+        # pre-window history (cum 5) must be excluded by the delta
+        agg.ingest(_payload(1, "scheduler", {
+            'solve_s_bucket{le="0.5"}':
+                ("bucket", [[_ns(0), 5.0], [_ns(8), 15.0]]),
+            'solve_s_bucket{le="2"}':
+                ("bucket", [[_ns(0), 5.0], [_ns(8), 15.0]]),
+        }, _ns(8)))
+        # pid 1 delta over the window: 15 - 5 = 10 observations <= 0.5
+        # pid 2: 10 observations in (0.5, 2] entirely inside the window
+        agg.ingest(_payload(2, "scheduler", {
+            'solve_s_bucket{le="0.5"}': ("bucket", [[_ns(8), 0.0]]),
+            'solve_s_bucket{le="2"}': ("bucket", [[_ns(8), 10.0]]),
+        }, _ns(8)))
+        v, _pid = agg._reduce(rule, _ns(10))
+        # 20 windowed observations, 10 <= 0.5: p50 = 0.5 exactly
+        assert v == pytest.approx(0.5)
+
+    def test_quantile_overflow_past_envelope_still_fires_ceiling(self):
+        # every windowed observation past the top finite bucket: the
+        # quantile conservatively reports that bound (2.0 here), so a
+        # ceiling rule with threshold <= top bucket fires instead of
+        # reading 'no data' precisely when the regression is largest
+        rule = SLORule("p50", "solve_s", op="ceil", threshold=1.5,
+                       reduce="p50", window_s=10.0)
+        agg = FlightAggregator([], rules=[rule], fetch=None)
+        agg.ingest(_payload(1, "scheduler", {
+            'solve_s_bucket{le="2"}': ("bucket", [[_ns(8), 0.0]]),
+            'solve_s_bucket{le="+Inf"}': ("bucket", [[_ns(8), 10.0]]),
+        }, _ns(8)))
+        v, _pid = agg._reduce(rule, _ns(9))
+        assert v == pytest.approx(2.0)
+        assert rule.violated(v)
+
+    def test_dead_pid_last_sample_ages_out(self):
+        # a crashed process's frozen final sample (queue at saturation,
+        # RSS at peak) must age out of 'last' reductions: the respawned
+        # replacement's healthy samples are the live truth, and the
+        # alarm must be able to resolve
+        rule = SLORule("q", "queue", op="ceil", threshold=10.0,
+                       window_s=15.0, scope="max")
+        agg = FlightAggregator([], rules=[rule], fetch=None)
+        agg.ingest(_payload(1, "solverd",
+                            {"queue": ("gauge", [[_ns(1), 64.0]])}, _ns(1)))
+        agg.ingest(_payload(2, "solverd",
+                            {"queue": ("gauge", [[_ns(30), 0.0]])}, _ns(30)))
+        v, pid = agg._reduce(rule, _ns(30))
+        assert (v, pid) == (0.0, 2)  # pid 1 died at t=1s: aged out
+        # while both are fresh, max still sees the saturated one
+        v, pid = agg._reduce(rule, _ns(10))
+        assert (v, pid) == (64.0, 1)
+
+    def test_merged_series_and_slo_curves_are_bounded(self):
+        rule = SLORule("r", "g", op="ceil", threshold=1e9)
+        agg = FlightAggregator([], rules=[rule], fetch=None)
+        cap = FlightAggregator.MAX_SAMPLES_PER_SERIES
+        for i in range(cap + 10):
+            agg.ingest(_payload(1, "s",
+                                {"g": ("gauge", [[_ns(i), float(i)]])},
+                                _ns(i)))
+            agg.evaluate(_ns(i))
+        with agg._lock:
+            n = len(agg._pids[1]["series"]["g"]["samples"])
+            m = len(agg._slo["r"])
+        assert n <= cap and m <= cap
+        # pruning drops the OLDEST half; the newest samples survive
+        assert agg._pids[1]["series"]["g"]["samples"][-1][1] == float(cap + 9)
+
+    def test_evaluate_builds_slo_curves_and_alarm_samples(self):
+        rule = SLORule("q_ceil", "q", op="ceil", threshold=10.0, for_s=0.0)
+        agg = FlightAggregator([], rules=[rule], fetch=None)
+        agg.ingest(_payload(1, "solverd",
+                            {"q": ("gauge", [[_ns(1), 50.0]])}, _ns(1)))
+        new = agg.evaluate()
+        assert len(new) == 1 and new[0]["rule"] == "q_ceil"
+        assert new[0]["samples"]  # the offending samples ride along
+        tl = agg.timeline()
+        assert "slo:q_ceil" in tl["series"]
+        assert tl["headline"] == ["slo:q_ceil"]
+        assert agg.alarms()[0]["state"] == "firing"
+
+    def test_reuseport_drain_until_all_pids_answer(self):
+        # one URL, three worker pids behind it: the fetch seam answers as
+        # a different pid each call (kernel accept balancing); one poll
+        # round must discover all three
+        calls = [0]
+
+        def fetch(url):
+            pid = 100 + calls[0] % 3
+            calls[0] += 1
+            return _payload(pid, "apiserver",
+                            {"g": ("gauge", [[_ns(calls[0]), 1.0]])},
+                            _ns(calls[0]))
+
+        agg = FlightAggregator(
+            [{"name": "apiserver", "url": "http://x", "workers": 3}],
+            rules=[], fetch=fetch)
+        agg.poll_once()
+        assert sorted(agg._pids) == [100, 101, 102]
+        assert agg.workers_missed == 0
+
+    def test_reuseport_missed_worker_is_counted(self):
+        def fetch(url):
+            return _payload(7, "apiserver",
+                            {"g": ("gauge", [[_ns(1), 1.0]])}, _ns(1))
+
+        agg = FlightAggregator(
+            [{"name": "apiserver", "url": "http://x", "workers": 2}],
+            rules=[], fetch=fetch)
+        agg.poll_once()
+        assert agg.workers_missed == 1  # disclosed, never silent
+
+    def test_timeline_downsamples_and_sidecar_keeps_full_series(self):
+        rule = SLORule("r", "g", op="ceil", threshold=1e9)
+        agg = FlightAggregator([], rules=[rule], fetch=None)
+        for i in range(400):
+            agg.ingest(_payload(1, "s",
+                                {"g": ("gauge", [[_ns(i), float(i)]])},
+                                _ns(i)))
+            agg.evaluate(_ns(i))
+        tl = agg.timeline(max_points=120)
+        pts = tl["series"]["slo:r"]
+        assert len(pts) <= 121
+        assert pts[0][0] == 0.0 and pts[-1][1] == 399.0
+        side = agg.sidecar_payload()
+        assert len(side["pids"]["1"]["series"]["g"]["samples"]) == 400
+        assert len(side["slo"]["r"]) == 400
+
+    def test_sidecar_excludes_bucket_series(self):
+        agg = FlightAggregator([], rules=[], fetch=None)
+        agg.ingest(_payload(1, "s", {
+            'h_bucket{le="1"}': ("bucket", [[_ns(1), 1.0]]),
+            "h_count": ("counter", [[_ns(1), 1.0]])}, _ns(1)))
+        series = agg.sidecar_payload()["pids"]["1"]["series"]
+        assert "h_count" in series and 'h_bucket{le="1"}' not in series
+
+
+# -- /debug/vars + deep healthz over live servers ---------------------------
+
+
+@pytest.fixture()
+def server():
+    srv = APIServer(Master(MasterConfig())).start()
+    yield srv
+    srv.stop()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return r.status, r.read()
+
+
+class TestDebugVarsEndpoints:
+    def test_apiserver_debug_vars_arms_and_pages(self, server):
+        # a real request first, so the per-server request metrics have a
+        # label set to sample
+        _get(server.base_url + "/api/v1/pods")
+        code, body = _get(server.base_url + "/debug/vars")
+        assert code == 200
+        p = json.loads(body)
+        assert p["armed"] and p["pid"] > 0
+        # the apiserver's per-instance registry is watched too
+        assert any(k.startswith("apiserver_request_count")
+                   for k in p["series"])
+        assert "process_resident_bytes" in p["series"]
+        cursor = p["t_ns"]
+        code, body = _get(server.base_url
+                          + f"/debug/vars?since={cursor + 10**13}")
+        assert json.loads(body)["series"] == {}
+
+    def test_scheduler_debug_server_vars_healthz_pprof(self, server):
+        from kubernetes_tpu.cmd.scheduler import (_scheduler_health,
+                                                  _serve_debug)
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        _serve_debug(port, service="scheduler",
+                     health=_scheduler_health(server.base_url, ""))
+        base = f"http://127.0.0.1:{port}"
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            try:
+                code, body = _get(base + "/healthz")
+                break
+            except OSError:
+                time.sleep(0.05)
+        health = json.loads(body)
+        assert code == 200 and health["healthy"] is True
+        assert health["items"][0]["name"] == "binder"
+        assert health["items"][0]["status"] == "success"
+        assert _get(base + "/healthz/ping")[1] == b"ok"
+        code, body = _get(base + "/debug/vars")
+        assert code == 200 and json.loads(body)["armed"]
+        # collapsed CPU profile: folded "frame;frame count" lines
+        code, body = _get(base + "/debug/pprof/profile"
+                          "?seconds=0.2&format=collapsed")
+        assert code == 200
+        lines = [l for l in body.decode().splitlines() if l]
+        assert lines, "profiler saw no thread stacks"
+        for line in lines:
+            stack, _, count = line.rpartition(" ")
+            assert stack and count.isdigit()
+            assert ";" in stack or ":" in stack
+
+    def test_scheduler_health_reports_dead_binder(self):
+        from kubernetes_tpu.cmd.scheduler import _scheduler_health
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            dead = s.getsockname()[1]
+        payload, ok = _scheduler_health(f"http://127.0.0.1:{dead}", "")()
+        assert ok is False
+        assert payload["items"][0]["status"] == "failure"
+
+    def test_solverd_health_reports_backend(self):
+        from kubernetes_tpu.cmd.solverd import _solverd_health
+
+        class _Srv:
+            _mesh_exec = None
+
+        payload, ok = _solverd_health(_Srv())()
+        assert ok is True
+        backend = payload["items"][0]
+        assert backend["name"] == "backend"
+        assert backend["status"] == "success"
+        assert "device" in backend["message"]
+
+
+class TestDeepHealthz:
+    def test_apiserver_healthz_deep_and_ping(self, server):
+        code, body = _get(server.base_url + "/healthz")
+        health = json.loads(body)
+        assert code == 200 and health["healthy"] is True
+        assert {c["name"] for c in health["items"]} == \
+            {"store", "watch-hub"}
+        assert all(c["status"] == "success" for c in health["items"])
+        assert _get(server.base_url + "/healthz/ping")[1] == b"ok"
+
+    def test_apiserver_healthz_503_when_store_unreachable(self, server,
+                                                          monkeypatch):
+        # store round-trip broken mid-flight: liveness (ping) stays 200,
+        # readiness (deep healthz) answers 503 with the verdicts
+        orig = server.master.dispatch
+
+        def broken(verb, resource, **kw):
+            if verb == "list" and resource == "namespaces":
+                raise ConnectionRefusedError("store down")
+            return orig(verb, resource, **kw)
+
+        monkeypatch.setattr(server.master, "dispatch", broken)
+        assert _get(server.base_url + "/healthz/ping")[0] == 200
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(server.base_url + "/healthz")
+        assert ei.value.code == 503
+        health = json.loads(ei.value.read())
+        assert health["healthy"] is False
+        statuses = {c["name"]: c["status"] for c in health["items"]}
+        assert statuses["store"] == "failure"
+
+
+class TestCollapsedProfileFormat:
+    def test_collapsed_output_parses_and_flat_default_kept(self):
+        from kubernetes_tpu.util import pprof
+        spin = threading.Event()
+
+        def burn():
+            while not spin.is_set():
+                sum(range(100))
+
+        t = threading.Thread(target=burn, daemon=True)
+        t.start()
+        try:
+            out = pprof.handle("profile", "0.3", "collapsed")
+            flat = pprof.handle("profile", "0.2")
+        finally:
+            spin.set()
+        folded = [l for l in out.splitlines() if l]
+        assert folded
+        total = 0
+        for line in folded:
+            stack, _, count = line.rpartition(" ")
+            assert count.isdigit() and int(count) > 0
+            total += int(count)
+            frames = stack.split(";")
+            assert all(frames), line  # no empty frames
+            assert any("test_flightrec" in f or ":" in f for f in frames)
+        assert total > 0
+        # the flat report is unchanged as the default
+        assert flat.startswith("cpu profile:")

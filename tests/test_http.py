@@ -267,7 +267,16 @@ class TestUnversionedEndpoints:
             return r.status, r.read().decode()
 
     def test_healthz_version_validate_index(self, server):
-        assert self.read(server, "/healthz")[1] == "ok"
+        # deep health: componentstatus-style verdicts for the store and
+        # the watch hub (the probe result vocabulary), 200 when healthy;
+        # /healthz/ping stays the unconditional liveness answer
+        code, body = self.read(server, "/healthz")
+        health = json.loads(body)
+        assert code == 200 and health["healthy"] is True
+        comps = {c["name"]: c["status"] for c in health["items"]}
+        assert comps["store"] == "success"
+        assert comps["watch-hub"] == "success"
+        assert self.read(server, "/healthz/ping")[1] == "ok"
         code, body = self.read(server, "/version")
         assert json.loads(body)["gitVersion"].startswith("v")
         code, body = self.read(server, "/validate")
@@ -379,7 +388,7 @@ class TestHeaderParsing:
         # "keep-alive, close" must be honored as close: the server must
         # finish the response and EOF rather than hold the socket open
         resp = self.raw(server,
-                        b"GET /healthz HTTP/1.1\r\nHost: h\r\n"
+                        b"GET /healthz/ping HTTP/1.1\r\nHost: h\r\n"
                         b"Connection: keep-alive, close\r\n\r\n")
         assert resp.startswith(b"HTTP/1.1 200") and resp.endswith(b"ok")
 

@@ -298,7 +298,7 @@ class TestMeshExecutorDirect:
         key = ("w", "b0")
         first = me.solve(inp, pol, False, cache_key=key)
         entry = me._resident[key]
-        devs = {name: dev for name, (_src, dev) in entry["planes"].items()}
+        devs = {name: rec[1] for name, rec in entry["planes"].items()}
         assert devs and all(not d.is_deleted() for d in devs.values())
         # same host objects again: zero re-transfer, same device buffers,
         # identical decisions — three solves deep
@@ -374,8 +374,8 @@ class TestMeshExecutorDirect:
         # the probed wave still installed device residency: the next wave
         # rides the identity chain instead of a full re-transfer
         planes = me._resident[("w", "b0")]["planes"]
-        assert planes and all(not d.is_deleted()
-                              for _s, d in planes.values())
+        assert planes and all(not rec[1].is_deleted()
+                              for rec in planes.values())
         cal = next(iter(me._cal.values()))
         assert cal["winner"] in ("shard", "single")
 

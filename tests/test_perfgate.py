@@ -1,0 +1,165 @@
+"""hack/perfgate.py — the regression gate over the record trajectory.
+
+Tier-1 coverage: the committed r08-r10 records must gate green against
+their own best priors (the trajectory the repo actually shipped), a
+synthetic 10% sustained-rate regression must gate red, advisory keys
+must warn without failing, and shape isolation must keep fan-out /
+lag-storm records out of the clean series' baselines."""
+
+import copy
+import importlib.util
+import json
+import os
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_perfgate():
+    spec = importlib.util.spec_from_file_location(
+        "perfgate", os.path.join(_REPO, "hack", "perfgate.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def pg():
+    return _load_perfgate()
+
+
+@pytest.fixture(scope="module")
+def r10(pg):
+    path = os.path.join(_REPO, "CHURN_MP_r10_fullshape.json")
+    with open(path) as fh:
+        return json.load(fh)
+
+
+class TestCommittedTrajectory:
+    def test_committed_records_gate_green(self, pg):
+        """Every committed r8+ record vs its best prior: the shipped
+        trajectory must satisfy the gate the future rounds will face."""
+        results = pg.check_committed(_REPO)
+        assert results, "no committed records gated"
+        red = [r for r in results if r["verdict"] == "red"]
+        assert red == [], red
+
+    def test_fullshape_rounds_found_baselines(self, pg):
+        by_rec = {r.get("record"): r for r in pg.check_committed(_REPO)}
+        for rnd in (8, 9, 10):
+            rec = by_rec.get(f"CHURN_MP_r{rnd:02d}_fullshape.json")
+            assert rec is not None
+            assert rec.get("baseline"), rec  # a real prior was compared
+            assert rec["verdict"] == "green"
+
+    def test_fanout_record_isolated_from_clean_shape(self, pg):
+        res = pg.gate(os.path.join(_REPO, "CHURN_MP_r08_fanout.json"),
+                      repo=_REPO)
+        # observer-watcher topology has no committed prior of its own
+        # shape; it must NOT have gated against the clean full-shape runs
+        assert res.get("no_baseline") is True
+        assert res["verdict"] == "green"
+
+
+class TestVerdicts:
+    def test_synthetic_10pct_sustained_regression_is_red(self, pg, r10):
+        fresh = copy.deepcopy(r10)
+        fresh["sustained_pods_per_s"] = round(
+            r10["sustained_pods_per_s"] * 0.90, 1)
+        res = pg.compare(fresh, r10)
+        assert res["verdict"] == "red"
+        assert any("sustained" in f for f in res["failures"])
+        assert res["keys"]["sustained_pods_per_s"]["status"] == "regressed"
+
+    def test_within_2pct_is_green(self, pg, r10):
+        fresh = copy.deepcopy(r10)
+        fresh["sustained_pods_per_s"] = round(
+            r10["sustained_pods_per_s"] * 0.98, 1)
+        res = pg.compare(fresh, r10)
+        assert res["verdict"] == "green"
+        assert res["keys"]["sustained_pods_per_s"]["status"] == "ok"
+
+    def test_advisory_regression_warns_but_stays_green(self, pg, r10):
+        fresh = copy.deepcopy(r10)
+        fresh["scheduler_waves"]["solve"]["p50_ms"] = \
+            r10["scheduler_waves"]["solve"]["p50_ms"] * 2.0
+        res = pg.compare(fresh, r10)
+        assert res["verdict"] == "green"
+        assert any("solve_p50_ms" in w for w in res["warnings"])
+        assert res["keys"]["solve_p50_ms"]["status"] == "regressed"
+        assert res["keys"]["solve_p50_ms"]["required"] is False
+
+    def test_dropped_required_key_is_red(self, pg, r10):
+        fresh = copy.deepcopy(r10)
+        del fresh["apiserver"]["frame_cache_hit_rate"]
+        res = pg.compare(fresh, r10)
+        assert res["verdict"] == "red"
+        assert res["keys"]["frame_cache_hit_rate"]["status"] == "missing"
+
+    def test_dropped_advisory_key_only_warns(self, pg, r10):
+        fresh = copy.deepcopy(r10)
+        del fresh["latency"]["e2e_p50_s"]
+        res = pg.compare(fresh, r10)
+        assert res["verdict"] == "green"
+        assert any("e2e_p50_s" in w for w in res["warnings"])
+
+    def test_frame_cache_hit_rate_band(self, pg, r10):
+        fresh = copy.deepcopy(r10)
+        base_rate = r10["apiserver"]["frame_cache_hit_rate"]
+        fresh["apiserver"]["frame_cache_hit_rate"] = \
+            round(base_rate * 0.90, 3)  # 10% relative drop >> 2% band
+        res = pg.compare(fresh, r10)
+        assert res["verdict"] == "red"
+
+    def test_improvement_is_green_everywhere(self, pg, r10):
+        fresh = copy.deepcopy(r10)
+        fresh["sustained_pods_per_s"] = r10["sustained_pods_per_s"] * 1.3
+        fresh["scheduler_waves"]["solve"]["p50_ms"] = 100.0
+        fresh["cpu_budget_s"]["apiserver"] = 50.0
+        res = pg.compare(fresh, r10)
+        assert res["verdict"] == "green"
+        assert res["warnings"] == [] and res["failures"] == []
+
+
+class TestShapeAndBaseline:
+    def test_shape_key_separates_load_topologies(self, pg, r10):
+        clean = pg.shape_key(r10)
+        fan = copy.deepcopy(r10)
+        fan["apiserver"]["observer_watchers"] = 8
+        storm = copy.deepcopy(r10)
+        storm["lag_storm"] = 2
+        assert pg.shape_key(fan) != clean
+        assert pg.shape_key(storm) != clean
+        assert pg.shape_key(fan) != pg.shape_key(storm)
+
+    def test_baseline_is_best_prior_not_latest(self, pg, r10):
+        # r10's search space holds r05 (333), r07 (232), r08 (426), r09
+        # (453): best == r09's sustained rate, regardless of file order
+        path, base = pg.find_baseline(r10, 10, _REPO)
+        assert base is not None
+        best = max(rec["sustained_pods_per_s"]
+                   for p, rec in pg.committed_records(_REPO)
+                   if pg.round_of(p) < 10 and pg._eligible_baseline(rec)
+                   and pg.shape_key(rec) == pg.shape_key(r10))
+        assert base["sustained_pods_per_s"] == best
+
+    def test_error_records_are_skipped_not_gated(self, pg, tmp_path):
+        p = tmp_path / "CHURN_MP_r99_broken.json"
+        p.write_text(json.dumps({"error": "feeder failures",
+                                 "created": 10}))
+        res = pg.gate(str(p), repo=_REPO)
+        assert res["verdict"] == "skipped"
+
+    def test_cli_exit_codes(self, pg, r10, tmp_path):
+        good = tmp_path / "CHURN_MP_r12_fullshape.json"
+        good.write_text(json.dumps(r10))
+        against = tmp_path / "base.json"
+        against.write_text(json.dumps(r10))
+        assert pg.main([str(good), "--against", str(against)]) == 0
+        bad_rec = copy.deepcopy(r10)
+        bad_rec["sustained_pods_per_s"] *= 0.5
+        bad = tmp_path / "CHURN_MP_r12_bad.json"
+        bad.write_text(json.dumps(bad_rec))
+        assert pg.main([str(bad), "--against", str(against)]) == 1
+        assert pg.main(["--check-committed", "--repo", _REPO]) == 0
