@@ -1,12 +1,15 @@
 # Build/test entry points (ref: the reference's root Makefile wrapping
 # hack/*.sh).
 
-.PHONY: all test bench bench-smoke native ui clean
+.PHONY: all test vet bench bench-smoke native ui clean
 
 all: native ui
 
 test:
 	hack/test.sh
+
+vet:
+	python hack/vet.py
 
 bench:
 	hack/benchmark.sh

@@ -7,7 +7,11 @@
 # concurrency-heavy suites RACE_ROUNDS times (default 3) with the
 # interpreter switch interval forced to ~1us (tests/conftest.py), so
 # thread preemption lands between nearly every bytecode and
-# check-then-act races become probable instead of theoretical.
+# check-then-act races become probable instead of theoretical. Under
+# KTPU_RACE the lock-order sanitizer (util/locksmith.py) is armed too:
+# every Lock/RLock records per-thread acquisition chains into a global
+# order graph, and any cycle (an A->B / B->A inversion — a potential
+# deadlock even if no schedule hung) fails the round with both stacks.
 # Latest full run: hack/race-report.md.
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -25,6 +29,14 @@ set -- ${ARGS+"${ARGS[@]}"}
 echo "=== compile smoke (python -m compileall) ==="
 python -m compileall -q kubernetes_tpu tests bench.py hack
 
+# kube-vet: the govet analog (ref: hack/test-go.sh gating on govet).
+# Invariant rules in kubernetes_tpu/analysis (donation-safety, clone-
+# mutation, thread-discipline, py310-compat, metrics-sync, unused) over
+# the whole tree; waivers require a rule id + reason. Also enforced as
+# a tier-1 test (tests/test_vet.py::test_tree_is_vet_clean).
+echo "=== kube-vet (hack/vet.py) ==="
+python hack/vet.py
+
 if [[ "$RACE" == 1 ]]; then
     ROUNDS="${RACE_ROUNDS:-3}"
     SUITES=(tests/test_contention.py tests/test_storage.py
@@ -37,7 +49,8 @@ if [[ "$RACE" == 1 ]]; then
             tests/test_controllers.py tests/test_scheduler.py
             tests/test_integration.py tests/test_solverd.py
             tests/test_incremental.py tests/test_parallel.py
-            tests/test_tracing.py tests/test_flightrec.py)
+            tests/test_tracing.py tests/test_flightrec.py
+            tests/test_vet.py)
     rc=0
     for ((i = 1; i <= ROUNDS; i++)); do
         echo "=== race round ${i}/${ROUNDS} (switchinterval=1e-6) ==="

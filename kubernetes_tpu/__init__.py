@@ -43,3 +43,25 @@ if _os.environ.get("KTPU_RACE"):
     import sys as _sys
 
     _sys.setswitchinterval(1e-6)
+
+    # Lock-order sanitizer (util/locksmith.py): armed in every process
+    # that imports the package under --race, so spawned component
+    # binaries probe their lock ordering too. A child has no pytest
+    # sessionfinish hook, so cycles are reported at interpreter exit on
+    # stderr (exit code untouched: the parent suite's own locksmith
+    # run is the gating instance).
+    import atexit as _atexit
+
+    from kubernetes_tpu.util import locksmith as _locksmith
+
+    _locksmith.arm()
+
+    def _locksmith_exit_report() -> None:
+        reps = _locksmith.reports()
+        if reps:
+            print("[locksmith] potential deadlocks in this process:",
+                  file=_sys.stderr)
+            for _r in reps:
+                print(_locksmith.format_report(_r), file=_sys.stderr)
+
+    _atexit.register(_locksmith_exit_report)

@@ -14,11 +14,10 @@ the shared plugin map so servers select them by name
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+from typing import Dict, Optional
 
 from kubernetes_tpu.admission import (
     CREATE,
-    DELETE,
     UPDATE,
     Attributes,
     Interface,

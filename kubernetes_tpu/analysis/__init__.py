@@ -1,0 +1,22 @@
+"""kube-vet — invariant-enforcing static analysis for the control plane.
+
+The reference tree gates every change through govet/golint
+(ref: hack/test-go.sh); this package is the project-specific analog. It
+does NOT re-implement a general linter: every rule encodes one
+hard-won, machine-checkable invariant of THIS codebase, each motivated
+by a real incident (the r11 donation heap corruption, the PR 1
+f-string that silently muted 13 test modules) or a documented contract
+(the read-only-store-objects invariant, the bounded-queue discipline).
+
+Rule table, motivating incidents, and the waiver policy:
+docs/design/invariants.md. CLI: ``python hack/vet.py``.
+"""
+
+from kubernetes_tpu.analysis.engine import (  # noqa: F401
+    FileContext, Rule, Violation, Waiver, all_rules, default_paths,
+    format_violation, load_context, run_vet)
+from kubernetes_tpu.analysis import rules  # noqa: F401  (registers rules)
+
+__all__ = ["FileContext", "Rule", "Violation", "Waiver", "all_rules",
+           "default_paths", "format_violation", "load_context", "run_vet",
+           "rules"]

@@ -10,11 +10,12 @@ talks only through the API surface (DESIGN.md:40).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field as dc_field
+from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
 
 from kubernetes_tpu import admission as admission_pkg
-from kubernetes_tpu.admission import plugins as admission_plugins  # registers factories
+# ktpu-vet: ok unused — side-effect import: registers admission plugin factories
+from kubernetes_tpu.admission import plugins as admission_plugins  # noqa: F401
 from kubernetes_tpu.api import errors
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.api.fields import parse_field_selector

@@ -18,7 +18,7 @@ package's ListWatch sources, and the Fake client used by controller tests
 from __future__ import annotations
 
 import threading
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List
 
 from kubernetes_tpu import watch as watchpkg
 from kubernetes_tpu.api import types as api

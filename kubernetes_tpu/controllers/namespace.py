@@ -9,7 +9,7 @@ now-finalizer-free namespace for real.
 from __future__ import annotations
 
 import threading
-from typing import Callable, List
+from typing import List
 
 from kubernetes_tpu.api import errors
 from kubernetes_tpu.api import types as api

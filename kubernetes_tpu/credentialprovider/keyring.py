@@ -8,7 +8,7 @@ import base64
 import json
 import os
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Tuple
+from typing import List, Optional, Tuple
 
 __all__ = ["DockerConfigEntry", "DockerConfig", "DockerKeyring", "Provider",
            "FileProvider", "EnvProvider", "register_provider",

@@ -8,7 +8,7 @@ does: Name/Labels/key-fields blocks followed by an events table.
 from __future__ import annotations
 
 import io
-from typing import Any, Optional
+from typing import Optional
 
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.kubectl.printers import HumanReadablePrinter, _join_labels

@@ -13,10 +13,9 @@
 from __future__ import annotations
 
 import datetime
-import io
 import json
 import re
-from typing import Any, Callable, Dict, List
+from typing import Any, Dict, List
 
 import yaml
 

@@ -20,7 +20,6 @@ from typing import Any, Callable, List, Optional
 
 import yaml
 
-from kubernetes_tpu.api import errors
 from kubernetes_tpu.api.meta import default_rest_mapper
 
 __all__ = ["Info", "Builder", "ResourceError", "RESOURCE_ALIASES"]

@@ -46,7 +46,10 @@ class PodConfig:
     (ref: config.PodConfig + Mux, config.go:53-63)."""
 
     def __init__(self):
-        self.updates: "queue.Queue[PodUpdate]" = queue.Queue()
+        # bounded + coalescing: every queued PodUpdate is a FULL merged
+        # snapshot, so a slow kubelet sync loop drops superseded old
+        # entries (latest wins) instead of buffering unbounded history
+        self.updates: "queue.Queue[PodUpdate]" = queue.Queue(maxsize=64)
         self._lock = threading.Lock()
         self._per_source: Dict[str, List[api.Pod]] = {}
 
@@ -61,8 +64,22 @@ class PodConfig:
             for src in sorted(self._per_source):
                 for p in self._per_source[src]:
                     merged[p.metadata.uid or p.metadata.name] = p
-            self.updates.put(PodUpdate(op=SET, pods=list(merged.values()),
-                                       source=source))
+            update = PodUpdate(op=SET, pods=list(merged.values()),
+                               source=source)
+            # never block here: a blocking put while holding _lock would
+            # wedge every other source (and seen_sources) behind a
+            # stalled consumer, and a source's stop() could not
+            # interrupt it. Older snapshots are strictly superseded by
+            # this one, so dropping the oldest is lossless.
+            while True:
+                try:
+                    self.updates.put_nowait(update)
+                    break
+                except queue.Full:
+                    try:
+                        self.updates.get_nowait()
+                    except queue.Empty:
+                        pass
 
     def seen_sources(self) -> List[str]:
         with self._lock:

@@ -17,7 +17,7 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
-from kubernetes_tpu.kubelet.runtime import ContainerRuntime, INFRA_CONTAINER_NAME
+from kubernetes_tpu.kubelet.runtime import ContainerRuntime
 
 __all__ = ["GCPolicy", "ContainerGC", "ImageGCPolicy", "ImageManager"]
 

@@ -32,7 +32,6 @@ from kubernetes_tpu.kubelet.runtime import (
     INFRA_CONTAINER_NAME,
     ContainerRecord,
     ContainerRuntime,
-    pod_full_name,
 )
 from kubernetes_tpu.kubelet.status import StatusManager
 from kubernetes_tpu.scheduler import predicates as sched_predicates

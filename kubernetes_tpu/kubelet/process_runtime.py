@@ -41,7 +41,6 @@ from kubernetes_tpu.kubelet.runtime import (
     ContainerRecord,
     ContainerRuntime,
     build_container_name,
-    pod_full_name,
 )
 
 __all__ = ["ProcessRuntime", "find_pause_binary", "pause_command"]

@@ -52,7 +52,6 @@ from __future__ import annotations
 
 import functools
 import json
-import math
 import os
 import threading
 import time

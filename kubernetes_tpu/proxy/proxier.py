@@ -15,12 +15,11 @@ socket syscalls).
 
 from __future__ import annotations
 
-import errno
 import select
 import socket
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 from kubernetes_tpu.api import types as api

@@ -13,7 +13,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.client.cache import meta_namespace_key_func

@@ -26,7 +26,6 @@ from kubernetes_tpu.api import validation
 from kubernetes_tpu.api.fields import FieldSelector
 from kubernetes_tpu.api.labels import Selector
 from kubernetes_tpu.api.meta import accessor
-from kubernetes_tpu.runtime.serialize import now_rfc3339
 from kubernetes_tpu.storage.helper import StoreHelper
 from kubernetes_tpu.util import tracing
 

@@ -19,7 +19,7 @@ over the GenericRegistry plus, where the reference has them, special verbs:
 from __future__ import annotations
 
 import threading
-from typing import Any, List, Optional
+from typing import List, Optional
 
 from kubernetes_tpu.api import errors
 from kubernetes_tpu.api import types as api

@@ -12,6 +12,13 @@ built from dataclasses, dicts, lists, tuples, and atomic leaves.
 
 Falls back to copy.deepcopy for anything unrecognized, so correctness
 never depends on the fast path's coverage.
+
+The sharing contract is machine-checked by kube-vet's ``clone-mutation``
+rule (docs/design/invariants.md): every repo-local class in ``_ATOMIC``
+must stay immutable outside construction (it is shared verbatim between
+clone and original), the SOURCE of a ``deep_clone`` must not be mutated
+afterwards, and this module must never copy ``__dict__`` wholesale
+(undeclared attributes are derived caches — see the field loop below).
 """
 
 from __future__ import annotations

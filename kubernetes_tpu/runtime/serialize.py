@@ -27,7 +27,8 @@ import dataclasses
 import datetime
 import re
 import typing
-from typing import Any, Callable, Dict, Optional, Type, get_args, get_origin, get_type_hints
+from typing import (Any, Callable, Dict, Optional, get_args, get_origin,
+                    get_type_hints)
 
 from kubernetes_tpu.api.quantity import Quantity
 

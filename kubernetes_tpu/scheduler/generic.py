@@ -12,7 +12,7 @@ bit-for-bit.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Dict, List, Set, Tuple
 
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.scheduler.listers import FakeMinionLister

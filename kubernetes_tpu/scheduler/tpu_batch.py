@@ -63,7 +63,6 @@ from datetime import timezone
 from typing import List, NamedTuple, Optional
 
 from kubernetes_tpu.api import types as api
-from kubernetes_tpu.client.record import EventRecorder
 from kubernetes_tpu.models import gang
 from kubernetes_tpu.models.batch_solver import decisions_to_names, solve
 from kubernetes_tpu.models.incremental import IncrementalEncoder

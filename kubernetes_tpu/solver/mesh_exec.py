@@ -372,6 +372,7 @@ class MeshExecutor:
                 continue
             d = delta.get(name) if delta else None
             if rec is not None and d is not None and d[0] is rec[0]:
+                _src, base_dev, base_xla_owned = rec
                 rows, vals = d[1], d[2]
                 vals = self._pad_vals(name, vals, pad)
                 rows, vals = _pow2_rows(np.ascontiguousarray(rows),
@@ -380,7 +381,8 @@ class MeshExecutor:
                 # base may alias the cached host array (see _scatter_fn)
                 with _donation_warnings_scoped():
                     dev = _scatter_fn(getattr(sh, name),
-                                      donate=rec[2])(rec[1], rows, vals)
+                                      donate=base_xla_owned)(base_dev,
+                                                             rows, vals)
                 transfer += rows.nbytes + vals.nbytes
                 xla_owned = True
             else:

@@ -58,7 +58,7 @@ import struct
 import threading
 import time
 from collections import OrderedDict, deque
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Tuple
 
 import numpy as np
 
@@ -277,6 +277,8 @@ class SolverService:
         self._sock.listen(128)
         self._stopped = threading.Event()
         self._cond = threading.Condition()
+        # ktpu-vet: ok thread-discipline — bounded by the BUSY backpressure
+        # check (len >= max_queue under _cond) before every append
         self._pending: deque = deque()
         self._threads: List[threading.Thread] = []
         self._conns: set = set()

@@ -29,7 +29,7 @@ import bisect
 import heapq
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
 from kubernetes_tpu import watch as watchpkg

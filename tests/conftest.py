@@ -48,3 +48,40 @@ if os.environ.get("KTPU_RACE"):
     import sys as _sys
 
     _sys.setswitchinterval(1e-6)
+
+    # Lock-order sanitizer (util/locksmith.py): every threading.Lock/
+    # RLock created from here on records per-thread acquisition chains
+    # into a global order graph; a cycle = a potential deadlock the
+    # switch-interval regime made probable but not necessarily fatal.
+    # pytest_sessionfinish below turns any cycle into a failed run.
+    from kubernetes_tpu.util import locksmith as _locksmith
+
+    _locksmith.arm()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """--race rounds fail loudly on any lock-order cycle locksmith saw,
+    even if no schedule actually deadlocked during the run."""
+    if not os.environ.get("KTPU_RACE"):
+        return
+    import sys
+
+    from kubernetes_tpu.util import locksmith
+
+    reps = locksmith.reports()
+    if reps:
+        print("\n=== locksmith: potential deadlocks (lock-order cycles) "
+              "===", file=sys.stderr)
+        for r in reps:
+            print(locksmith.format_report(r), file=sys.stderr)
+        session.exitstatus = 1
+    else:
+        print(f"\n[locksmith] armed={locksmith.armed()} "
+              f"lock-order cycles: 0 "
+              f"(order edges observed: {len(locksmith.edges())})",
+              file=sys.stderr)
+    if os.environ.get("KTPU_LOCK_EDGES"):
+        # dump the measured order table (docs/design/invariants.md)
+        for (a, b), n in sorted(locksmith.edges().items(),
+                                key=lambda kv: -kv[1]):
+            print(f"[locksmith] edge {n:>8} {a} -> {b}", file=sys.stderr)

@@ -305,7 +305,6 @@ def test_always_deny_plugin():
 # -- events TTL -------------------------------------------------------------
 
 def test_event_registry_ttl():
-    import itertools
     now = [0.0]
     from kubernetes_tpu.storage.memstore import MemStore
     m = Master(MasterConfig(store=MemStore(clock=lambda: now[0]), event_ttl_seconds=10))

@@ -332,7 +332,6 @@ def test_packed_transfer_is_bit_identical(monkeypatch):
     bit-identical to the per-array transfer path across dtype variety
     (int32/int64 planes, bool masks, uint32 bitmask words, float32
     zone one-hots)."""
-    import os
 
     import bench
     from kubernetes_tpu.models.batch_solver import solve

@@ -16,7 +16,6 @@ from kubernetes_tpu.client.cache import (
     Store,
     StorePodLister,
     StoreServiceLister,
-    meta_namespace_key_func,
 )
 from kubernetes_tpu.api.labels import parse_selector
 from kubernetes_tpu.storage.helper import StoreHelper

@@ -8,7 +8,6 @@ import threading
 import time
 import urllib.request
 
-import pytest
 
 from kubernetes_tpu.api import types as api
 

@@ -8,10 +8,8 @@ EXACTLY once, losers requeue with backoff, and no wave deadlocks — even
 with injected CAS conflicts and stale node/pod stores.
 """
 
-import threading
 import time
 
-import pytest
 
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.api.quantity import Quantity

@@ -8,13 +8,11 @@ all live loops — the reference's definition of "multi-node without a cluster".
 """
 
 import json
-import time
 
 import pytest
 
 from kubernetes_tpu.api import errors
 from kubernetes_tpu.api import types as api
-from kubernetes_tpu.api.quantity import Quantity
 from kubernetes_tpu.cluster import Cluster, ClusterConfig
 
 

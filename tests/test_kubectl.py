@@ -4,14 +4,12 @@ is strictly stronger)."""
 
 import io
 import json
-import textwrap
 
 import pytest
 import yaml
 
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.api.latest import scheme
-from kubernetes_tpu.api.quantity import Quantity
 from kubernetes_tpu.apiserver.master import Master
 from kubernetes_tpu.client.client import Client, InProcessTransport
 from kubernetes_tpu.kubectl.cmd import Factory, run_kubectl

@@ -6,7 +6,6 @@ image_manager_test.go) — all against FakeRuntime, no real containers.
 import json
 import time
 
-import pytest
 
 from kubernetes_tpu import probe as probe_pkg
 from kubernetes_tpu.api import types as api
@@ -296,6 +295,21 @@ class TestConfigSources:
         cfg.merge("api", [make_pod("b", uid="u-b")])
         upd = cfg.updates.get()
         assert {p.metadata.name for p in upd.pods} == {"a", "b"}
+
+    def test_merge_never_blocks_when_consumer_stalls(self):
+        # the channel is bounded (thread-discipline), but every update
+        # is a full merged snapshot: with no consumer, merge() must
+        # coalesce (drop superseded snapshots), never block under _lock
+        cfg = PodConfig()
+        for i in range(cfg.updates.maxsize * 3):
+            cfg.merge("file", [make_pod(f"p{i}", uid=f"u-{i}")])
+        assert cfg.updates.qsize() <= cfg.updates.maxsize
+        last = None
+        while not cfg.updates.empty():
+            last = cfg.updates.get()
+        # the newest snapshot always survives the coalescing
+        assert {p.metadata.name for p in last.pods} == \
+            {f"p{cfg.updates.maxsize * 3 - 1}"}
 
     def test_mirror_pod_created_for_static(self):
         master = Master()

@@ -4,7 +4,6 @@ import pytest
 
 from kubernetes_tpu.api.labels import (
     Requirement,
-    Selector,
     everything,
     format_labels,
     nothing,

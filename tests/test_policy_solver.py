@@ -22,11 +22,7 @@ from kubernetes_tpu.models.batch_solver import (
     solve_jit,
 )
 from kubernetes_tpu.models.oracle import solve_serial
-from kubernetes_tpu.models.policy import (
-    BatchPolicy,
-    UnsupportedPolicy,
-    batch_policy_from,
-)
+from kubernetes_tpu.models.policy import UnsupportedPolicy, batch_policy_from
 from kubernetes_tpu.models.snapshot import encode_snapshot
 from kubernetes_tpu.scheduler.plugins import Policy, load_policy
 

@@ -205,7 +205,6 @@ def test_storeserver_sigkill_restart_clients_and_data_recover(tmp_path):
     topology (ref: the reference's components ride out etcd restarts by
     list-then-watch resume, pkg/client/cache/reflector.go:83)."""
     import os
-    import signal
     import socket as socket_mod
     import subprocess
     import sys

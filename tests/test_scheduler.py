@@ -6,7 +6,6 @@ generic-scheduler tests, and driver tests with a mock binder
 (plugin/pkg/scheduler/scheduler_test.go).
 """
 
-import threading
 import time
 
 import pytest
@@ -26,7 +25,6 @@ from kubernetes_tpu.scheduler.driver import (
 from kubernetes_tpu.scheduler.generic import (
     FitError,
     GenericScheduler,
-    fnv1a64,
     select_host_deterministic,
 )
 from kubernetes_tpu.scheduler.listers import (
@@ -35,7 +33,7 @@ from kubernetes_tpu.scheduler.listers import (
     FakePodLister,
     FakeServiceLister,
 )
-from kubernetes_tpu.scheduler.priorities import HostPriority, PriorityConfig
+from kubernetes_tpu.scheduler.priorities import HostPriority
 
 
 def mk_pod(name="p", ns="default", cpu=None, mem=None, host="", labels=None,

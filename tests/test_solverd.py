@@ -18,7 +18,7 @@ import pytest
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.api.quantity import Quantity
 from kubernetes_tpu.models import gang as gang_mod
-from kubernetes_tpu.models.batch_solver import SolverInputs, solve
+from kubernetes_tpu.models.batch_solver import solve
 from kubernetes_tpu.models.incremental import IncrementalEncoder
 from kubernetes_tpu.models.policy import BatchPolicy, batch_policy_from
 from kubernetes_tpu.models.snapshot import encode_snapshot

@@ -1,9 +1,7 @@
 """MemStore + StoreHelper tests (ref: pkg/tools/etcd_helper_test.go,
 etcd_helper_watch_test.go, fake_etcd_client semantics)."""
 
-import queue
 import threading
-import time
 
 import pytest
 

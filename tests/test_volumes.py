@@ -8,10 +8,12 @@ import os
 import pytest
 
 from kubernetes_tpu.api import types as api
-from kubernetes_tpu.volume.plugins import (FakeDiskManager, FakeMounter,
-                                           VolumeHost, VolumePluginMgr,
-                                           escape_plugin_name,
-                                           new_default_plugin_mgr)
+from kubernetes_tpu.volume.plugins import (
+    FakeDiskManager,
+    FakeMounter,
+    escape_plugin_name,
+    new_default_plugin_mgr,
+)
 
 
 def mkpod(uid="uid-1", volumes=()):

@@ -9,7 +9,6 @@ value, not by timing compiles."""
 import json
 import os
 
-import pytest
 
 from kubernetes_tpu.models.batch_solver import WavePlan, WaveRouter
 from kubernetes_tpu.models.policy import BatchPolicy
