@@ -511,6 +511,7 @@ FULL_SHAPES = {
     "binpack3": (5_000, 10_000, {"three_resources": True}),
     "gang": (2_000, 0, {"gang_groups": 1_000, "gang_size": 8}),
     "mesh": (10_000, 2_048, {}),
+    "priority": (2_000, 1_000, {}),
 }
 
 
@@ -757,6 +758,155 @@ def timed_wave(nodes, existing, pending, services, batch_policy=None,
         res["compile_s"] = round(compile_s, 3)
         res["shape_setup_s"] = round(shape_setup_s, 3)
     return res, snap, chosen_np
+
+
+def build_priority_cluster(n_nodes: int, n_pending: int,
+                           fill_per_node: int = 4):
+    """kube-preempt benchmark cluster: every node pre-filled EXACTLY to
+    capacity with low-priority pods split across two priority bands (so
+    the lowest-sufficient-threshold choice is non-trivial), then a
+    pending wave that can only place by evicting — plus Never-policy and
+    equal-priority pods that must stay pending (the invariants ride the
+    same wave the throughput number comes from)."""
+    from kubernetes_tpu.api import types as api
+    from kubernetes_tpu.api.quantity import Quantity
+
+    unit_m = 500
+    nodes = [api.Node(
+        metadata=api.ObjectMeta(name=f"node-{i:05d}"),
+        spec=api.NodeSpec(capacity={
+            "cpu": Quantity(f"{fill_per_node * unit_m}m"),
+            "memory": Quantity("32Gi")}))
+        for i in range(n_nodes)]
+
+    def pod(name, i, prio, host="", policy_never=False, units=1):
+        return api.Pod(
+            metadata=api.ObjectMeta(name=name, namespace="default",
+                                    uid=f"uid-{name}"),
+            spec=api.PodSpec(
+                host=host,
+                containers=[api.Container(
+                    name="c", image="img",
+                    resources=api.ResourceRequirements(limits={
+                        "cpu": Quantity(f"{units * unit_m}m"),
+                        "memory": Quantity(f"{units * 256}Mi")}))],
+                priority=prio,
+                preemption_policy=(api.PreemptNever if policy_never
+                                   else "")),
+            status=api.PodStatus(host=host))
+
+    existing = []
+    for i in range(n_nodes):
+        for j in range(fill_per_node):
+            # two low bands: 100 and 200 — a preemptor may clear just the
+            # 100 band (lowest sufficient) or need both
+            existing.append(pod(f"low-{i:05d}-{j}", i,
+                                100 if j % 2 == 0 else 200,
+                                host=f"node-{i:05d}"))
+    pending = []
+    for k in range(n_pending):
+        if k % 10 == 9:
+            # PreemptionPolicy=Never at high priority: stays pending in a
+            # full cluster no matter what
+            pending.append(pod(f"storm-never-{k:05d}", k, 1000,
+                               policy_never=True))
+        elif k % 10 == 8:
+            # equal priority to the top resident band: never evicts
+            pending.append(pod(f"storm-equal-{k:05d}", k, 200))
+        else:
+            # the storm: single- and double-unit high-priority pods
+            pending.append(pod(f"storm-{k:05d}", k, 1000,
+                               units=1 + (k % 3 == 0)))
+    return nodes, existing, pending
+
+
+def run_priority_config(tag, n_nodes, n_pods, gate_nodes=0, gate_pods=0,
+                        runs=30):
+    """kube-preempt: throughput of preemption waves (every placement
+    evicts) + the bit-identity gate against the preempt_serial oracle —
+    decisions AND victim sets must match exactly, and the
+    never-evict-equal-or-higher / PreemptionPolicy=Never invariants are
+    re-checked on the full wave."""
+    import numpy as np
+
+    from kubernetes_tpu.api import types as api
+    from kubernetes_tpu.models import preempt as preempt_mod
+    from kubernetes_tpu.models.batch_solver import decisions_to_names, solve
+    from kubernetes_tpu.models.oracle import preempt_serial
+    from kubernetes_tpu.models.snapshot import encode_snapshot
+
+    log(f"[{tag}] building full cluster: {n_nodes} nodes pre-filled, "
+        f"{n_pods} storm pods")
+    nodes, existing, pending = build_priority_cluster(n_nodes, n_pods)
+    res, snap, chosen_np = timed_wave(nodes, existing, pending, [],
+                                      runs=runs)
+
+    # full-wave invariant checks need the scores (timed_wave drops them):
+    # one more solve of the same snapshot — deterministic, cached program
+    chosen, scores = solve(snap)
+    assert np.array_equal(np.asarray(chosen), np.asarray(chosen_np)), \
+        "non-deterministic priority solve"
+    names = decisions_to_names(snap, chosen)
+    node_index = {n.metadata.name: i for i, n in enumerate(nodes)}
+    victims = preempt_mod.assign_victims(
+        chosen, scores, snap.band_prio,
+        preempt_mod.resident_from_pods(existing, node_index),
+        n_pods=len(pending))
+    prio_of = {p.metadata.uid: api.pod_priority(p) for p in existing}
+    n_preempted = sum(1 for v in victims if v)
+    n_victims = sum(len(v) for v in victims if v)
+    for p, v in zip(pending, victims):
+        if not v:
+            continue
+        pp = api.pod_priority(p)
+        assert all(prio_of[x.uid] < pp for x in v), \
+            f"{tag}: evicted an equal-or-higher-priority pod"
+        assert p.spec.preemption_policy != api.PreemptNever, \
+            f"{tag}: a PreemptionPolicy=Never pod preempted"
+    # Never pods may still place NORMALLY into capacity earlier
+    # preemptions freed (a whole evicted band can exceed its preemptor's
+    # request) — what they may never do is place via eviction, which the
+    # victims loop above already pinned. Re-assert it explicitly:
+    never_evicting = [nm for p, nm, v in zip(pending, names, victims)
+                      if p.spec.preemption_policy == api.PreemptNever and v]
+    assert not never_evicting, \
+        f"{tag}: Never pods placed via preemption: {never_evicting}"
+    res["preempted_pods"] = n_preempted
+    res["victims"] = n_victims
+    log(f"[{tag}] {n_preempted} preempting placements, {n_victims} "
+        f"victims, invariants OK")
+
+    # oracle gate: decisions + victim sets bit-identical to preempt_serial
+    g_nodes = nodes[:gate_nodes] if gate_nodes else nodes
+    keep = {n.metadata.name for n in g_nodes}
+    g_exist = [p for p in existing if p.status.host in keep]
+    g_pend = pending[:gate_pods] if gate_pods else pending
+    g_snap = encode_snapshot(g_nodes, g_exist, g_pend, [])
+    g_chosen, g_scores = solve(g_snap)
+    g_names = decisions_to_names(g_snap, g_chosen)
+    g_index = {n.metadata.name: i for i, n in enumerate(g_nodes)}
+    g_victims = preempt_mod.assign_victims(
+        g_chosen, g_scores, g_snap.band_prio,
+        preempt_mod.resident_from_pods(g_exist, g_index),
+        n_pods=len(g_pend))
+    t0 = time.perf_counter()
+    s_names, s_victims = preempt_serial(g_nodes, g_exist, g_pend)
+    oracle_s = time.perf_counter() - t0
+    bv = [sorted(v.uid for v in (x or [])) or None for x in g_victims]
+    sv = [sorted(v.uid for v in (x or [])) or None for x in s_victims]
+    if g_names != s_names or bv != sv:
+        nd = sum(1 for a, b in zip(g_names, s_names) if a != b)
+        nv = sum(1 for a, b in zip(bv, sv) if a != b)
+        log(f"[{tag}] PREEMPT ORACLE FAILURE: {nd} decisions / {nv} "
+            f"victim sets diverge over {len(g_pend)} pods")
+        return None
+    rate = len(g_pend) / oracle_s if oracle_s > 0 else 0.0
+    res["gate"] = f"preempt-oracle-{len(g_pend)}x{len(g_nodes)}"
+    res["serial_oracle_pods_per_s"] = round(rate, 1)
+    log(f"[{tag}] preempt oracle OK: decisions + victim sets identical "
+        f"on {len(g_pend)} pods x {len(g_nodes)} nodes "
+        f"({oracle_s:.1f}s serial)")
+    return res
 
 
 def check_equivalence(tag, snap, chosen_np, nodes, existing, pending,
@@ -1453,7 +1603,7 @@ def child(argv) -> int:
     s = args.smoke
     runs = args.runs or (5 if s else 12 if args.cpu else 30)
     known = {"north_star", "basic", "affinity", "binpack3", "gang", "churn",
-             "pipeline", "mesh"}
+             "pipeline", "mesh", "priority"}
     if args.configs != "all":
         want = set(args.configs.split(","))
     else:
@@ -1578,6 +1728,11 @@ def child(argv) -> int:
         256 if s else m_nodes, 128 if s else m_pods,
         gate_nodes=100 if s else 600, gate_pods=100 if s else 600,
         runs=2 if s else 5)
+    pr_nodes, pr_pods, _ = FULL_SHAPES["priority"]
+    run("priority", run_priority_config,
+        50 if s else pr_nodes, 60 if s else pr_pods,
+        gate_nodes=25 if s else 150, gate_pods=60 if s else 200,
+        runs=runs)
     run("churn", run_churn_config,
         20 if s else 500, 300 if s else 8_000,
         rate_pods_per_s=300 if s else 1_000,

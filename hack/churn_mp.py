@@ -51,18 +51,29 @@ def cpu_env() -> dict:
     return env
 
 
-_POD_TEMPLATE = json.dumps({
-    "kind": "Pod", "apiVersion": "v1",
-    "metadata": {"name": "@@NAME@@", "namespace": "default"},
-    "spec": {"containers": [{
+def _pod_template(priority_class: str = "") -> str:
+    spec = {"containers": [{
         "name": "c", "image": "img",
         "resources": {"limits": {"cpu": "100m",
-                                 "memory": "128Mi"}}}]}})
+                                 "memory": "128Mi"}}}]}
+    if priority_class:
+        # kube-preempt: the apiserver's PriorityDefault admission resolves
+        # the class into spec.priority at create — feeders ship the NAME
+        spec["priorityClassName"] = priority_class
+    return json.dumps({
+        "kind": "Pod", "apiVersion": "v1",
+        "metadata": {"name": "@@NAME@@", "namespace": "default"},
+        "spec": spec})
+
+
+_POD_TEMPLATE = _pod_template()
 _POD_PATH = "/api/v1/namespaces/default/pods"
 
 
-def _render_request(prefix: str, i: int) -> bytes:
-    head, tail = _POD_TEMPLATE.split("@@NAME@@")
+def _render_request(prefix: str, i: int, priority_class: str = "") -> bytes:
+    tmpl = _pod_template(priority_class) if priority_class \
+        else _POD_TEMPLATE
+    head, tail = tmpl.split("@@NAME@@")
     body = f"{head}{prefix}-{i:06d}{tail}".encode()
     return (b"POST " + _POD_PATH.encode() + b" HTTP/1.1\r\n"
             b"Host: a\r\nContent-Type: application/json\r\n"
@@ -70,7 +81,8 @@ def _render_request(prefix: str, i: int) -> bytes:
             b"\r\n\r\n" + body)
 
 
-def render_replay(prefix: str, count: int, path: str) -> str:
+def render_replay(prefix: str, count: int, path: str,
+                  priority_class: str = "") -> str:
     """Pre-serialize a feeder's whole request stream to a replay log:
     ``path`` holds COUNT raw pipelined HTTP requests back-to-back and
     ``path + ".idx"`` the little-endian u32 offsets (count+1 entries).
@@ -82,7 +94,7 @@ def render_replay(prefix: str, count: int, path: str) -> str:
     offs = [0]
     with open(path, "wb") as fh:
         for i in range(count):
-            req = _render_request(prefix, i)
+            req = _render_request(prefix, i, priority_class)
             fh.write(req)
             offs.append(offs[-1] + len(req))
     with open(path + ".idx", "wb") as fh:
@@ -91,7 +103,7 @@ def render_replay(prefix: str, count: int, path: str) -> str:
 
 
 def feed(prefix: str, count: int, rate: float, master: str,
-         depth: int = 32, replay: str = "") -> int:
+         depth: int = 32, replay: str = "", priority_class: str = "") -> int:
     """Paced feeder (one process). Prints one JSON line when done.
 
     Offers pods over a raw keep-alive socket — a load generator must be
@@ -170,7 +182,7 @@ def feed(prefix: str, count: int, rate: float, master: str,
         if log_mm is not None:
             req = log_mv[idx[i]:idx[i + 1]]
         else:
-            req = _render_request(prefix, i)
+            req = _render_request(prefix, i, priority_class)
         while sent - done[0] >= depth and not bad:
             time.sleep(0.0005)
         if bad:
@@ -538,6 +550,12 @@ LATENCY_FIELDS = ("e2e_count", "e2e_p50_s", "e2e_p95_s", "e2e_p99_s",
 # merged series live in the <out>_timeline.json sidecar.
 TIMELINE_FIELDS = ("sample_period_s", "series", "headline")
 TIMELINE_MIN_SERIES = 5
+# kube-preempt evidence, required whenever a record claims the
+# priority-storm shape: evict+bind counts, the MUST-BE-ZERO invariant
+# counter, and the preempt-to-bind latency section.
+PREEMPTION_FIELDS = ("attempts", "victims", "conflicts",
+                     "higher_evictions", "bind_count", "bind_p50_s",
+                     "bind_p95_s")
 
 
 def validate_record(rec: dict, round_no: int = 8) -> list:
@@ -597,6 +615,13 @@ def validate_record(rec: dict, round_no: int = 8) -> list:
                     f"timeline.series:{len(series)}<{TIMELINE_MIN_SERIES}")
         if not isinstance(rec.get("alarms"), list):
             missing.append("alarms")
+    if rec.get("priority_storm"):
+        pr = rec.get("preemption")
+        if not isinstance(pr, dict):
+            missing.append("preemption")
+        elif "error" not in pr:
+            missing += [f"preemption.{k}" for k in PREEMPTION_FIELDS
+                        if k not in pr]
     cb = rec.get("cpu_budget_s")
     if cb is not None and not isinstance(cb, dict):
         missing.append("cpu_budget_s:not-a-dict")
@@ -681,6 +706,42 @@ def _collect_trace_shards(master: str, ports, n_api: int = 1):
     return list(shards.values()), errors, len(api_pids)
 
 
+def _scrape_preemption(ports) -> dict:
+    """kube-preempt evidence merged across scheduler workers: evict+bind
+    commits, victims, per-item CAS losses, the MUST-BE-ZERO
+    equal-or-higher-eviction invariant counter, and the preempt-to-bind
+    latency quantiles (scheduler_preemption_bind_seconds) — the storm
+    record's ``preemption`` section (required when priority_storm)."""
+    out = {"attempts": 0, "victims": 0, "conflicts": 0,
+           "higher_evictions": 0}
+    total, count, bmap = 0.0, 0.0, {}
+    for port in ports:
+        raw = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+        for key, field in (
+                ("scheduler_preemption_attempts_total", "attempts"),
+                ("scheduler_preemption_victims_total", "victims"),
+                ("scheduler_preemption_conflicts_total", "conflicts"),
+                ("scheduler_preemption_higher_evictions_total",
+                 "higher_evictions")):
+            for line in raw.splitlines():
+                if line.startswith(key + " "):
+                    out[field] += int(float(line.rsplit(None, 1)[1]))
+        s, c, buckets = _parse_hist(raw, "scheduler_preemption_bind_seconds")
+        total += s
+        count += c
+        for le, n in buckets:
+            bmap[le] = bmap.get(le, 0.0) + n
+    buckets = sorted(bmap.items())
+    out["bind_count"] = int(count)
+    out["bind_mean_s"] = round(total / count, 4) if count else None
+    out["bind_p50_s"] = round(
+        _hist_quantile(buckets, count, 0.5), 4) if count else None
+    out["bind_p95_s"] = round(
+        _hist_quantile(buckets, count, 0.95), 4) if count else None
+    return out
+
+
 def _scrape_pipeline(port: int) -> dict:
     """Speculation counters from a pipelined scheduler worker's /metrics."""
     raw = urllib.request.urlopen(
@@ -743,7 +804,8 @@ def main(argv=None) -> int:
     if argv and argv[0] == "--_feed":
         return feed(argv[1], int(argv[2]), float(argv[3]), argv[4],
                     replay=argv[5] if len(argv) > 5 else "",
-                    depth=int(argv[6]) if len(argv) > 6 else 32)
+                    depth=int(argv[6]) if len(argv) > 6 else 32,
+                    priority_class=argv[7] if len(argv) > 7 else "")
 
     ap = argparse.ArgumentParser()
     ap.add_argument("--pods", type=int, default=6000)
@@ -786,6 +848,11 @@ def main(argv=None) -> int:
                     help="kube-solverd --mesh-dispatch: auto times "
                     "sharded vs single-device once per shape and runs "
                     "the winner; shard/single pin a layout")
+    ap.add_argument("--mesh-min-nodes", type=int, default=0,
+                    help="kube-solverd --mesh-min-nodes override (0 = "
+                    "daemon default): lets sub-floor shapes — e.g. the "
+                    "priority-storm cluster — run through the mesh "
+                    "executor's device-resident plane path")
     ap.add_argument("--solverd-gather", type=float, default=0.003,
                     help="kube-solverd gather window seconds; raise it "
                     "when several scheduler workers share the daemon so "
@@ -847,6 +914,19 @@ def main(argv=None) -> int:
                     help="pass through to the apiserver(s); 0 keeps the "
                     "server default (65536). Lag-storm runs set this "
                     "low so the storm trips inside the run's span")
+    ap.add_argument("--priority-storm", action="store_true",
+                    help="kube-preempt scenario: pre-fill the cluster "
+                    "EXACTLY to capacity with low-priority pods "
+                    "(PriorityClass storm-low), then offer --pods "
+                    "high-priority pods (storm-high) at --rate — every "
+                    "storm pod must bind via atomic evict+bind "
+                    "preemption. Nodes are sized to "
+                    "--storm-fill-per-node template pods; the record "
+                    "gains a priority_storm marker + preemption section "
+                    "and perfgate isolates it from the clean series")
+    ap.add_argument("--storm-fill-per-node", type=int, default=8,
+                    help="template pods per node at exact capacity in "
+                    "--priority-storm mode")
     ap.add_argument("--port", type=int, default=18410)
     ap.add_argument("--out", default=None)
     ap.add_argument("--platform", choices=["cpu", "ambient"], default="cpu",
@@ -957,25 +1037,45 @@ def main(argv=None) -> int:
         from kubernetes_tpu.client.client import Client
         from kubernetes_tpu.client.http import HTTPTransport
         client = Client(HTTPTransport(master))
+        if args.priority_storm:
+            # kube-preempt: nodes sized to EXACTLY --storm-fill-per-node
+            # template pods (100m / 128Mi each), so "full" is a precise
+            # number; the two PriorityClasses drive admission resolution
+            fpn = args.storm_fill_per_node
+            node_cap = {"cpu": Quantity(f"{fpn * 100}m"),
+                        "memory": Quantity(f"{fpn * 128}Mi")}
+            client.resource("priorityclasses").create(api.PriorityClass(
+                metadata=api.ObjectMeta(name="storm-low"), value=100))
+            client.resource("priorityclasses").create(api.PriorityClass(
+                metadata=api.ObjectMeta(name="storm-high"), value=1000))
+        else:
+            node_cap = {"cpu": Quantity("64"),
+                        "memory": Quantity("256Gi")}
         for i in range(args.nodes):
             client.nodes().create(api.Node(
                 metadata=api.ObjectMeta(name=f"node-{i:05d}"),
-                spec=api.NodeSpec(capacity={"cpu": Quantity("64"),
-                                            "memory": Quantity("256Gi")})))
+                spec=api.NodeSpec(capacity=dict(node_cap))))
 
         # batch-vs-per-pod CAS parity on the LIVE server, before any
         # scheduler can race the probe pods (the zero-divergence evidence
-        # the record carries)
-        try:
-            parity = bind_parity_probe(client, api, args.nodes)
-        except Exception as e:
-            parity = {"error": f"probe failed: {e}"}
-        # isolated bind cost on the quiet server (comparable to r07's
-        # commit-derived figure, which r07 measured on post-feed waves)
-        try:
-            bind_probe = bind_cost_probe(client, api, args.nodes)
-        except Exception as e:
-            bind_probe = {"error": f"probe failed: {e}"}
+        # the record carries). Skipped in storm mode: probe pods bind
+        # directly onto the sized nodes and would break the exact-fill
+        # arithmetic the scenario depends on.
+        if args.priority_storm:
+            parity = {"skipped": "priority-storm (probe pods would "
+                                 "consume the exact-fill capacity)"}
+            bind_probe = {"skipped": "priority-storm"}
+        else:
+            try:
+                parity = bind_parity_probe(client, api, args.nodes)
+            except Exception as e:
+                parity = {"error": f"probe failed: {e}"}
+            # isolated bind cost on the quiet server (comparable to r07's
+            # commit-derived figure, measured on post-feed waves)
+            try:
+                bind_probe = bind_cost_probe(client, api, args.nodes)
+            except Exception as e:
+                bind_probe = {"error": f"probe failed: {e}"}
 
         solver_addr = ""
         if args.solverd:
@@ -999,6 +1099,8 @@ def main(argv=None) -> int:
                   "--mesh", args.mesh,
                   "--pods-axis", str(args.pods_axis),
                   "--mesh-dispatch", args.mesh_dispatch,
+                  *(["--mesh-min-nodes", str(args.mesh_min_nodes)]
+                    if args.mesh_min_nodes else []),
                   *(["--trace"] if args.trace else []),
                   *(["--flightrec"] if args.flightrec else []),
                   *(["--trace-device", args.trace_device]
@@ -1232,6 +1334,35 @@ def main(argv=None) -> int:
                 raise RuntimeError(f"warmup bucket {size} did not bind")
             size //= 2
 
+        fill_count = 0
+        if args.priority_storm:
+            # fill the cluster EXACTLY to capacity with storm-low pods
+            # (warmup pods sit at priority 0 and are evictable too); the
+            # storm then has no free capacity anywhere — every
+            # high-priority pod must claim its node by eviction
+            capacity = args.nodes * args.storm_fill_per_node
+            fill_count = capacity - warm_total
+            if fill_count < 0:
+                raise RuntimeError(
+                    f"cluster capacity {capacity} below warmup "
+                    f"{warm_total}: raise --nodes/--storm-fill-per-node")
+            if args.pods > capacity:
+                raise RuntimeError(
+                    f"--pods {args.pods} exceeds cluster capacity "
+                    f"{capacity}: nothing to evict for the overflow")
+            print(f"[churn-mp] priority-storm fill: {fill_count} "
+                  f"storm-low pods -> exact capacity {capacity}",
+                  file=sys.stderr, flush=True)
+            if fill_count:
+                feed("fill", fill_count, 100000.0, master,
+                     priority_class="storm-low")
+                if not wait_all_bound(warm_total + fill_count,
+                                      timeout=300.0):
+                    raise RuntimeError("storm fill did not bind to "
+                                       "capacity")
+            print("[churn-mp] cluster full; offering the high-priority "
+                  "storm", file=sys.stderr, flush=True)
+
         try:
             waves_baseline = [_scrape_wave_raw(p)
                               for p in sched_metrics_ports]
@@ -1247,10 +1378,11 @@ def main(argv=None) -> int:
         # the paced offer loop is mmap-slice + sendall, ~0 CPU per pod
         replay_paths = [os.path.join(logdir, f"replay-{f}.bin")
                         for f in range(args.feeders)]
+        storm_pc = "storm-high" if args.priority_storm else ""
         t_r = time.perf_counter()
         rthreads = [threadinglib.Thread(
             target=render_replay,
-            args=(f"churn{f}", counts[f], replay_paths[f]))
+            args=(f"churn{f}", counts[f], replay_paths[f], storm_pc))
             for f in range(args.feeders)]
         for t in rthreads:
             t.start()
@@ -1268,7 +1400,7 @@ def main(argv=None) -> int:
         feeders = [subprocess.Popen(
             [PY, os.path.abspath(__file__), "--_feed", f"churn{f}",
              str(counts[f]), str(args.rate / args.feeders), master,
-             replay_paths[f], str(args.depth)],
+             replay_paths[f], str(args.depth), storm_pc],
             env=child_env, stdout=subprocess.PIPE, text=True)
             for f in range(args.feeders)]
         # Poll, don't block: a feeder that dies early (refused connect,
@@ -1345,7 +1477,27 @@ def main(argv=None) -> int:
                 with open(args.out, "w") as f:
                     f.write(json.dumps(record, indent=1) + "\n")
             return 1
-        ok = wait_all_bound(warm_total + args.pods)
+        if args.priority_storm:
+            # bound-frame counting undercounts here (victim DELETEs shrink
+            # the bound set), so storm completion is judged directly: no
+            # unbound pod remains — every storm pod claimed its node
+            def wait_storm_done(timeout=300.0):
+                deadline = time.monotonic() + timeout
+                while time.monotonic() < deadline:
+                    try:
+                        lst = json.loads(urllib.request.urlopen(
+                            f"{master}/api/v1/pods?fieldSelector="
+                            "spec.host%3D", timeout=30).read())
+                        if not lst.get("items"):
+                            return True
+                    except Exception:
+                        pass
+                    time.sleep(0.25)
+                return False
+
+            ok = wait_storm_done()
+        else:
+            ok = wait_all_bound(warm_total + args.pods)
         total_s = time.perf_counter() - t0
         if flight_agg is not None:
             # load window closed: active-only rules stand down (a binds
@@ -1378,6 +1530,9 @@ def main(argv=None) -> int:
             sched_desc += ")"
         if args.watchers:
             sched_desc += f" + {args.watchers} observer watch streams"
+        if args.priority_storm:
+            sched_desc += (" | PRIORITY STORM: cluster pre-filled to "
+                           "capacity, storm binds via atomic evict+bind")
         budget = cpu_budget()
         budget["feeders"] = round(sum(s.get("cpu_s", 0.0) for s in stats), 2)
         record = {
@@ -1501,8 +1656,32 @@ def main(argv=None) -> int:
             # shape key keeps it out of the clean trajectory's baselines
             record["lag_storm"] = args.lag_storm
             record["lag_storm_resyncs_seen"] = sum(lag_resyncs_seen)
+        if args.priority_storm:
+            # priority-storm shape marker (perfgate isolates it) + the
+            # kube-preempt evidence: every storm pod bound into a FULL
+            # cluster, zero equal-or-higher evictions, preempt-to-bind
+            # latency populated
+            record["priority_storm"] = {
+                "fill_pods": fill_count + warm_total,
+                "fill_per_node": args.storm_fill_per_node,
+                "storm_pods": args.pods,
+            }
+            try:
+                record["preemption"] = _scrape_preemption(
+                    sched_metrics_ports)
+            except Exception as e:
+                record["preemption"] = {"error": f"scrape failed: {e}"}
+            pr = record["preemption"]
+            if "error" not in pr:
+                print(f"[churn-mp] preemption: {pr['attempts']} "
+                      f"evict+bind commits, {pr['victims']} victims, "
+                      f"{pr['conflicts']} conflicts, "
+                      f"{pr['higher_evictions']} equal-or-higher "
+                      f"evictions (must be 0); preempt-to-bind "
+                      f"p50/p95 = {pr['bind_p50_s']}/{pr['bind_p95_s']} s",
+                      file=sys.stderr, flush=True)
         flush_flightrec(record)
-        missing = validate_record(record, round_no=11)
+        missing = validate_record(record, round_no=12)
         if missing:
             print(f"[churn-mp] WARNING: record missing contract fields: "
                   f"{missing}", file=sys.stderr, flush=True)

@@ -68,8 +68,10 @@ def _get_path(rec: dict, path: str):
 
 def shape_key(rec: dict) -> str:
     """Shape identity: the config line plus the load-topology class.
-    Observer fan-out and induced-lag-storm runs measure deliberately
-    different regimes and must never gate against the clean series."""
+    Observer fan-out, induced-lag-storm, and priority-storm runs measure
+    deliberately different regimes and must never gate against the clean
+    series (a preemption storm offers into a FULL cluster — its
+    sustained rate is an evict+bind number, not a clean-bind number)."""
     cfg = rec.get("config", "")
     ap = rec.get("apiserver") or {}
     suffix = ""
@@ -77,6 +79,8 @@ def shape_key(rec: dict) -> str:
         suffix += "+watchers"
     if rec.get("lag_storm"):
         suffix += "+lagstorm"
+    if rec.get("priority_storm"):
+        suffix += "+prioritystorm"
     return cfg + suffix
 
 

@@ -337,6 +337,26 @@ def default_churn_rules(binds_floor: float = 50.0,
         SLORule("process_rss_ceiling", "process_resident_bytes",
                 reduce="last", op="ceil", threshold=rss_ceil_bytes,
                 for_s=5.0, scope="max"),
+        # kube-preempt (the priority-storm scenario ships with its own
+        # alarm): a high-priority pod must claim its node promptly —
+        # preempt-to-bind p95 above the ceiling while load is offered
+        # means the evict+bind path is backing up behind the wave queue.
+        # Threshold sits below the histogram's 30 s top finite bucket so
+        # an overflow conservatively fires instead of reading 'no data'.
+        SLORule("preempt_to_bind_p95_ceiling",
+                "scheduler_preemption_bind_seconds",
+                reduce="p95", op="ceil", threshold=20.0,
+                window_s=60.0, for_s=10.0, service="scheduler",
+                scope="sum", active_only=True),
+        # eviction-rate visibility: the victims counter's rate rides the
+        # timeline as a headline series; the invariant counter must stay 0
+        SLORule("preemption_victims_rate_visible",
+                "scheduler_preemption_victims_total",
+                reduce="rate", op="ceil", threshold=10_000.0,
+                window_s=20.0, service="scheduler", scope="sum"),
+        SLORule("preemption_higher_evictions_zero",
+                ("scheduler_preemption_higher_evictions_total",),
+                reduce="last", op="ceil", threshold=0.0, scope="sum"),
     ]
 
 

@@ -29,7 +29,8 @@ from kubernetes_tpu.api.quantity import Quantity
 from kubernetes_tpu.registry.generic import Context
 
 __all__ = ["AlwaysAdmit", "AlwaysDeny", "NamespaceExists", "NamespaceAutoProvision",
-           "NamespaceLifecycle", "ResourceDefaults", "LimitRanger", "ResourceQuota"]
+           "NamespaceLifecycle", "ResourceDefaults", "LimitRanger", "ResourceQuota",
+           "PriorityDefault"]
 
 
 class AlwaysAdmit(Interface):
@@ -153,6 +154,80 @@ class ResourceDefaults(Interface):
                 limits[api.ResourceCPU] = Quantity(self.DEFAULT_CPU)
             if api.ResourceMemory not in limits:
                 limits[api.ResourceMemory] = Quantity(self.DEFAULT_MEMORY)
+
+
+class PriorityDefault(Interface):
+    """kube-preempt admission-defaulting: resolve a pod's
+    spec.priorityClassName into the integer spec.priority (and inherit the
+    class's preemptionPolicy when the pod sets none) — the analog of the
+    upstream Priority admission plugin. Rules:
+
+    - a named class must exist (unknown name -> 400-class Invalid);
+    - an explicitly pre-set spec.priority must MATCH the named class's
+      value (only the admission chain may invent priorities);
+    - with no class named, the globalDefault class (if any) applies,
+      else priority resolves to 0 (DefaultPodPriority).
+
+    Class lookups ride a short-TTL cache like the namespace plugins:
+    priority classes change rarely, pod creates at churn rate should not
+    pay a registry decode each.
+    """
+
+    _PC_CACHE_TTL = 1.0
+
+    def __init__(self, priorityclasses=None, **_):
+        self.priorityclasses = priorityclasses
+        self._cache: dict = {}   # name ("" = globalDefault) -> (deadline, pc)
+
+    def _get_class(self, name: str) -> Optional[api.PriorityClass]:
+        import time as _time
+
+        now = _time.monotonic()
+        hit = self._cache.get(name)
+        if hit is not None and hit[0] > now:
+            return hit[1]
+        pc: Optional[api.PriorityClass] = None
+        if name:
+            try:
+                pc = self.priorityclasses.get(Context(), name)
+            except errors.StatusError as e:
+                if not errors.is_not_found(e):
+                    raise
+        else:
+            pc = next((c for c in
+                       self.priorityclasses.list(Context()).items
+                       if c.global_default), None)
+        if len(self._cache) >= 1024:
+            self._cache = {k: v for k, v in self._cache.items()
+                           if v[0] > now}
+            if len(self._cache) >= 1024:
+                self._cache.clear()
+        self._cache[name] = (now + self._PC_CACHE_TTL, pc)
+        return pc
+
+    def admit(self, attrs: Attributes) -> None:
+        if attrs.resource != "pods" or attrs.operation != CREATE \
+                or attrs.subresource:
+            return
+        if self.priorityclasses is None:
+            return
+        pod = attrs.obj
+        spec = pod.spec
+        pc = self._get_class(spec.priority_class_name)
+        if spec.priority_class_name and pc is None:
+            raise errors.new_invalid(
+                "Pod", pod.metadata.name,
+                [ValueError(f"spec.priorityClassName: no PriorityClass "
+                            f"named {spec.priority_class_name!r}")])
+        value = pc.value if pc is not None else api.DefaultPodPriority
+        if spec.priority is not None and spec.priority != value:
+            raise errors.new_invalid(
+                "Pod", pod.metadata.name,
+                [ValueError(f"spec.priority: {spec.priority} conflicts "
+                            f"with the resolved class value {value}")])
+        spec.priority = value
+        if pc is not None and not spec.preemption_policy:
+            spec.preemption_policy = pc.preemption_policy
 
 
 class LimitRanger(Interface):
@@ -290,3 +365,4 @@ register_plugin("NamespaceLifecycle", NamespaceLifecycle)
 register_plugin("ResourceDefaults", ResourceDefaults)
 register_plugin("LimitRanger", LimitRanger)
 register_plugin("ResourceQuota", ResourceQuota)
+register_plugin("PriorityDefault", PriorityDefault)

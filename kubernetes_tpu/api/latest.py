@@ -43,6 +43,7 @@ _ALL_KINDS = (
     api.Secret, api.SecretList,
     api.LimitRange, api.LimitRangeList,
     api.ResourceQuota, api.ResourceQuotaList,
+    api.PriorityClass, api.PriorityClassList,
     api.Status,
     api.DeleteOptions,
 )

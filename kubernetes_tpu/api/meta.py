@@ -105,5 +105,7 @@ def default_rest_mapper() -> RESTMapper:
           aliases=("limitrange", "limits"))
     m.add("resourcequotas", "ResourceQuota", api.ResourceQuota, True, api.ResourceQuotaList,
           aliases=("resourcequota", "quota"))
+    m.add("priorityclasses", "PriorityClass", api.PriorityClass, False,
+          api.PriorityClassList, aliases=("priorityclass", "pc"))
     m.add("bindings", "Binding", api.Binding, True, None)
     return m

@@ -110,6 +110,16 @@ ReasonTooManyRequests = "TooManyRequests"
 AffinityNone = "None"
 AffinityClientIP = "ClientIP"
 
+# PreemptionPolicy (PriorityClass / PodSpec): whether a pod of this
+# priority may claim a node by evicting strictly-lower-priority pods.
+PreemptLowerPriority = "PreemptLowerPriority"
+PreemptNever = "Never"
+
+# Priority values: user classes must stay below the system band, like the
+# upstream HighestUserDefinablePriority / system-cluster-critical split.
+HighestUserDefinablePriority = 1_000_000_000
+DefaultPodPriority = 0
+
 # Event source components
 DefaultSchedulerName = "scheduler"
 
@@ -370,6 +380,13 @@ class PodSpec:
     node_selector: Dict[str, str] = field(default_factory=dict)
     host: str = ""
     host_network: bool = False
+    # kube-preempt: the resolved integer priority (admission fills it from
+    # priority_class_name; None = unresolved, treated as 0) and the
+    # effective preemption policy ("" inherits the class's, defaulting to
+    # PreemptLowerPriority). The scheduler reads ONLY the resolved fields.
+    priority: Optional[int] = None
+    priority_class_name: str = ""
+    preemption_policy: str = ""
 
 
 @dataclass
@@ -600,6 +617,30 @@ class NamespaceList:
 
 
 # ---------------------------------------------------------------------------
+# PriorityClass (kube-preempt: the scheduling.k8s.io/v1 shape on the
+# era-appropriate surface — cluster-scoped, int32 value, optional
+# preemption policy, at most one globalDefault)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PriorityClass:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    value: int = 0
+    global_default: bool = False
+    description: str = ""
+    preemption_policy: str = PreemptLowerPriority
+    kind: str = "PriorityClass"
+
+
+@dataclass
+class PriorityClassList:
+    metadata: ListMeta = field(default_factory=ListMeta)
+    items: List[PriorityClass] = field(default_factory=list)
+    kind: str = "PriorityClassList"
+
+
+# ---------------------------------------------------------------------------
 # Binding (ref: types.go:1145-1155; write path pkg/registry/pod/etcd/etcd.go:98)
 # ---------------------------------------------------------------------------
 
@@ -609,6 +650,11 @@ class Binding:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     pod_name: str = ""
     host: str = ""
+    # kube-preempt: pods the server must evict (delete) atomically with
+    # this bind — either every victim is deleted AND the pod binds, or the
+    # item fails 409 and nothing is applied. Each ref names a pod in the
+    # binding's namespace; uid guards against name reuse.
+    victims: List[ObjectReference] = field(default_factory=list)
     kind: str = "Binding"
 
 
@@ -802,7 +848,22 @@ LIST_KINDS = {
     "SecretList": SecretList,
     "LimitRangeList": LimitRangeList,
     "ResourceQuotaList": ResourceQuotaList,
+    "PriorityClassList": PriorityClassList,
 }
+
+
+def pod_priority(pod: Pod) -> int:
+    """The scheduler-effective priority of a pod: the admission-resolved
+    spec.priority, 0 (DefaultPodPriority) when unresolved."""
+    p = pod.spec.priority
+    return DefaultPodPriority if p is None else int(p)
+
+
+def pod_can_preempt(pod: Pod) -> bool:
+    """Whether this pod may claim a node by evicting lower-priority pods:
+    the resolved spec.preemption_policy, defaulting to
+    PreemptLowerPriority exactly like the upstream API."""
+    return pod.spec.preemption_policy != PreemptNever
 
 
 def is_pod_active(pod: Pod) -> bool:

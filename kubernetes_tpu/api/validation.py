@@ -26,6 +26,7 @@ __all__ = [
     "validate_node",
     "validate_namespace",
     "validate_event",
+    "validate_priority_class",
     "accumulate_unique_host_ports",
     "is_dns1123_label",
     "is_dns1123_subdomain",
@@ -209,6 +210,20 @@ def validate_pod_spec(spec: api.PodSpec) -> List[ValidationError]:
     if spec.dns_policy not in (api.DNSClusterFirst, api.DNSDefault):
         errs.append(_unsupported("spec.dnsPolicy", spec.dns_policy))
     errs.extend(validate_labels(spec.node_selector, "spec.nodeSelector"))
+    if spec.priority_class_name and \
+            not is_dns1123_subdomain(spec.priority_class_name):
+        errs.append(_invalid("spec.priorityClassName",
+                             spec.priority_class_name,
+                             "must be a DNS subdomain"))
+    if spec.priority is not None and \
+            spec.priority > api.HighestUserDefinablePriority:
+        errs.append(_invalid("spec.priority", spec.priority,
+                             "must not exceed the highest user-definable "
+                             f"priority ({api.HighestUserDefinablePriority})"))
+    if spec.preemption_policy not in ("", api.PreemptLowerPriority,
+                                      api.PreemptNever):
+        errs.append(_unsupported("spec.preemptionPolicy",
+                                 spec.preemption_policy))
     return errs
 
 
@@ -298,6 +313,30 @@ def validate_namespace(ns: api.Namespace) -> List[ValidationError]:
         return [] if is_dns1123_label(name) else [_invalid(field, name, "must be a DNS label")]
 
     return validate_object_meta(ns.metadata, namespaced=False, name_fn=name_fn)
+
+
+def validate_priority_class(pc: api.PriorityClass) -> List[ValidationError]:
+    """kube-preempt: PriorityClass is cluster-scoped; value is a bounded
+    int32 (the upstream user-definable ceiling), the preemption policy an
+    enum. The at-most-one-globalDefault invariant is enforced by the
+    registry (it needs the stored set)."""
+    def name_fn(name, field):
+        return [] if is_dns1123_subdomain(name) else \
+            [_invalid(field, name, "must be a DNS subdomain")]
+
+    errs = validate_object_meta(pc.metadata, namespaced=False,
+                                name_fn=name_fn)
+    if not isinstance(pc.value, int) or isinstance(pc.value, bool):
+        errs.append(_invalid("value", pc.value, "must be an integer"))
+    elif not (-(1 << 31) <= pc.value <= api.HighestUserDefinablePriority):
+        errs.append(_invalid(
+            "value", pc.value,
+            "must be an int32 no greater than the highest user-definable "
+            f"priority ({api.HighestUserDefinablePriority})"))
+    if pc.preemption_policy not in (api.PreemptLowerPriority,
+                                    api.PreemptNever):
+        errs.append(_unsupported("preemptionPolicy", pc.preemption_policy))
+    return errs
 
 
 def validate_event(ev: api.Event) -> List[ValidationError]:

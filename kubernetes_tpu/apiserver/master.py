@@ -30,7 +30,7 @@ from kubernetes_tpu.storage.memstore import MemStore
 __all__ = ["Master", "MasterConfig"]
 
 DEFAULT_ADMISSION = ("NamespaceAutoProvision", "NamespaceLifecycle",
-                     "LimitRanger", "ResourceQuota")
+                     "LimitRanger", "ResourceQuota", "PriorityDefault")
 
 
 @dataclass
@@ -69,6 +69,7 @@ class Master:
         self.secrets = reg.make_secret_registry(self.helper)
         self.limitranges = reg.make_limitrange_registry(self.helper)
         self.resourcequotas = reg.make_resourcequota_registry(self.helper)
+        self.priorityclasses = reg.make_priorityclass_registry(self.helper)
 
         # sub/special resources
         self.bindings = reg.BindingREST(self.pods)
@@ -89,6 +90,7 @@ class Master:
             "secrets": self.secrets,
             "limitranges": self.limitranges,
             "resourcequotas": self.resourcequotas,
+            "priorityclasses": self.priorityclasses,
         }
         self.subresources: Dict[tuple, Any] = {
             ("pods", "binding"): self.bindings,
@@ -113,6 +115,7 @@ class Master:
             namespaces=self.namespaces,
             limitranges=self.limitranges,
             resourcequotas=self.resourcequotas,
+            priorityclasses=self.priorityclasses,
         )
 
         # bootstrap: the default namespace always exists (the reference
@@ -194,7 +197,24 @@ class Master:
             namespace=namespace, obj=bindings, user=user)
         self._authorize(user, attrs)
         self.admission.admit(attrs)
+        self._authorize_victims(user, namespace, bindings.items)
         return self.bindings.create_many(ctx, bindings, on_bound=on_bound)
+
+    def _authorize_victims(self, user, namespace: str, bindings) -> None:
+        """kube-preempt: an evict+bind item deletes pods, so EVERY
+        distinct victim namespace (the request's own included — binding
+        create rights are not pod delete rights) gets its own DELETE
+        authorization + admission pass. Shared by bind_batch and the
+        per-pod binding subresource, so neither form widens what the
+        plain delete verb allows."""
+        victim_ns = {v.namespace or namespace
+                     for b in bindings for v in getattr(b, "victims", ())}
+        for ns in sorted(victim_ns):
+            vattrs = admission_pkg.Attributes(
+                operation=admission_pkg.DELETE, resource="pods",
+                namespace=ns, user=user)
+            self._authorize(user, vattrs)
+            self.admission.admit(vattrs)
 
     def dispatch(self, verb: str, resource: str, *, namespace: str = "",
                  name: str = "", body: Any = None, subresource: str = "",
@@ -218,6 +238,12 @@ class Master:
                 attrs.operation = admission_pkg.CREATE
                 self._authorize(user, attrs)
                 self.admission.admit(attrs)
+                if canonical == "pods" and subresource == "binding":
+                    # a single evict+bind binding deletes pods too: same
+                    # per-victim-namespace DELETE authz as bind_batch
+                    items = list(getattr(body, "items", None) or [body])
+                    if any(getattr(b, "victims", None) for b in items):
+                        self._authorize_victims(user, namespace, items)
                 return sub.create(ctx, body)
             if verb == "update":
                 attrs.operation = admission_pkg.UPDATE

@@ -17,9 +17,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--address", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8080)
     p.add_argument("--portal-net", "--portal_net", default="10.0.0.0/24")
+    # default shared with apiserver.master.DEFAULT_ADMISSION — a plugin
+    # added to the in-process default (PriorityDefault was the incident:
+    # priorityClassName silently unresolved in the multi-process
+    # topology) must ship in the binary's default too
+    from kubernetes_tpu.apiserver.master import DEFAULT_ADMISSION
     p.add_argument("--admission-control", "--admission_control",
-                   default="NamespaceAutoProvision,NamespaceLifecycle,"
-                           "LimitRanger,ResourceQuota")
+                   default=",".join(DEFAULT_ADMISSION))
     p.add_argument("--token-auth-file", "--token_auth_file", default="")
     p.add_argument("--basic-auth-file", "--basic_auth_file", default="")
     p.add_argument("--authorization-policy-file",

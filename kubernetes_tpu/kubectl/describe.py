@@ -15,7 +15,8 @@ from kubernetes_tpu.kubectl.printers import HumanReadablePrinter, _join_labels
 
 __all__ = ["describe", "PodDescriber", "ReplicationControllerDescriber",
            "ServiceDescriber", "NodeDescriber", "NamespaceDescriber",
-           "SecretDescriber", "LimitRangeDescriber", "ResourceQuotaDescriber"]
+           "SecretDescriber", "LimitRangeDescriber", "ResourceQuotaDescriber",
+           "PriorityClassDescriber"]
 
 
 def _events_for(client, obj, namespace: str) -> Optional[api.EventList]:
@@ -46,6 +47,12 @@ class PodDescriber:
         out.write(f"Image(s):\t{', '.join(c.image for c in pod.spec.containers)}\n")
         out.write(f"Host:\t{pod.spec.host or pod.status.host or '<unscheduled>'}\n")
         out.write(f"Labels:\t{_join_labels(pod.metadata.labels)}\n")
+        prio = pod.spec.priority
+        if prio is not None or pod.spec.priority_class_name:
+            out.write(f"Priority:\t{0 if prio is None else prio}\n")
+            if pod.spec.priority_class_name:
+                out.write(f"Priority Class Name:\t"
+                          f"{pod.spec.priority_class_name}\n")
         out.write(f"Status:\t{pod.status.phase or 'Pending'}\n")
         if pod.status.pod_ip:
             out.write(f"IP:\t{pod.status.pod_ip}\n")
@@ -157,6 +164,21 @@ class NamespaceDescriber:
         return out.getvalue()
 
 
+class PriorityClassDescriber:
+    def describe(self, client, namespace: str, name: str) -> str:
+        pc = client.resource("priorityclasses", "").get(name)
+        out = io.StringIO()
+        out.write(f"Name:\t{pc.metadata.name}\n")
+        out.write(f"Value:\t{pc.value}\n")
+        out.write(f"GlobalDefault:\t{pc.global_default}\n")
+        out.write(f"PreemptionPolicy:\t"
+                  f"{pc.preemption_policy or api.PreemptLowerPriority}\n")
+        if pc.description:
+            out.write(f"Description:\t{pc.description}\n")
+        _write_events(out, _events_for(client, pc, ""))
+        return out.getvalue()
+
+
 class SecretDescriber:
     def describe(self, client, namespace: str, name: str) -> str:
         s = client.resource("secrets", namespace).get(name)
@@ -204,6 +226,7 @@ _DESCRIBERS = {
     "secrets": SecretDescriber,
     "limitranges": LimitRangeDescriber,
     "resourcequotas": ResourceQuotaDescriber,
+    "priorityclasses": PriorityClassDescriber,
 }
 
 

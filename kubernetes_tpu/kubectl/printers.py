@@ -129,6 +129,13 @@ def _status_rows(st: api.Status):
     return [[st.status]]
 
 
+def _priorityclass_rows(pc: api.PriorityClass):
+    return [[pc.metadata.name, str(pc.value),
+             "true" if pc.global_default else "false",
+             pc.preemption_policy or api.PreemptLowerPriority,
+             _age(pc.metadata)]]
+
+
 _HANDLERS: Dict[str, tuple] = {
     # kind -> (columns, row fn)   columns ref: resource_printer.go:231-240
     "Pod": (["POD", "IP", "CONTAINER(S)", "IMAGE(S)", "HOST", "LABELS",
@@ -144,6 +151,8 @@ _HANDLERS: Dict[str, tuple] = {
     "Secret": (["NAME", "TYPE", "DATA"], _secret_rows),
     "LimitRange": (["NAME"], _limitrange_rows),
     "ResourceQuota": (["NAME"], _quota_rows),
+    "PriorityClass": (["NAME", "VALUE", "GLOBAL-DEFAULT",
+                       "PREEMPTIONPOLICY", "AGE"], _priorityclass_rows),
     "Status": (["STATUS"], _status_rows),
 }
 

@@ -43,6 +43,7 @@ RESOURCE_ALIASES = {
     "secret": "secrets",
     "limit": "limitranges", "limitrange": "limitranges", "limits": "limitranges",
     "quota": "resourcequotas", "resourcequota": "resourcequotas",
+    "pc": "priorityclasses", "priorityclass": "priorityclasses",
 }
 
 

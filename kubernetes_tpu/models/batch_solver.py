@@ -89,6 +89,14 @@ __all__ = ["solve", "solve_jit", "solve_device", "SolverInputs",
 
 NEG = -1  # masked score sentinel (scores are always >= 0)
 
+# kube-preempt score-channel constants (models/preempt.py owns the host
+# side): a preempting placement's score is _PSCORE_BASE - band_slot, and
+# the preemption node selection maximizes _PREEMPT_BIG - victim_count
+# (so the minimum-victim-cost node wins under the same masked_top_count
+# machinery; victim counts are bounded far below _PREEMPT_BIG).
+_PSCORE_BASE = -2
+_PREEMPT_BIG = 1 << 30
+
 _I32_HEADROOM = (2**31 - 1) // 10  # calculate_score multiplies by 10
 
 # KTPU_DEBUG=1: recompute encoder-resident zone_counts0 planes from the
@@ -152,6 +160,14 @@ class SolverInputs(NamedTuple):
     has_anchor0: jnp.ndarray     # [G] bool
     zone_idx: jnp.ndarray        # [A, N] i32 zone codes, -1 unlabeled
     zone_counts0: jnp.ndarray    # [A, G, V] i32 initial per-group peers/zone
+    # kube-preempt planes (models/preempt.py). B == 0 compiles the exact
+    # pre-preemption program; B > 0 adds the evictable-capacity planes to
+    # the scan carry and the minimum-victim-cost preemption sub-program.
+    pod_prio: jnp.ndarray        # [P] i32 resolved pod priorities
+    pod_can_preempt: jnp.ndarray  # [P] bool — PreemptionPolicy != Never
+    band_prio: jnp.ndarray       # [B] i32 band values (BAND_EMPTY padded)
+    evict_cap: jnp.ndarray       # [N, B, R] evictable capacity (res dtype)
+    evict_cnt: jnp.ndarray       # [N, B] i32 evictable pod counts
 
 
 def _pack_bits(a: np.ndarray) -> np.ndarray:
@@ -169,9 +185,13 @@ def _resource_scales(snap: ClusterSnapshot) -> np.ndarray:
     """Per-dimension gcd of every value in that resource column — dividing a
     whole column by a common factor is exact for each comparison and floor
     division the solver performs. (Memory reduces by Mi granularity; cpu
-    milli-values usually by 100.)"""
-    cols = np.concatenate([snap.cap, snap.fit_used, snap.score_used,
-                           snap.req], axis=0)              # [*, R]
+    milli-values usually by 100.) The per-band evictable sums participate:
+    a band subtotal must divide exactly too, and a node TOTAL's gcd can be
+    coarser than its per-band parts'."""
+    parts = [snap.cap, snap.fit_used, snap.score_used, snap.req]
+    if snap.evict_cap is not None and snap.evict_cap.size:
+        parts.append(snap.evict_cap.reshape(-1, snap.evict_cap.shape[2]))
+    cols = np.concatenate(parts, axis=0)                   # [*, R]
     R = cols.shape[1]
     scales = np.ones(R, np.int64)
     for r in range(R):
@@ -207,12 +227,20 @@ def snapshot_to_host_inputs(snap: ClusterSnapshot) -> SolverInputs:
     fit_used = snap.fit_used // g
     score_used = snap.score_used // g
     req = snap.req // g
+    N0 = snap.n_nodes
+    R0 = snap.cap.shape[1]
+    evict_cap = (snap.evict_cap if snap.evict_cap is not None
+                 else np.zeros((N0, 0, R0), np.int64)) // g[None, :, :]
+    evict_cnt = (snap.evict_cnt if snap.evict_cnt is not None
+                 else np.zeros((N0, 0), np.int32))
+    band_prio = (snap.band_prio if snap.band_prio is not None
+                 else np.zeros(0, np.int32))
 
     # int32 is safe when no running sum can reach 2^31/10: the largest
     # initial value plus the whole batch's requests bounds every accumulator
     req_total = req.sum(axis=0, keepdims=True)             # [1, R]
     use_i32 = _fits_i32(cap, fit_used, score_used + req_total,
-                        cap + req_total)
+                        cap + req_total, evict_cap)
     rdt = np.int32 if use_i32 else np.int64
 
     N = snap.n_nodes
@@ -276,6 +304,15 @@ def snapshot_to_host_inputs(snap: ClusterSnapshot) -> SolverInputs:
         has_anchor0=np.asarray(has_anchor0, bool),
         zone_idx=node_zone.astype(np.int32),
         zone_counts0=np.ascontiguousarray(zone_counts0, np.int32),
+        pod_prio=np.ascontiguousarray(
+            snap.pod_prio if snap.pod_prio is not None
+            else np.zeros(P, np.int32), np.int32),
+        pod_can_preempt=np.asarray(
+            snap.pod_can_preempt if snap.pod_can_preempt is not None
+            else np.ones(P, bool), bool),
+        band_prio=np.ascontiguousarray(band_prio, np.int32),
+        evict_cap=np.ascontiguousarray(evict_cap.astype(rdt)),
+        evict_cnt=np.ascontiguousarray(evict_cnt, np.int32),
     )
     return host
 
@@ -433,8 +470,15 @@ def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
         anchor_vals: jnp.ndarray     # [G, L] i32
         has_anchor: jnp.ndarray      # [G] bool
         zone_counts: jnp.ndarray     # [A, G, V] i32 peers per zone
+        evict_cap: jnp.ndarray       # [N, B, R] evictable capacity
+        evict_cnt: jnp.ndarray       # [N, B] i32 evictable pod counts
 
     V = inp.zone_counts0.shape[2]
+    B = inp.band_prio.shape[0]
+    # kube-preempt sub-program: compiled only when the encoder's emit gate
+    # shipped bands (models/preempt.py) — a B == 0 wave runs the exact
+    # legacy program, zero-size carry planes included
+    enable_p = B > 0 and pol.use_resources
     if pol.anti_affinity:
         # scan-invariant zone scatter basis, derived on device once per
         # wave (XLA hoists it out of the scan): the wire/encoder ship only
@@ -444,28 +488,17 @@ def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
                        ).astype(jnp.float32)                 # [A, N, V]
     init = Carry(inp.fit_used, inp.score_used,
                  inp.node_ports, inp.node_pds, inp.group_counts,
-                 inp.anchor_vals0, inp.has_anchor0, inp.zone_counts0)
+                 inp.anchor_vals0, inp.has_anchor0, inp.zone_counts0,
+                 inp.evict_cap, inp.evict_cnt)
 
     def step(carry: Carry, xs, blocked=None):
         (static_row, req, pod_ports, pod_pds,
-         tie_hi, tie_lo, gid, member, aff_static) = xs
+         tie_hi, tie_lo, gid, member, aff_static, prio, can_p) = xs
 
         feasible = static_row
         if blocked is not None:
             # remaining members of an already-failed gang place nowhere
             feasible = feasible & ~blocked
-        if pol.use_resources:
-            # Filter: resources over all R dims (predicates.go:127-152 —
-            # a pod requesting zero of everything always fits; pre-exceeded
-            # nodes fail; per-dim rule per ``unconstrained`` above)
-            res_ok = jnp.all(unconstrained |
-                             (inp.cap - carry.fit_used >= req[None, :]),
-                             axis=1)
-            zero_req = jnp.all(req == 0)
-            # fit_exceeded is static: committed pending pods always fit, so
-            # they never flip a node into the pre-exceeded state.
-            feasible = feasible & \
-                (zero_req | (~inp.fit_exceeded & res_ok))
         if pol.use_ports:
             # Filter: host ports (predicates.go:326-338) — packed-word AND
             feasible = feasible & \
@@ -485,6 +518,23 @@ def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
                 need = (aff_static[l] == -2) & (row[l] >= 0)
                 dyn = dyn & (~need | (inp.node_aff_vals[:, l] == row[l]))
             feasible = feasible & (~has | dyn)
+        # everything except resources — the preemption branch re-checks
+        # resource fit with freed capacity against exactly this base
+        # (victims conservatively keep their ports/PDs/group membership
+        # for the rest of the wave, so only the resource term may relax)
+        feasible_nores = feasible
+        if pol.use_resources:
+            # Filter: resources over all R dims (predicates.go:127-152 —
+            # a pod requesting zero of everything always fits; pre-exceeded
+            # nodes fail; per-dim rule per ``unconstrained`` above)
+            res_ok = jnp.all(unconstrained |
+                             (inp.cap - carry.fit_used >= req[None, :]),
+                             axis=1)
+            zero_req = jnp.all(req == 0)
+            # fit_exceeded is static: committed pending pods always fit, so
+            # they never flip a node into the pre-exceeded state.
+            feasible = feasible & \
+                (zero_req | (~inp.fit_exceeded & res_ok))
 
         counts_row = carry.counts[jnp.maximum(gid, 0)]         # [N+1]
         score = jnp.zeros(N, jnp.int32)
@@ -550,6 +600,67 @@ def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
         k = _u64_mod(tie_hi, tie_lo, cnt)
         chosen = select_kth_true(best, k)
         chosen = jnp.where(any_feasible, chosen, jnp.int32(-1))
+        win_score = jnp.where(any_feasible, top, jnp.int32(NEG))
+
+        if enable_p:
+            # ---- preemption (kube-preempt; models/preempt.py rule) -------
+            # Considered only when NO node is normally feasible and the
+            # pod may preempt. Candidate victim sets are priority-prefix
+            # sets per node: threshold t over bands strictly below the
+            # pod's priority; freed(t) is monotone, so the minimal
+            # fitting t is the lowest-sufficient set. Across nodes the
+            # minimal victim COUNT wins, normal FNV tie-break among ties.
+            below = inp.band_prio < prio                          # [B]
+            # leq[b, c]: band b evicts under threshold band c
+            leq = (inp.band_prio[:, None] <= inp.band_prio[None, :]) \
+                & below[:, None]                                  # [B, B]
+            # dtype pins: jnp.sum would promote i32 to i64 under x64
+            freed = jnp.sum(carry.evict_cap[:, :, None, :]
+                            * leq.astype(rdt)[None, :, :, None],
+                            axis=1, dtype=rdt)                    # [N, B, R]
+            ccost = jnp.sum(carry.evict_cnt[:, :, None]
+                            * leq.astype(jnp.int32)[None, :, :],
+                            axis=1, dtype=jnp.int32)              # [N, B]
+            head = (inp.cap - carry.fit_used)[:, None, :] + freed
+            fits = jnp.all(unconstrained[:, None, :] |
+                           (head >= req[None, None, :]), axis=2)  # [N, B]
+            fits = fits & below[None, :] & feasible_nores[:, None] \
+                & (~inp.fit_exceeded)[:, None]
+            node_fits = fits.any(axis=1)
+            # minimal sufficient threshold per node (band values are
+            # distinct by vocabulary construction; BAND_EMPTY slots never
+            # fit because ``below`` is False there)
+            bidx = jnp.argmin(jnp.where(fits, inp.band_prio[None, :],
+                                        jnp.int32(2**31 - 1)),
+                              axis=1).astype(jnp.int32)           # [N]
+            cost = jnp.take_along_axis(
+                ccost, bidx[:, None], axis=1)[:, 0]               # [N]
+            pmask = node_fits & can_p
+            masked_p = jnp.where(pmask, jnp.int32(_PREEMPT_BIG) - cost,
+                                 jnp.int32(NEG))
+            _ptop, p_any, pbest, pcnt = masked_top_count(masked_p, NEG)
+            pbest = pbest & pmask
+            pchosen = select_kth_true(pbest, _u64_mod(tie_hi, tie_lo,
+                                                      pcnt))
+            pchosen = jnp.where(p_any, pchosen, jnp.int32(-1))
+            did_preempt = ~any_feasible & (pchosen >= 0)
+            chosen = jnp.where(any_feasible, chosen, pchosen)
+            safe_c = jnp.maximum(chosen, 0)
+            bsel = bidx[safe_c]
+            # the score channel reports the threshold band slot
+            # (models/preempt.preempt_score) so the host-side victim
+            # replay can expand the decision without extra outputs
+            win_score = jnp.where(
+                any_feasible, win_score,
+                jnp.where(did_preempt,
+                          jnp.int32(_PSCORE_BASE) - bsel, jnp.int32(NEG)))
+            evicted = leq[:, bsel] & did_preempt                  # [B]
+            freed_sel = jnp.where(did_preempt, freed[safe_c, bsel],
+                                  jnp.zeros_like(freed[0, 0]))    # [R]
+        else:
+            did_preempt = jnp.bool_(False)
+            evicted = jnp.zeros((B,), bool)
+            freed_sel = jnp.zeros((R,), rdt)
 
         # commit: one-hot update of every accumulator at the chosen node
         onehot = (arange_n == chosen)                # [N] (all-False if -1)
@@ -575,9 +686,14 @@ def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
                  == zv[:, None, None])).astype(jnp.int32)
         else:
             zone_counts = carry.zone_counts
+        # preemption eviction lands with the commit: the chosen node's
+        # evicted-band capacity leaves both accumulators and the evictable
+        # planes zero out there — later pods see the post-eviction cluster
+        delta = onehot[:, None] * (req[None, :] - freed_sel[None, :])
+        emask = (onehot[:, None] & evicted[None, :])          # [N, B]
         carry = Carry(
-            fit_used=carry.fit_used + onehot[:, None] * req[None, :],
-            score_used=carry.score_used + onehot[:, None] * req[None, :],
+            fit_used=carry.fit_used + delta,
+            score_used=carry.score_used + delta,
             ports=carry.ports | jnp.where(onehot[:, None], pod_ports[None, :],
                                           jnp.uint32(0)),
             pds=carry.pds | jnp.where(onehot[:, None], pod_pds[None, :],
@@ -587,13 +703,15 @@ def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
             anchor_vals=anchor_vals,
             has_anchor=has_anchor,
             zone_counts=zone_counts,
+            evict_cap=jnp.where(emask[:, :, None],
+                                jnp.zeros((), rdt), carry.evict_cap),
+            evict_cnt=jnp.where(emask, jnp.int32(0), carry.evict_cnt),
         )
-        win_score = jnp.where(any_feasible, top, jnp.int32(NEG))
         return carry, (chosen, win_score)
 
     xs = (static_mask, inp.req, inp.pod_ports, inp.pod_pds,
           inp.tie_hi, inp.tie_lo, inp.pod_gid, inp.pod_group_member,
-          inp.pod_aff_static)
+          inp.pod_aff_static, inp.pod_prio, inp.pod_can_preempt)
     if not gangs:
         _, (chosen, scores) = jax.lax.scan(step, init, xs, unroll=unroll)
         return chosen, scores

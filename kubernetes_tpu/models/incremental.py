@@ -44,6 +44,7 @@ tests/test_incremental.py.
 
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -62,21 +63,32 @@ from kubernetes_tpu.scheduler.generic import pod_tie_break_key
 
 __all__ = ["IncrementalEncoder"]
 
+# KTPU_DEBUG=1: re-derive the resident evictable planes from the cached
+# pod records every emitted wave and assert equality with the O(bands)
+# incrementally-maintained ones (models/preempt.derive_evict_planes is
+# the authoritative from-scratch twin)
+_DEBUG_VERIFY_EVICT = os.environ.get("KTPU_DEBUG", "") not in ("", "0")
+
 
 class _PodRec:
     """Cached contribution of one existing pod to the resident planes."""
 
-    __slots__ = ("host_idx", "req", "ports", "pds", "ns_code", "svc_mask")
+    __slots__ = ("host_idx", "req", "ports", "pds", "ns_code", "svc_mask",
+                 "prio", "name", "ns")
 
     def __init__(self, host_idx: int, req: List[Tuple[int, int]],
                  ports: List[int], pds: List[int], ns_code: int,
-                 svc_mask: np.ndarray):
+                 svc_mask: np.ndarray, prio: int = 0, name: str = "",
+                 ns: str = ""):
         self.host_idx = host_idx   # node row, or N-sentinel for off-list
         self.req = req             # [(resource column, amount)]
         self.ports = ports         # port vocab columns (with multiplicity)
         self.pds = pds             # pd vocab columns
         self.ns_code = ns_code
         self.svc_mask = svc_mask   # [S] bool — selector-subset match per svc
+        self.prio = prio           # resolved pod priority (kube-preempt)
+        self.name = name           # pod name (victim materialization)
+        self.ns = ns               # pod namespace
 
 
 class _Vocab:
@@ -114,15 +126,24 @@ class IncrementalEncoder:
         self._sels = _Vocab()
         self._pds = _Vocab()
         self._ns = _Vocab()
+        # kube-preempt: sticky priority-band vocabulary (value -> slot) +
+        # the monotone minimum over every value ever interned; bands emit
+        # (self._preempt_emitted, sticky for shape stability) once any
+        # pending pod sits strictly above the floor
+        self._bands = _Vocab()
+        self._band_min: Optional[int] = None
+        self._preempt_emitted = False
         self._resource_names: List[str] = []
         # resident planes (allocated by _rebuild_nodes)
         self._N = 0
         # O(changed) accounting, consumed by the tier-1 complexity guards
         # (tests/test_incremental.py): zone_writes counts single-element
         # zone-plane updates, group_writes the group-count ones;
+        # evict_writes the per-band evictable-plane updates;
         # node_rebuilds the full resident-plane rebuilds
         self.op_counts: Dict[str, int] = {
-            "zone_writes": 0, "group_writes": 0, "node_rebuilds": 0}
+            "zone_writes": 0, "group_writes": 0, "node_rebuilds": 0,
+            "evict_writes": 0}
 
     # -- node side ----------------------------------------------------------
     @staticmethod
@@ -216,6 +237,13 @@ class IncrementalEncoder:
         self._grp_rows: Dict[Tuple[int, int], int] = {}
         self._grp_cnt = np.zeros((8, N + 1), np.int32)
         self._zone_cnt = np.zeros((A, 8, self._zone_V), np.int32)
+        # kube-preempt resident planes: [N, B, R] evictable capacity +
+        # [N, B] counts over the sticky band vocabulary, plus the
+        # per-node pod registry victim materialization reads
+        Bc = self._bands.cap if len(self._bands) else 0
+        self._evict_cap = np.zeros((N, Bc, R), np.int64)
+        self._evict_cnt = np.zeros((N, Bc), np.int32)
+        self._node_pods: Dict[int, Dict[str, _PodRec]] = {}
         self.op_counts["node_rebuilds"] += 1
         self._pods.clear()
         self._set_services(services)
@@ -322,7 +350,23 @@ class IncrementalEncoder:
             self._cap = np.pad(self._cap, ((0, 0), (0, 1)))
             self._advertised = np.pad(self._advertised, ((0, 0), (0, 1)))
             self._score_used = np.pad(self._score_used, ((0, 0), (0, 1)))
+            self._evict_cap = np.pad(self._evict_cap,
+                                     ((0, 0), (0, 0), (0, 1)))
         return r
+
+    def _band_col(self, prio: int) -> int:
+        """Sticky band slot for a priority value, growing the resident
+        evictable planes' band axis on first sight."""
+        b = self._bands.intern(prio)
+        if self._band_min is None or prio < self._band_min:
+            self._band_min = prio
+        cap = self._bands.cap
+        if self._evict_cnt.shape[1] < cap:
+            self._evict_cap = np.pad(
+                self._evict_cap,
+                ((0, 0), (0, cap - self._evict_cap.shape[1]), (0, 0)))
+            self._evict_cnt = self._grow_cols(self._evict_cnt, cap)
+        return b
 
     def _port_col(self, port: int) -> int:
         col = self._ports.intern(port)
@@ -368,7 +412,9 @@ class IncrementalEncoder:
                         v.source.gce_persistent_disk.pd_name))
         ns_code = self._ns.intern(pod.metadata.namespace)
         svc_mask = self._svc_subset_mask(pod)
-        rec = _PodRec(i, req, ports, pds, ns_code, svc_mask)
+        rec = _PodRec(i, req, ports, pds, ns_code, svc_mask,
+                      prio=api.pod_priority(pod), name=pod.metadata.name,
+                      ns=pod.metadata.namespace)
         self._pods[uid] = rec
         if i < self._N:
             for r, amt in req:
@@ -377,6 +423,13 @@ class IncrementalEncoder:
                 self._port_cnt[i, col] += 1
             for col in pds:
                 self._pd_cnt[i, col] += 1
+            # kube-preempt: O(1) single-element evictable-plane updates
+            b = self._band_col(rec.prio)
+            for r, amt in req:
+                self._evict_cap[i, b, r] += amt
+            self._evict_cnt[i, b] += 1
+            self.op_counts["evict_writes"] += 1
+            self._node_pods.setdefault(i, {})[uid] = rec
         if svc_mask.any():
             for (g_ns, si), row in self._grp_rows.items():
                 if g_ns == ns_code and svc_mask[si]:
@@ -394,12 +447,29 @@ class IncrementalEncoder:
                 self._port_cnt[i, col] -= 1
             for col in rec.pds:
                 self._pd_cnt[i, col] -= 1
+            b = self._band_col(rec.prio)
+            for r, amt in rec.req:
+                self._evict_cap[i, b, r] -= amt
+            self._evict_cnt[i, b] -= 1
+            self.op_counts["evict_writes"] += 1
+            node = self._node_pods.get(i)
+            if node is not None:
+                node.pop(uid, None)
         if rec.svc_mask.any():
             for (g_ns, si), row in self._grp_rows.items():
                 if g_ns == rec.ns_code and rec.svc_mask[si]:
                     self._grp_cnt[row, i] -= 1
                     self.op_counts["group_writes"] += 1
                     self._zone_delta(row, i, -1)
+
+    # -- kube-preempt victim materialization --------------------------------
+    def resident_on(self, node_idx: int):
+        """ResidentPod rows for one node — the per-node registry feed for
+        models/preempt.assign_victims (O(pods on the node), not
+        O(cluster))."""
+        from kubernetes_tpu.models.preempt import ResidentPod
+        return [ResidentPod(uid, rec.name, rec.ns, rec.host_idx, rec.prio)
+                for uid, rec in self._node_pods.get(node_idx, {}).items()]
 
     # -- speculation support (scheduler/tpu_batch.py pipelined mode) --------
     def has_pod(self, uid: str) -> bool:
@@ -512,6 +582,8 @@ class IncrementalEncoder:
         pg_ij: List[Tuple[int, int]] = []
         pod_host_idx = np.full(Ppad, -2, np.int32)
         pod_host_idx[:P] = -1
+        pod_prio = np.zeros(Ppad, np.int32)
+        pod_can_preempt = np.zeros(Ppad, bool)  # padding rows never preempt
         pod_names: List[str] = []
         pod_ns = np.zeros(P, np.int32)
         feats: List[Tuple[int, int]] = []  # (pod, svc-vocab col)
@@ -544,6 +616,8 @@ class IncrementalEncoder:
                         v.source.gce_persistent_disk.pd_name)))
             if p.spec.host:
                 pod_host_idx[j] = self._node_index.get(p.spec.host, -2)
+            pod_prio[j] = api.pod_priority(p)
+            pod_can_preempt[j] = api.pod_can_preempt(p)
         R = len(self._resource_names)
         if R > R0:
             req = np.pad(req, ((0, 0), (0, R - R0)))
@@ -633,6 +707,43 @@ class IncrementalEncoder:
         pod_run_start = np.ones(Ppad, bool)
         pod_run_start[:P] = run_start
 
+        # -- kube-preempt planes (sticky emit gate) -------------------------
+        if not self._preempt_emitted and len(self._bands) and P \
+                and int(pod_prio[:P].max()) > self._band_min:
+            self._preempt_emitted = True
+        if self._preempt_emitted:
+            from kubernetes_tpu.models import preempt as _preempt
+            Bc = self._bands.cap
+            band_prio = np.full(Bc, _preempt.BAND_EMPTY, np.int32)
+            for v, b in self._bands.index.items():
+                band_prio[b] = v
+            evict_cap = self._evict_cap[:, :Bc, :R].copy()
+            evict_cnt = self._evict_cnt[:, :Bc].copy()
+            if evict_cap.shape[2] < R:
+                evict_cap = np.pad(
+                    evict_cap, ((0, 0), (0, 0),
+                                (0, R - evict_cap.shape[2])))
+            if _DEBUG_VERIFY_EVICT:
+                e_host = np.array([rec.host_idx
+                                   for rec in self._pods.values()])
+                e_prio = np.array([rec.prio
+                                   for rec in self._pods.values()])
+                e_req = np.zeros((len(self._pods), R), np.int64)
+                for k, rec in enumerate(self._pods.values()):
+                    for r, amt in rec.req:
+                        e_req[k, r] += amt
+                want_cap, want_cnt = _preempt.derive_evict_planes(
+                    e_host, e_prio, e_req, band_prio, N)
+                assert np.array_equal(want_cap, evict_cap) and \
+                    np.array_equal(want_cnt, evict_cnt), (
+                        "resident evictable planes diverged from the "
+                        "derive_evict_planes from-scratch twin — the "
+                        "O(bands) incremental maintenance is out of sync")
+        else:
+            band_prio = np.zeros(0, np.int32)
+            evict_cap = np.zeros((N, 0, R), np.int64)
+            evict_cnt = np.zeros((N, 0), np.int32)
+
         return ClusterSnapshot(
             node_names=self._node_names,
             resource_names=list(self._resource_names),
@@ -653,6 +764,8 @@ class IncrementalEncoder:
             score_static=self._score_static,
             node_zone=self._node_zone,
             zone_counts0=self._zone_cnt.copy(),
+            pod_prio=pod_prio, pod_can_preempt=pod_can_preempt,
+            band_prio=band_prio, evict_cap=evict_cap, evict_cnt=evict_cnt,
             policy=self.policy,
             w_least_requested=self.policy.w_lr,
             w_spreading=self.policy.w_spread,

@@ -12,12 +12,19 @@ it on every benchmark run.
 from __future__ import annotations
 
 import copy
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.models import gang as gang_mod
+from kubernetes_tpu.models.preempt import Victim
 from kubernetes_tpu.scheduler import plugins as schedplugins
-from kubernetes_tpu.scheduler.generic import FitError, GenericScheduler
+from kubernetes_tpu.scheduler import predicates as _preds
+from kubernetes_tpu.scheduler.generic import (
+    FitError,
+    GenericScheduler,
+    fnv1a64,
+    pod_tie_break_key,
+)
 from kubernetes_tpu.scheduler.listers import (
     FakeMinionLister,
     FakeNodeInfo,
@@ -25,7 +32,7 @@ from kubernetes_tpu.scheduler.listers import (
     FakeServiceLister,
 )
 
-__all__ = ["solve_serial"]
+__all__ = ["solve_serial", "preempt_serial"]
 
 
 def solve_serial(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
@@ -100,3 +107,213 @@ def solve_serial(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
                 decisions[k] = None
         j = run[-1] + 1
     return decisions
+
+
+# ---------------------------------------------------------------------------
+# kube-preempt serial oracle
+# ---------------------------------------------------------------------------
+
+def _req_vec(pod: api.Pod) -> Dict[str, int]:
+    """Summed container limits per resource name (the same accounting the
+    encoder's request planes use — limits double as requests in this era)."""
+    out: Dict[str, int] = {}
+    for c in pod.spec.containers:
+        for name, q in c.resources.limits.items():
+            out[name] = out.get(name, 0) + _preds.resource_value(name, q)
+    return out
+
+
+def _node_exceeded(cap: Dict[str, int], pods: Sequence[api.Pod]) -> bool:
+    """The greedy order-exact pre-exceeded rule (snapshot
+    .greedy_fit_accumulators semantics): walking the node's pods in list
+    order, did any pod fail to fit? Preemption never targets such nodes —
+    their accumulators are not plain sums."""
+    used: Dict[str, int] = {}
+    for p in pods:
+        req = _req_vec(p)
+        ok = all(_preds.dim_fits(name, cap.get(name, 0),
+                                 cap.get(name, 0) - used.get(name, 0), amt)
+                 for name, amt in req.items())
+        if not ok:
+            return True
+        for name, amt in req.items():
+            used[name] = used.get(name, 0) + amt
+    return False
+
+
+def preempt_serial(nodes: Sequence[api.Node],
+                   existing_pods: Sequence[api.Pod],
+                   pending_pods: Sequence[api.Pod],
+                   services: Sequence[api.Service] = (),
+                   provider: str = schedplugins.DEFAULT_PROVIDER,
+                   policy: Optional[schedplugins.Policy] = None
+                   ) -> Tuple[List[Optional[str]],
+                              List[Optional[List[Victim]]]]:
+    """Serial reference for priority preemption: the lowest-sufficient-
+    victim-set rule of models/preempt.py run pod by pod over the object
+    graph. Returns ``(decisions, victims)`` — ``victims[j]`` is None when
+    pod j placed normally (or not at all), else the evicted pods sorted by
+    (priority, uid). The batched path (solve + preempt.assign_victims over
+    the same wave) must match BOTH lists bit-for-bit; tests/test_preempt.py
+    and the ``priority`` bench config gate it.
+
+    Per pod, in wave order:
+
+    1. normal placement through the unmodified GenericScheduler — identical
+       to solve_serial (preemption never perturbs a schedulable wave);
+    2. on FitError, if the pod's preemptionPolicy allows: per node, over
+       thresholds t drawn from the remaining evictable pods' priorities
+       strictly below the pod's, the minimal t whose prefix set
+       {priority <= t} frees enough capacity (same per-dim rule as the
+       resource predicate; victims' ports/PDs/service membership are
+       conservatively retained — only resources free up); across nodes the
+       minimal victim count wins, FNV tie-break in node-list order;
+    3. the whole chosen prefix evicts: victims leave the evictable pool
+       and their resources leave the accounting, but ghost entries keep
+       their ports/PDs/labels visible to every later pod's predicates —
+       exactly the batched scan's conservative-retention carry.
+    """
+    node_list = api.NodeList(items=list(nodes))
+    node_order = [n.metadata.name for n in nodes]
+    caps = {n.metadata.name: _preds.capacity_values(n.spec.capacity)
+            for n in nodes}
+    committed: List[api.Pod] = list(existing_pods)
+    pod_lister = FakePodLister(committed)
+    args = schedplugins.PluginFactoryArgs(
+        pod_lister=pod_lister,
+        service_lister=FakeServiceLister(list(services)),
+        node_lister=FakeMinionLister(node_list),
+        node_info=FakeNodeInfo(node_list))
+    if policy is not None:
+        predicates = schedplugins.predicates_from_policy(policy, args)
+        priorities = schedplugins.priorities_from_policy(policy, args)
+    else:
+        keys = schedplugins.get_algorithm_provider(provider)
+        predicates = schedplugins.get_predicates(keys["predicates"], args)
+        priorities = schedplugins.get_priorities(keys["priorities"], args)
+    scheduler = GenericScheduler(predicates, priorities, pod_lister)
+    minion_lister = FakeMinionLister(node_list)
+    nores_predicates = {name: fn for name, fn in predicates.items()
+                        if name != "PodFitsResources"}
+
+    # static pre-exceeded set + the evictable pool (wave-start residents;
+    # within-wave placements are never added, so they can never be victims)
+    by_host: Dict[str, List[api.Pod]] = {}
+    for p in existing_pods:
+        if p.status.host in caps:
+            by_host.setdefault(p.status.host, []).append(p)
+    exceeded = {name: _node_exceeded(caps[name], by_host.get(name, ()))
+                for name in node_order}
+    evictable: Dict[str, List[api.Pod]] = {
+        name: list(by_host.get(name, ())) for name in node_order}
+
+    # maintained per-host usage (same values a committed-list scan would
+    # produce; kept incrementally so the per-candidate-node check is O(1))
+    used_by_host: Dict[str, Dict[str, int]] = {name: {}
+                                               for name in node_order}
+
+    def account(host: str, req: Dict[str, int], sign: int) -> None:
+        used = used_by_host[host]
+        for name, amt in req.items():
+            used[name] = used.get(name, 0) + sign * amt
+
+    for p in existing_pods:
+        if p.status.host in caps:
+            account(p.status.host, _req_vec(p), +1)
+
+    def commit(pod: api.Pod, host: str) -> None:
+        bound = copy.deepcopy(pod)
+        bound.spec.host = host
+        bound.status.host = host
+        committed.append(bound)
+        account(host, _req_vec(bound), +1)
+
+    def try_preempt(pod: api.Pod):
+        """-> (host, victims) or None. The serial form of the scan's
+        preemption sub-program."""
+        p_prio = api.pod_priority(pod)
+        req = _req_vec(pod)
+        machine_to_pods = _preds.map_pods_to_machines(pod_lister)
+        best: List[Tuple[str, int, List[api.Pod]]] = []  # (host, cost, set)
+        for host in node_order:
+            if exceeded[host]:
+                continue
+            if not all(fn(pod, machine_to_pods.get(host, []), host)
+                       for fn in nores_predicates.values()):
+                continue
+            pool = [v for v in evictable[host]
+                    if api.pod_priority(v) < p_prio]
+            if not pool:
+                continue
+            cap = caps[host]
+            used = used_by_host[host]
+            free = {name: cap.get(name, 0) - used.get(name, 0)
+                    for name in set(cap) | set(used) | set(req)}
+            # thresholds ascending; freed is monotone, so the first
+            # sufficient prefix is the lowest-sufficient victim set
+            chosen_t = None
+            for t in sorted({api.pod_priority(v) for v in pool}):
+                prefix = [v for v in pool if api.pod_priority(v) <= t]
+                freed: Dict[str, int] = {}
+                for v in prefix:
+                    for name, amt in _req_vec(v).items():
+                        freed[name] = freed.get(name, 0) + amt
+                fits = all(_preds.dim_fits(
+                    name, cap.get(name, 0),
+                    free.get(name, 0) + freed.get(name, 0), amt)
+                    for name, amt in req.items())
+                if fits:
+                    chosen_t = t
+                    break
+            if chosen_t is None:
+                continue
+            victims = [v for v in pool
+                       if api.pod_priority(v) <= chosen_t]
+            best.append((host, len(victims), victims))
+        if not best:
+            return None
+        min_cost = min(cost for _h, cost, _v in best)
+        tied = [(h, v) for h, cost, v in best if cost == min_cost]
+        host, victims = tied[fnv1a64(pod_tie_break_key(pod)) % len(tied)]
+        return host, victims
+
+    decisions: List[Optional[str]] = []
+    victim_out: List[Optional[List[Victim]]] = []
+    for pod in pending_pods:
+        try:
+            host = scheduler.schedule(pod, minion_lister)
+            commit(pod, host)
+            decisions.append(host)
+            victim_out.append(None)
+            continue
+        except FitError:
+            pass
+        hit = try_preempt(pod) if api.pod_can_preempt(pod) else None
+        if hit is None:
+            decisions.append(None)
+            victim_out.append(None)
+            continue
+        host, victims = hit
+        gone = {id(v) for v in victims}
+        evictable[host] = [v for v in evictable[host]
+                           if id(v) not in gone]
+        for v in victims:
+            account(host, _req_vec(v), -1)
+        # ghost the victims: resources leave the accounting, but ports /
+        # PDs / labels stay visible for the rest of the wave (the scan's
+        # conservative-retention rule)
+        for k, p in enumerate(committed):
+            if id(p) in gone:
+                ghost = copy.deepcopy(p)
+                for c in ghost.spec.containers:
+                    c.resources.limits = {}
+                    c.resources.requests = {}
+                ghost.spec.__dict__.pop("_ktpu_rows", None)
+                committed[k] = ghost
+        commit(pod, host)
+        decisions.append(host)
+        victim_out.append(sorted(
+            (Victim(v.metadata.uid, v.metadata.name,
+                    v.metadata.namespace, api.pod_priority(v))
+             for v in victims), key=lambda v: (v.priority, v.uid)))
+    return decisions, victim_out

@@ -1,0 +1,217 @@
+"""kube-preempt — priority bands, victim materialization, score encoding.
+
+The dense solver models preemption as ONE extra pair of resident planes:
+per node, per **priority band** (one band per distinct priority value
+present among the node-resident pods), the total evictable capacity
+``evict_cap [N, B, R]`` and pod count ``evict_cnt [N, B]``, plus the
+band's priority value ``band_prio [B]`` (``BAND_EMPTY`` marks unused
+pow-2-padded slots and can never sit below any pod priority).
+
+**The eviction rule** (the single definition both the batched scan and
+the serial oracle implement; bit-identity between them is the proof):
+
+- a pod tries NORMAL placement first; preemption is considered only when
+  no node is normally feasible and the pod's preemptionPolicy allows it;
+- on each node, the candidate victim sets are the *priority-prefix* sets:
+  all resident pods with priority <= t for a threshold t drawn from the
+  node's band values strictly below the pod's priority (equal-or-higher
+  pods are never candidates — the never-evict invariant is structural);
+- a (node, t) pair fits iff every non-resource filter the pod's normal
+  placement would apply passes (victims' host ports / PDs / service
+  membership are conservatively RETAINED for the remainder of the wave)
+  and ``free + freed(t) >= request`` on every resource dimension
+  (pre-exceeded nodes are excluded — their accumulators are not sums);
+- per node the minimal sufficient threshold wins (``freed`` is monotone
+  in t, so that IS the lowest-sufficient victim set); across nodes the
+  minimum **victim cost** — the number of pods evicted — wins, with the
+  standard FNV-1a tie-break over the minimum-cost nodes in list order;
+- the whole chosen prefix evicts: the scan zeroes those bands in its
+  carry (and subtracts their capacity from the node's accumulators), so
+  later pods in the same wave see the post-eviction cluster. Pods placed
+  earlier in the SAME wave are never victims (their contributions enter
+  ``fit_used`` but not the evictable planes).
+
+The scan cannot name individual victims (it holds aggregates), so it
+reports each preempting placement's threshold through the returned score
+channel: a placed pod's score ``<= PREEMPT_SCORE_BASE`` encodes the
+chosen threshold's band SLOT (``ceiling_slot``), and the host-side
+:func:`assign_victims` replay — shared by the live scheduler and the
+oracle gate — expands (node, threshold) into the concrete victim pods,
+deterministically.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, NamedTuple, Optional, Sequence
+
+import numpy as np
+
+from kubernetes_tpu.api import types as api
+
+__all__ = ["BAND_EMPTY", "PREEMPT_SCORE_BASE", "is_preempt_score",
+           "ceiling_slot", "preempt_score", "Victim", "ResidentPod",
+           "assign_victims", "derive_evict_planes", "band_values_of",
+           "preemption_possible"]
+
+# Empty/padded band slots carry this priority value: above every legal
+# pod priority (validation caps at HighestUserDefinablePriority < 2^31-1),
+# so a padded slot can never be "strictly lower" than any pod.
+BAND_EMPTY = np.int32(2**31 - 1)
+
+# A placed pod's returned score at or below this value means the pod
+# placed VIA PREEMPTION; the encoded band slot is recovered by
+# ceiling_slot. Normal scores are always >= 0 and the unschedulable
+# sentinel is -1, so the ranges cannot collide.
+PREEMPT_SCORE_BASE = -2
+
+
+def preempt_score(slot) -> int:
+    """Encode a threshold band slot into the score channel."""
+    return PREEMPT_SCORE_BASE - slot
+
+
+def is_preempt_score(score: int) -> bool:
+    return score <= PREEMPT_SCORE_BASE
+
+
+def ceiling_slot(score: int) -> int:
+    """Inverse of preempt_score."""
+    return PREEMPT_SCORE_BASE - int(score)
+
+
+class Victim(NamedTuple):
+    """One evicted pod, as the commit path needs it."""
+
+    uid: str
+    name: str
+    namespace: str
+    priority: int
+
+
+class ResidentPod(NamedTuple):
+    """A node-resident pod as the victim replay sees it: provided by the
+    IncrementalEncoder's registry (live scheduler) or derived from the
+    existing-pod list (oracle / full-encoder paths)."""
+
+    uid: str
+    name: str
+    namespace: str
+    host_idx: int
+    priority: int
+
+
+def resident_from_pods(pods: Sequence[api.Pod],
+                       node_index: Dict[str, int]) -> List[ResidentPod]:
+    """Existing-pod list -> ResidentPod rows (off-list pods dropped: they
+    occupy no node and can never be victims)."""
+    out: List[ResidentPod] = []
+    for p in pods:
+        i = node_index.get(p.status.host)
+        if i is None:
+            continue
+        m = p.metadata
+        out.append(ResidentPod(m.uid, m.name, m.namespace, i,
+                               api.pod_priority(p)))
+    return out
+
+
+def assign_victims(chosen: np.ndarray, scores: np.ndarray,
+                   band_prio: np.ndarray,
+                   resident: Optional[Iterable[ResidentPod]] = None,
+                   n_pods: Optional[int] = None,
+                   node_pods=None) -> List[Optional[List[Victim]]]:
+    """Expand the scan's (node, threshold) preemption decisions into
+    concrete victim sets — the deterministic host-side replay.
+
+    ``chosen``/``scores`` are the solve outputs (pod order = wave order;
+    pod-axis padding rows are sliced off via ``n_pods``); ``band_prio``
+    is the wave's band-value vector. Returns one entry per pod: None for
+    non-preempting pods, else the victim list sorted by (priority, uid).
+
+    Replay semantics mirror the in-scan carry exactly: victims are all
+    still-resident pods on the chosen node with priority <= threshold,
+    and each pod's evictions are excluded from every later pod's
+    candidate set (the scan zeroed those bands). Within-wave placements
+    are absent from ``resident`` by construction, so they can never be
+    selected — the never-evict-own-wave rule.
+
+    ``node_pods`` (optional) replaces the flat ``resident`` iterable with
+    a per-node lookup — ``node_pods(i) -> iterable of ResidentPod`` — so
+    the live scheduler's encoder registry pays O(pods on touched nodes),
+    not O(cluster), per wave.
+    """
+    n = len(chosen) if n_pods is None else n_pods
+    if node_pods is None:
+        by_node: Dict[int, List[ResidentPod]] = {}
+        for r in (resident or ()):
+            by_node.setdefault(r.host_idx, []).append(r)
+        node_pods = lambda i: by_node.get(i, ())
+    evicted: set = set()
+    out: List[Optional[List[Victim]]] = []
+    for j in range(n):
+        node = int(chosen[j])
+        score = int(scores[j])
+        if node < 0 or not is_preempt_score(score):
+            out.append(None)
+            continue
+        slot = ceiling_slot(score)
+        ceiling = int(band_prio[slot])
+        victims = [Victim(r.uid, r.name, r.namespace, r.priority)
+                   for r in node_pods(node)
+                   if r.uid not in evicted and r.priority <= ceiling]
+        victims.sort(key=lambda v: (v.priority, v.uid))
+        evicted.update(v.uid for v in victims)
+        out.append(victims)
+    return out
+
+
+def band_values_of(existing_pods: Sequence[api.Pod],
+                   node_index: Dict[str, int]) -> List[int]:
+    """Sorted distinct priorities of node-resident existing pods — the
+    full encoder's band vocabulary (the incremental encoder's sticky
+    vocabulary converges to the same VALUES; slot order may differ, which
+    is fine: every consumer compares band values, never slots)."""
+    seen = set()
+    for p in existing_pods:
+        if p.status.host in node_index:
+            seen.add(api.pod_priority(p))
+    return sorted(seen)
+
+
+def preemption_possible(band_values: Sequence[int],
+                        pending_pods: Sequence[api.Pod]) -> bool:
+    """The emit gate: the preemption planes (and the extra compiled scan
+    program they imply) ship only when some pending pod's priority sits
+    strictly above some existing band — otherwise no eviction can ever
+    trigger and the wave compiles the exact pre-preemption program."""
+    if not band_values or not pending_pods:
+        return False
+    floor = min(band_values)
+    return any(api.pod_priority(p) > floor for p in pending_pods)
+
+
+def derive_evict_planes(e_host: np.ndarray, e_prio: np.ndarray,
+                        e_req: np.ndarray, band_prio: np.ndarray,
+                        n_nodes: int):
+    """From-scratch twin of the encoder-resident evictable planes:
+    ``evict_cap[n, b, :]`` = summed request vectors of pods resident on
+    node ``n`` whose priority equals ``band_prio[b]``; ``evict_cnt`` the
+    matching pod counts. ``e_host`` >= n_nodes marks off-list pods (no
+    node, no band). The incremental encoder maintains the same planes
+    O(bands) per delta and KTPU_DEBUG-verifies against this."""
+    B = len(band_prio)
+    R = e_req.shape[1] if e_req.ndim == 2 else 0
+    cap = np.zeros((n_nodes, B, R), np.int64)
+    cnt = np.zeros((n_nodes, B), np.int32)
+    slot_of = {int(v): b for b, v in enumerate(band_prio)
+               if int(v) != int(BAND_EMPTY)}
+    for k in range(len(e_host)):
+        i = int(e_host[k])
+        if i >= n_nodes:
+            continue
+        b = slot_of.get(int(e_prio[k]))
+        if b is None:
+            continue
+        cap[i, b] += e_req[k]
+        cnt[i, b] += 1
+    return cap, cnt

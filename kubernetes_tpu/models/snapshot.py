@@ -180,6 +180,17 @@ class ClusterSnapshot:
     # solver seeds its scan carry from it (batch_solver.derive_zone_counts
     # is the authoritative definition).
     zone_counts0: np.ndarray = None
+    # kube-preempt planes (models/preempt.py). B == 0 disables the whole
+    # preemption sub-program (the emit gate: no pending pod sits strictly
+    # above any resident band), compiling the exact legacy scan. The
+    # evictable planes are band-granular aggregates of resident pods'
+    # request vectors, maintained O(bands) per delta by the incremental
+    # encoder; derive_evict_planes is the from-scratch twin.
+    pod_prio: np.ndarray = None        # [P] i32 resolved priorities
+    pod_can_preempt: np.ndarray = None  # [P] bool (PreemptionPolicy!=Never)
+    band_prio: np.ndarray = None       # [B] i32 values, BAND_EMPTY padded
+    evict_cap: np.ndarray = None       # [N, B, R] i64 evictable capacity
+    evict_cnt: np.ndarray = None       # [N, B] i32 evictable pod counts
     policy: BatchPolicy = field(default_factory=lambda: DEFAULT_BATCH_POLICY)
     # priority weights (kept for back-compat; mirror policy)
     w_least_requested: int = 1
@@ -313,6 +324,8 @@ def encode_snapshot(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
 
     req = np.zeros((P, R), np.int64)
     pod_host_idx = np.full(P, -1, np.int32)
+    pod_prio = np.zeros(P, np.int32)
+    pod_can_preempt = np.ones(P, bool)
     pod_names: List[str] = []
     pp_ij: List[Tuple[int, int]] = []   # (pod, port-vocab) pairs
     ps_ij: List[Tuple[int, int]] = []   # (pod, selector-vocab)
@@ -351,6 +364,8 @@ def encode_snapshot(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
                                         v.source.gce_persistent_disk.pd_name)))
         if spec.host:
             pod_host_idx[j] = node_index_get(spec.host, -2)
+        pod_prio[j] = api.pod_priority(p)
+        pod_can_preempt[j] = api.pod_can_preempt(p)
     pod_rid, pod_run_start = gang.pod_run_ids(pending_pods)
     tie = _fnv1a64_batch([pod_tie_break_key(p) for p in pending_pods])
     tie_hi = (tie >> np.uint64(32)).astype(np.int64)
@@ -384,6 +399,7 @@ def encode_snapshot(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
     # -- existing pods: one Python pass, then bulk accumulation -------------
     e_host = np.full(E, N, np.int64)      # N = unknown/unassigned slot
     e_req = np.zeros((E, R), np.int64)
+    e_prio = np.zeros(E, np.int32)
     np_ij: List[Tuple[int, int]] = []     # (node, port-vocab)
     nd_ij: List[Tuple[int, int]] = []     # (node, pd-vocab)
     ef_ij: List[Tuple[int, int]] = []     # (pod, service-selector-vocab)
@@ -403,6 +419,7 @@ def encode_snapshot(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
                 if t is not None:
                     ef_append((e, t))
         i = node_index_get(p.status.host, -1)
+        e_prio[e] = api.pod_priority(p)
         for name, val in exist_limits[e]:
             r = rindex_get(name)
             if r is not None:
@@ -429,6 +446,25 @@ def encode_snapshot(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
 
     fit_used, fit_exceeded = greedy_fit_accumulators(
         cap, score_used, zip(e_host.tolist(), e_req))
+
+    # -- kube-preempt: priority bands + evictable planes --------------------
+    # emit gate (preempt.preemption_possible): the planes (and the extra
+    # compiled scan program) ship only when some pending pod sits strictly
+    # above some resident priority; every other wave compiles the exact
+    # legacy program with B == 0
+    from kubernetes_tpu.models import preempt as _preempt
+    band_vals = sorted({int(v) for v, on in zip(e_prio, on_node) if on})
+    if band_vals and P and \
+            int(pod_prio.max(initial=-(2**31))) > band_vals[0]:
+        B = _pow2_pad(len(band_vals), minimum=2)
+        band_prio = np.full(B, _preempt.BAND_EMPTY, np.int32)
+        band_prio[:len(band_vals)] = band_vals
+        evict_cap, evict_cnt = _preempt.derive_evict_planes(
+            e_host, e_prio, e_req, band_prio, N)
+    else:
+        band_prio = np.zeros(0, np.int32)
+        evict_cap = np.zeros((N, 0, R), np.int64)
+        evict_cnt = np.zeros((N, 0), np.int32)
 
     # -- service groups (vectorized) ---------------------------------------
     # group = (namespace, index of FIRST service whose selector matches the
@@ -578,6 +614,8 @@ def encode_snapshot(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
         node_aff_vals=node_aff_vals, pod_aff_static=pod_aff_static,
         anchor_vals0=anchor_vals0, has_anchor0=has_anchor0,
         node_zone=node_zone,
+        pod_prio=pod_prio, pod_can_preempt=pod_can_preempt,
+        band_prio=band_prio, evict_cap=evict_cap, evict_cnt=evict_cnt,
         policy=policy,
         w_least_requested=policy.w_lr, w_spreading=policy.w_spread,
         w_equal=policy.w_equal,

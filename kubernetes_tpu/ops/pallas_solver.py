@@ -107,6 +107,13 @@ def eligible(inp, pol: Optional[BatchPolicy], gangs: bool,
         return False
     if inp.cap.dtype != jnp.int32:
         return False
+    band_prio = getattr(inp, "band_prio", None)
+    if band_prio is not None and band_prio.shape[0] > 0:
+        # kube-preempt waves carry the evictable-band planes and the
+        # min-victim-cost sub-program; the VMEM kernel does not model
+        # them — those waves take the XLA scan (batch_solver solve_jit),
+        # which is the bit-identity-gated reference implementation
+        return False
     N, R = inp.cap.shape
     G = inp.group_counts.shape[0]
     if not (R <= _MAX_R and inp.node_ports.shape[1] <= _MAX_W

@@ -51,6 +51,7 @@ RESIDENT_FIELDS = (
     "node_ports", "node_sel", "node_pds", "node_extra_ok",
     "group_counts", "score_static", "node_aff_vals",
     "zone_idx", "zone_counts0",
+    "evict_cap", "evict_cnt", "band_prio",
 )
 WAVE_FIELDS = tuple(f for f in SolverInputs._fields
                     if f not in RESIDENT_FIELDS)
@@ -116,6 +117,8 @@ def _assert_padding_invariant(padded: SolverInputs, n: int) -> None:
         "mesh padding is zone-labeled (would perturb anti-affinity counts)"
     assert (np.asarray(padded.node_aff_vals[n:]) == -1).all(), \
         "mesh padding carries affinity label values"
+    assert not np.asarray(padded.evict_cnt[n:]).any(), \
+        "mesh padding holds evictable pods (preemption could target it)"
 
 
 # (axis, decision-invariant fill) of each plane pad_inputs_for_mesh
@@ -134,6 +137,10 @@ PAD_SPEC = {
     "node_extra_ok": (0, False), "score_static": (0, 0),
     "node_aff_vals": (0, -1),
     "group_counts": (1, 0), "zone_idx": (1, -1),
+    # kube-preempt: pad nodes hold no evictable pods, so they can never
+    # be preempted onto (their freed capacity is zero and they are
+    # infeasible anyway per node_extra_ok/fit_exceeded above)
+    "evict_cap": (0, 0), "evict_cnt": (0, 0),
 }
 
 
@@ -197,6 +204,12 @@ def input_shardings(mesh: Mesh) -> SolverInputs:
         anchor_vals0=rep, has_anchor0=rep,
         zone_idx=s(None, "nodes"),
         zone_counts0=rep,
+        pod_prio=rep, pod_can_preempt=rep,
+        # evictable planes are node-major like cap/fit_used; band values
+        # are a tiny [B] vector every shard needs
+        band_prio=rep,
+        evict_cap=s("nodes", None, None),
+        evict_cnt=s("nodes", None),
     )
 
 
@@ -231,7 +244,8 @@ def shard_memory_report(inp: SolverInputs, mesh: Mesh) -> dict:
     # the lax.scan carry holds live copies of the mutable planes
     # (kubernetes_tpu.models.batch_solver solve_jit Carry); same layout
     carry_sharded = sum(nbytes(f) for f in (
-        "fit_used", "score_used", "node_ports", "node_pds")) // shards
+        "fit_used", "score_used", "node_ports", "node_pds",
+        "evict_cap", "evict_cnt")) // shards
     carry_replicated = sum(nbytes(f) for f in (
         "group_counts", "anchor_vals0", "has_anchor0"))
     return {

@@ -35,6 +35,7 @@ __all__ = [
     "make_node_registry", "make_event_registry", "make_namespace_registry",
     "NamespaceFinalizeREST", "make_secret_registry", "make_limitrange_registry",
     "make_resourcequota_registry", "ResourceQuotaStatusREST", "IPAllocator",
+    "make_priorityclass_registry",
 ]
 
 
@@ -107,6 +108,18 @@ class BindingREST:
             raise errors.new_bad_request("binding must name a pod")
         if not binding.host:
             raise errors.new_bad_request("binding must name a host")
+        if binding.victims:
+            # the single-binding form of the evict+bind item: one-element
+            # batch, same all-or-nothing transaction
+            res = self.create_many(ctx.with_namespace(
+                ctx.namespace or binding.metadata.namespace),
+                api.BindingList(items=[binding]))
+            r = res.items[0]
+            if r.error:
+                raise errors.StatusError(api.Status(
+                    status=api.StatusFailure, message=r.error, code=r.code,
+                    reason=api.ReasonConflict if r.code == 409 else ""))
+            return api.Status(status=api.StatusSuccess)
         key = self.pods.key(ctx, name)
         self.pods.helper.atomic_update(key, api.Pod,
                                        self._assign_fn(name, binding.host))
@@ -124,10 +137,20 @@ class BindingREST:
         ``on_bound`` (optional) is called with each successfully bound
         pod (its committed post-bind revision) — the apiserver's
         encode-once seam: the HTTP layer serializes the revision here,
-        at commit, so fanning its watch event out is a byte copy."""
+        at commit, so fanning its watch event out is a byte copy.
+
+        kube-preempt: an item carrying ``victims`` commits as ONE
+        all-or-nothing transaction — every victim pod deleted (its
+        watch DELETE event drives the normal kubelet teardown) AND the
+        pod bound, or a per-item 409 and nothing applied. Victims are
+        namespace-pinned to the request exactly like the binding;
+        victim uids guard against name reuse; an already-gone victim
+        counts as evicted (the eviction's goal state)."""
         updates = []
         results = [api.BindingResult() for _ in bindings.items]
         slot_map = []
+        evict_items = []     # (pod_key, assign_fn, [(victim_key, uid)])
+        evict_slots = []
         for i, b in enumerate(bindings.items):
             name = b.pod_name or b.metadata.name
             results[i].pod_name = name
@@ -141,12 +164,33 @@ class BindingREST:
                     f"match request namespace {ctx.namespace!r}")
                 results[i].code = 403
                 continue
+            if b.victims:
+                if any(not v.name for v in b.victims):
+                    results[i].error = "every victim must name a pod"
+                    results[i].code = 400
+                    continue
+                # victims may live in other namespaces (the node is a
+                # shared resource); Master.bind_batch authorized DELETE
+                # against every victim namespace the wave touches
+                evict_items.append((
+                    self.pods.key(ctx, name),
+                    self._assign_fn(name, b.host),
+                    [(self.pods.key(
+                        ctx.with_namespace(v.namespace or ctx.namespace),
+                        v.name), v.uid)
+                     for v in b.victims]))
+                evict_slots.append(i)
+                continue
             updates.append((self.pods.key(ctx, name),
                             self._assign_fn(name, b.host)))
             slot_map.append(i)
-        with tracing.child_span("store.bind_batch", bindings=len(updates)):
+        with tracing.child_span("store.bind_batch", bindings=len(updates),
+                                evict_binds=len(evict_items)):
             outcomes = self.pods.helper.atomic_update_many(api.Pod, updates)
-        for i, oc in zip(slot_map, outcomes):
+            evict_outcomes = self.pods.helper.atomic_bind_evict_many(
+                api.Pod, evict_items) if evict_items else []
+        for i, oc in zip(slot_map + evict_slots,
+                         list(outcomes) + list(evict_outcomes)):
             if isinstance(oc, errors.StatusError):
                 results[i].error = oc.status.message
                 results[i].code = oc.status.code
@@ -521,6 +565,64 @@ class ResourceQuotaStrategy(Strategy):
 def make_resourcequota_registry(helper: StoreHelper) -> GenericRegistry:
     return GenericRegistry(helper, "/registry/resourcequotas", api.ResourceQuota,
                            api.ResourceQuotaList, ResourceQuotaStrategy())
+
+
+class PriorityClassStrategy(Strategy):
+    """kube-preempt: cluster-scoped PriorityClass storage. Beyond field
+    validation, create/update check the at-most-one-globalDefault
+    invariant against the stored set. The check is list-then-write (no
+    cross-key transaction spans it), so two concurrent globalDefault
+    creates racing through separate apiserver workers can still both
+    land — the same window the upstream apiserver has; PriorityDefault
+    admission tolerates that state (it resolves to SOME globalDefault
+    deterministically per process) and the serial case is rejected."""
+
+    kind = "PriorityClass"
+    namespaced = False
+
+    def __init__(self, registry_ref):
+        # late-bound reference: the strategy needs the registry's list()
+        # for the globalDefault check, and the registry needs the strategy
+        self._registry = registry_ref
+
+    def _global_default_conflict(self, pc: api.PriorityClass):
+        if not pc.global_default:
+            return None
+        for other in self._registry[0].list(Context()).items:
+            if other.global_default and other.metadata.name != pc.metadata.name:
+                return other.metadata.name
+        return None
+
+    def validate(self, ctx, pc: api.PriorityClass) -> List[Exception]:
+        errs = list(validation.validate_priority_class(pc))
+        clash = self._global_default_conflict(pc)
+        if clash:
+            errs.append(ValueError(
+                f"globalDefault: PriorityClass {clash!r} is already the "
+                "global default"))
+        return errs
+
+    def validate_update(self, ctx, new, old) -> List[Exception]:
+        errs = list(validation.validate_priority_class(new))
+        if new.value != old.value:
+            # upstream parity: the value is immutable post-creation (the
+            # scheduler caches resolved priorities on pods)
+            errs.append(ValueError("value: may not be changed"))
+        clash = self._global_default_conflict(new)
+        if clash:
+            errs.append(ValueError(
+                f"globalDefault: PriorityClass {clash!r} is already the "
+                "global default"))
+        return errs
+
+
+def make_priorityclass_registry(helper: StoreHelper) -> GenericRegistry:
+    ref: list = []
+    reg = GenericRegistry(helper, "/registry/priorityclasses",
+                          api.PriorityClass, api.PriorityClassList,
+                          PriorityClassStrategy(ref))
+    ref.append(reg)
+    return reg
 
 
 class ResourceQuotaStatusREST:

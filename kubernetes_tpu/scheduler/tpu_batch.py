@@ -64,6 +64,7 @@ from typing import List, NamedTuple, Optional
 
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.models import gang
+from kubernetes_tpu.models import preempt as preempt_mod
 from kubernetes_tpu.models.batch_solver import decisions_to_names, solve
 from kubernetes_tpu.models.incremental import IncrementalEncoder
 from kubernetes_tpu.models.policy import BatchPolicy, batch_policy_from
@@ -154,6 +155,18 @@ def _pipeline_metrics() -> _PipelineMetrics:
     if _PipelineMetrics._singleton is None:
         _PipelineMetrics._singleton = _PipelineMetrics()
     return _PipelineMetrics._singleton
+
+
+class _WaveDecisions(NamedTuple):
+    """One wave's solve outcome: per-pod host names (None =
+    unschedulable) plus, for pods the solver placed VIA PREEMPTION
+    (kube-preempt), the concrete victim sets the commit must evict
+    atomically with the bind. ``t0`` is the solve-dispatch instant, the
+    start of the preempt-to-bind latency window."""
+
+    hosts: list
+    victims: list           # aligned; None = normal placement
+    t0: float = 0.0
 
 
 class _SpecResult(NamedTuple):
@@ -355,21 +368,51 @@ class BatchScheduler:
 
     def _solve_snap(self, snap, n_pending: int, tctx=None):
         """One wave's solve (in-process or via the shared daemon) ->
-        decision host names. Thread-safe: runs on the pipelined loop's
+        _WaveDecisions. Thread-safe: runs on the pipelined loop's
         solve thread; both paths include the gang all-or-nothing post-pass
         and RemoteSolver falls back in-process when the daemon is
         absent/busy. ``tctx`` carries the wave's trace across the thread
         boundary; the span's ambient context is what RemoteSolver ships
-        on the v3 frame so solverd's spans join this trace."""
+        on the v3 frame so solverd's spans join this trace.
+
+        kube-preempt: a placed pod whose returned score encodes a
+        preemption threshold (models/preempt.py score channel) gets its
+        victim set materialized here from the incremental encoder's
+        per-node registry — the deterministic replay the oracle gate
+        pins. Safe on the solve thread: the encoder is only mutated
+        after this wave's decisions are collected (speculation ordering
+        in _pipelined_cycle)."""
         t0 = time.perf_counter()
         with tracing.span("wave.solve", parent=tctx, pods=n_pending):
             if self.solver is not None:
-                chosen, _ = self.solver.solve(snap)
+                chosen, scores = self.solver.solve(snap)
             else:
-                chosen, _ = solve(snap, mesh=self._mesh)
+                chosen, scores = solve(snap, mesh=self._mesh)
         _wave_metrics().solve.observe(time.perf_counter() - t0)
         _wave_metrics().pods.inc(by=n_pending)
-        return decisions_to_names(snap, chosen)
+        hosts = decisions_to_names(snap, chosen)
+        victims = [None] * len(hosts)
+        if any(preempt_mod.is_preempt_score(int(s))
+               for s in scores[:len(hosts)]):
+            if self._encoder is not None:
+                victims = preempt_mod.assign_victims(
+                    chosen, scores, snap.band_prio, n_pods=len(hosts),
+                    node_pods=self._encoder.resident_on)
+            else:
+                # the full-encoder path has no resident pod registry to
+                # name victims from: fail those pods back to the queue
+                # (preemption requires the incremental encoder, like
+                # speculation; policies it cannot model keep the serial
+                # no-preemption behavior)
+                if not getattr(self, "_warned_preempt_encoder", False):
+                    self._warned_preempt_encoder = True
+                    _log.warning(
+                        "preemption decisions need the incremental "
+                        "encoder's pod registry; requeueing preempting "
+                        "pods (policy forces the full encoder)")
+                hosts = [None if preempt_mod.is_preempt_score(int(s))
+                         else h for h, s in zip(hosts, scores)]
+        return _WaveDecisions(hosts, victims, t0)
 
     def _default_solve(self, nodes, existing, pending, services, tctx=None):
         get_existing = existing if callable(existing) else lambda: existing
@@ -447,40 +490,58 @@ class BatchScheduler:
 
     # -- commit -------------------------------------------------------------
     def _split_decisions(self, pending, decisions):
-        """(pod, host) pairs for placed pods; unschedulable pods are
-        evented + handed to the error handler (backoff + requeue)."""
+        """(pod, host, victims) triples for placed pods (victims is None
+        for normal placements); unschedulable pods are evented + handed to
+        the error handler (backoff + requeue). ``decisions`` is a
+        _WaveDecisions, or a bare host-name list from a custom solve_fn
+        (which never preempts)."""
         c = self.config
+        if isinstance(decisions, _WaveDecisions):
+            hosts, victims = decisions.hosts, decisions.victims
+        else:
+            hosts, victims = decisions, [None] * len(decisions)
         placed = []
-        for pod, host in zip(pending, decisions):
+        for pod, host, vict in zip(pending, hosts, victims):
             if host is None:
                 err = FitError(pod, {})
                 self._record(pod, "FailedScheduling",
                              "Error scheduling: %s", err)
                 c.error(pod, err)
             else:
-                placed.append((pod, host))
+                placed.append((pod, host, vict))
         return placed
 
     def _commit_wave(self, placed, assumed: Optional[list] = None,
-                     tctx=None):
+                     tctx=None, preempt_t0: Optional[float] = None):
         """Bind the wave's placements, event every outcome, assume the
         winners. ``assumed`` optionally supplies the pre-built post-bind
         clones — the pipelined path shares them with the speculative
         encode so the encoder and the modeler account the IDENTICAL
         objects. Returns (outcomes, bound): outcomes[i] is None on
-        success, else the bind error (aligned with ``placed``)."""
-        with tracing.span("wave.commit", parent=tctx, pods=len(placed)):
-            return self._commit_wave_inner(placed, assumed)
+        success, else the bind error (aligned with ``placed``).
 
-    def _commit_wave_inner(self, placed, assumed: Optional[list] = None):
+        kube-preempt: a placed triple carrying victims commits as an
+        atomic evict+bind item (Binding.victims) — the server deletes
+        every victim AND binds the pod in one transaction, or fails the
+        item 409; the victims' DELETE watch events then drive kubelet
+        teardown and the encoder's resident-plane removal exactly like
+        any other delete."""
+        with tracing.span("wave.commit", parent=tctx, pods=len(placed)):
+            return self._commit_wave_inner(placed, assumed, preempt_t0)
+
+    def _commit_wave_inner(self, placed, assumed: Optional[list] = None,
+                           preempt_t0: Optional[float] = None):
         t_commit0 = time.perf_counter()
         c = self.config
 
-        def mk_binding(pod, host) -> api.Binding:
+        def mk_binding(pod, host, victims) -> api.Binding:
+            refs = [api.ObjectReference(kind="Pod", namespace=v.namespace,
+                                        name=v.name, uid=v.uid)
+                    for v in victims] if victims else []
             return api.Binding(
                 metadata=api.ObjectMeta(name=pod.metadata.name,
                                         namespace=pod.metadata.namespace),
-                pod_name=pod.metadata.name, host=host)
+                pod_name=pod.metadata.name, host=host, victims=refs)
 
         # one transactional store pass per namespace for the wave's
         # bindings (SURVEY §7 hard part (e)); the batch endpoint scopes to
@@ -491,7 +552,7 @@ class BatchScheduler:
         outcomes: List[Optional[Exception]] = [None] * len(placed)
         if bind_many is not None:
             by_ns: dict = {}
-            for idx, (pod, host) in enumerate(placed):
+            for idx, (pod, host, vict) in enumerate(placed):
                 by_ns.setdefault(pod.metadata.namespace, []).append(idx)
             for ns, idxs in by_ns.items():
                 blist = api.BindingList(items=[
@@ -499,8 +560,12 @@ class BatchScheduler:
                 try:
                     results = bind_many(ns, blist)
                     for i, r in zip(idxs, results.items):
-                        outcomes[i] = RuntimeError(r.error) if r.error \
-                            else None
+                        if r.error:
+                            err = RuntimeError(r.error)
+                            err.code = r.code  # CAS-vs-other classification
+                            outcomes[i] = err
+                        else:
+                            outcomes[i] = None
                 except Exception as e:
                     for i in idxs:
                         outcomes[i] = e
@@ -513,9 +578,9 @@ class BatchScheduler:
                     "bind round-trip per pod (scheduler_bind_fallback_"
                     "total counts affected waves)",
                     type(c.binder).__name__)
-            for idx, (pod, host) in enumerate(placed):
+            for idx, (pod, host, vict) in enumerate(placed):
                 try:
-                    c.binder.bind(mk_binding(pod, host))
+                    c.binder.bind(mk_binding(pod, host, vict))
                 except Exception as e:
                     outcomes[idx] = e
 
@@ -524,16 +589,39 @@ class BatchScheduler:
             # deep_clone, not copy.deepcopy — at churn rates the stdlib
             # deepcopy was the scheduler's single largest CPU sink
             assumed = []
-            for pod, host in placed:
+            for pod, host, _vict in placed:
                 cl = deep_clone(pod)
                 cl.spec.host = host
                 cl.status.host = host
                 assumed.append(cl)
 
+        # preemption outcome accounting (scheduler_preemption_* family)
+        pmx = None
+        now_p = time.perf_counter()
+        for (pod, host, vict), err in zip(placed, outcomes):
+            if not vict:
+                continue
+            if pmx is None:
+                pmx = metrics.preemption_metrics()
+            if err is None:
+                pmx.attempts.inc()
+                pmx.victims.inc(by=len(vict))
+                p_prio = api.pod_priority(pod)
+                bad = sum(1 for v in vict if v.priority >= p_prio)
+                if bad:
+                    pmx.higher_evictions.inc(by=bad)
+                if preempt_t0 is not None:
+                    pmx.bind_seconds.observe(max(0.0, now_p - preempt_t0))
+            elif getattr(err, "code", None) == 409:
+                # only true CAS losses count as conflicts; other failure
+                # classes (transport faults, 4xx validation) stay visible
+                # as requeues instead of masquerading as benign CAS churn
+                pmx.conflicts.inc()
+
         bound = 0
         now_m = time.monotonic()
         now_w = time.time()
-        for (pod, host), cl, err in zip(placed, assumed, outcomes):
+        for (pod, host, _vict), cl, err in zip(placed, assumed, outcomes):
             if err is not None:
                 # lost a CAS race: requeue; next wave sees fresh state
                 self._record(pod, "FailedScheduling",
@@ -611,7 +699,10 @@ class BatchScheduler:
         placed = self._split_decisions(pending, decisions)
         if not placed:
             return 0
-        _, bound = self._commit_wave(placed, tctx=tctx)
+        _, bound = self._commit_wave(
+            placed, tctx=tctx,
+            preempt_t0=decisions.t0
+            if isinstance(decisions, _WaveDecisions) else None)
         return bound
 
     # -- pipelined wave loop ------------------------------------------------
@@ -798,7 +889,7 @@ class BatchScheduler:
         # speculative encode and assume_pod, so a verified hit leaves the
         # encoder accounting the very objects the modeler holds
         predicted = []
-        for pod, host in placed:
+        for pod, host, _vict in placed:
             cl = deep_clone(pod)
             cl.spec.host = host
             cl.status.host = host
@@ -806,11 +897,18 @@ class BatchScheduler:
         # wave k's bindings commit on the commit thread; the speculative
         # encode (overlap 2) and wave k+1's solve (overlap 3) ride it
         t_c0 = time.perf_counter()
-        commit_fut = commit_pool.submit(self._commit_wave, placed, predicted,
-                                        inflight.tctx)
+        commit_fut = commit_pool.submit(
+            self._commit_wave, placed, predicted, inflight.tctx,
+            decisions.t0 if isinstance(decisions, _WaveDecisions) else None)
+        # kube-preempt: a wave that evicts changes the cluster beyond its
+        # own binds (victim deletions land in the changelog), so the
+        # predicted post-commit state would always verify as divergent —
+        # don't speculate on top of it
+        wave_evicts = any(vict for _pod, _host, vict in placed)
         spec = None
         next_fut = None
         if next_pods and self._delta_token is not None and \
+                not wave_evicts and \
                 not any(gang.gang_key(p) is not None for p in next_pods):
             spec = self._speculate(next_pods, predicted, tctx=next_tctx)
             if spec.snap is not None:

@@ -114,6 +114,10 @@ DELTA_FIELDS = frozenset((
     "node_ports", "node_sel", "node_pds", "node_extra_ok",
     "group_counts", "score_static", "node_aff_vals",
     "zone_idx", "zone_counts0",
+    # kube-preempt: the evictable-band planes are node-resident like every
+    # other plane above (band_prio rides along — [B] rows delta like any
+    # axis-0 plane); pod_prio/pod_can_preempt are pod-axis, always full
+    "evict_cap", "evict_cnt", "band_prio",
 ))
 
 # A full-shape wave (10k pods x 10k nodes) encodes to a few hundred MB in

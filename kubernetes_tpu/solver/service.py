@@ -103,6 +103,14 @@ _PAD_SPEC = {
     "has_anchor0":     (("G",), False),
     "zone_idx":        (("A", "N"), -1),   # pad nodes are unlabeled
     "zone_counts0":    (("A", "G", "V"), 0),  # phantom zones hold no peers
+    # kube-preempt: pad pods carry priority 0 and can never preempt; pad
+    # bands are BAND_EMPTY (never strictly below any priority); pad nodes
+    # hold no evictable pods
+    "pod_prio":        (("P",), 0),
+    "pod_can_preempt": (("P",), False),
+    "band_prio":       (("B",), 2**31 - 1),
+    "evict_cap":       (("N", "B", "R"), 0),
+    "evict_cnt":       (("N", "B"), 0),
 }
 
 
@@ -113,6 +121,7 @@ def _dims_of(inp) -> Dict[str, int]:
         "Wd": inp.node_pds.shape[1], "P": inp.req.shape[0],
         "G": inp.group_counts.shape[0], "L": inp.node_aff_vals.shape[1],
         "A": inp.zone_idx.shape[0], "V": inp.zone_counts0.shape[2],
+        "B": inp.band_prio.shape[0],
     }
 
 
@@ -125,6 +134,10 @@ def _target_dims(all_dims: List[Dict[str, int]]) -> Dict[str, int]:
         m = max(d[k] for d in all_dims)
         if k in ("L", "A"):
             t[k] = m
+        elif k == "B":
+            # B == 0 must STAY 0: padding a band axis into a legacy wave
+            # would compile the preemption sub-program for it
+            t[k] = 0 if m == 0 else _pow2_pad(m, minimum=2)
         elif k == "G":
             t[k] = _pow2_pad(m, minimum=8)
         else:

@@ -255,6 +255,80 @@ class StoreHelper:
                                              "too many CAS retries")
         return results
 
+    def atomic_bind_evict_many(self, obj_type: Type,
+                               items: "list[tuple]",
+                               max_retries: int = 100) -> list:
+        """kube-preempt's commit primitive: per item, delete every victim
+        AND apply the pod update in ONE store transaction (MemStore
+        .txn_many) — all-or-nothing per item, items independent. Each
+        item is ``(pod_key, update_fn, victims)`` with victims a list of
+        ``(victim_key, expected_uid)``; a victim whose uid no longer
+        matches is a 409 (the world moved — the caller must re-solve),
+        while an already-absent victim counts as evicted. CAS conflicts
+        re-read and retry like atomic_update_many."""
+        results: list = [None] * len(items)
+        live = list(range(len(items)))
+        for _ in range(max_retries):
+            if not live:
+                return results
+            txn = []       # (slot, cas_ops, delete_ops, desired)
+            for i in live:
+                pod_key, fn, victims = items[i]
+                try:
+                    kv = self.store.get(pod_key)
+                except ErrKeyNotFound:
+                    results[i] = errors.new_not_found(
+                        obj_type.__name__, pod_key.rsplit("/", 1)[-1])
+                    continue
+                try:
+                    desired = fn(self._decode(kv, isolate=True))
+                except errors.StatusError as e:
+                    results[i] = e
+                    continue
+                vkeys = [vk for vk, _uid in victims]
+                vkvs = self.store.get_many(vkeys)
+                deletes = []
+                bad = None
+                for (vk, want_uid), vkv in zip(victims, vkvs):
+                    if vkv is None:
+                        continue  # already gone: eviction's goal state
+                    if want_uid:
+                        have = accessor.uid(self._decode(vkv))
+                        if have != want_uid:
+                            bad = errors.new_conflict(
+                                obj_type.__name__,
+                                vk.rsplit("/", 1)[-1],
+                                f"victim {vk.rsplit('/', 1)[-1]} uid "
+                                f"changed (have {have!r}, want "
+                                f"{want_uid!r}) — re-solve required")
+                            break
+                    deletes.append((vk, vkv.modified_index))
+                if bad is not None:
+                    results[i] = bad
+                    continue
+                txn.append((i, [(pod_key, self._encode(desired),
+                                 kv.modified_index)], deletes, desired))
+            if not txn:
+                live = []
+                return results
+            outcomes = self.store.txn_many(
+                [(cas, dels) for _i, cas, dels, _d in txn])
+            live = []
+            for (i, _cas, _dels, desired), oc in zip(txn, outcomes):
+                if isinstance(oc, (ErrCASConflict, ErrKeyNotFound)):
+                    live.append(i)   # raced: re-read and retry
+                elif isinstance(oc, Exception):
+                    results[i] = errors.new_internal_error(str(oc))
+                else:
+                    accessor.set_resource_version(
+                        desired, str(oc[0].modified_index))
+                    results[i] = desired
+        for i in live:
+            results[i] = errors.new_conflict(obj_type.__name__,
+                                             items[i][0],
+                                             "too many CAS retries")
+        return results
+
     # -- watch --------------------------------------------------------------
     def watch_raw(self, prefix: str, resource_version: str = "",
                   recursive: bool = True,

@@ -294,6 +294,79 @@ class MemStore:
                 out.append(kv)
         return out
 
+    def txn_many(self, items: List[Tuple[List[Tuple[str, str, int]],
+                                         List[Tuple[str, int]]]]
+                 ) -> List[object]:
+        """Per-item all-or-nothing transactions under ONE lock acquisition
+        — the evict+bind commit primitive (kube-preempt). Each item is
+        ``(cas_ops, delete_ops)``: cas_ops are (key, value, prev_index)
+        writes, delete_ops are (key, prev_index) compare-and-deletes.
+        EVERY guard in an item is validated before ANY of its ops apply;
+        the first failing guard aborts the whole item (its outcome is the
+        StoreError) and later items still run independently. Outcomes are
+        positional: the list of written KVs on success (cas order then
+        delete order carries no KVs — deletes return nothing), a
+        StoreError otherwise. Watch events are recorded per applied op in
+        order, exactly as the serial verbs would."""
+        out: List[object] = []
+        with self._lock:
+            self._sweep_locked()
+            for cas_ops, delete_ops in items:
+                err: Optional[StoreError] = None
+                for key, _value, prev_index in cas_ops:
+                    try:
+                        self._maybe_raise("compare_and_swap", key)
+                    except StoreError as e:
+                        err = e
+                        break
+                    prev = self._data.get(key)
+                    if prev is None:
+                        err = ErrKeyNotFound(key)
+                        break
+                    if prev.modified_index != prev_index:
+                        err = ErrCASConflict(
+                            f"{key}: index mismatch (have "
+                            f"{prev.modified_index}, want {prev_index})")
+                        break
+                if err is None:
+                    for key, prev_index in delete_ops:
+                        try:
+                            self._maybe_raise("delete", key)
+                        except StoreError as e:
+                            err = e
+                            break
+                        prev = self._data.get(key)
+                        if prev is None:
+                            err = ErrKeyNotFound(key)
+                            break
+                        if prev.modified_index != prev_index:
+                            err = ErrCASConflict(
+                                f"{key}: index mismatch (have "
+                                f"{prev.modified_index}, want {prev_index})")
+                            break
+                if err is not None:
+                    out.append(err)
+                    continue
+                written: List[KV] = []
+                for key, value, _prev_index in cas_ops:
+                    prev = self._data[key]
+                    self._index += 1
+                    kv = KV(key, value, prev.created_index, self._index,
+                            None)
+                    self._data[key] = kv
+                    self._record_locked(StoreEvent(
+                        "compareAndSwap", key, self._index, kv, prev))
+                    written.append(kv)
+                for key, _prev_index in delete_ops:
+                    prev = self._data[key]
+                    del self._data[key]
+                    self._remove_key_locked(key)
+                    self._index += 1
+                    self._record_locked(StoreEvent(
+                        "delete", key, self._index, None, prev))
+                out.append(written)
+        return out
+
     def delete(self, key: str, prev_index: Optional[int] = None) -> KV:
         with self._lock:
             self._maybe_raise("delete", key)

@@ -199,6 +199,14 @@ class StoreServer:
                 [(k, v, p) for k, v, p in req["items"]])
             return {"ok": [_err_out(oc) if isinstance(oc, Exception)
                            else {"kv": _kv_out(oc)} for oc in outcomes]}
+        if op == "txn_many":
+            outcomes = s.txn_many(
+                [([(k, v, p) for k, v, p in cas],
+                  [(k, p) for k, p in dels])
+                 for cas, dels in req["items"]])
+            return {"ok": [_err_out(oc) if isinstance(oc, Exception)
+                           else {"kvs": [_kv_out(kv) for kv in oc]}
+                           for oc in outcomes]}
         if op == "delete":
             return {"ok": _kv_out(s.delete(req["key"],
                                            prev_index=req.get("prev_index")))}
@@ -353,6 +361,21 @@ class RemoteStore:
                 results.append(_ERRORS.get(d["err"], StoreError)(d["msg"]))
             else:
                 results.append(_kv_in(d["kv"]))
+        return results
+
+    def txn_many(self, items) -> List[object]:
+        """Per-item all-or-nothing CAS+delete transactions (the evict+bind
+        commit primitive); wire mirror of MemStore.txn_many."""
+        out = self._call({"op": "txn_many",
+                          "items": [[[list(c) for c in cas],
+                                     [list(d) for d in dels]]
+                                    for cas, dels in items]})
+        results: List[object] = []
+        for d in out:
+            if "err" in d:
+                results.append(_ERRORS.get(d["err"], StoreError)(d["msg"]))
+            else:
+                results.append([_kv_in(kv) for kv in d["kvs"]])
         return results
 
     def delete(self, key: str, prev_index: Optional[int] = None) -> KV:

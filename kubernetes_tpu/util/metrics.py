@@ -424,6 +424,46 @@ def pod_latency_metrics() -> PodLatencyMetrics:
     return PodLatencyMetrics._singleton
 
 
+class PreemptionMetrics:
+    """kube-preempt instrumentation (scheduler/tpu_batch.py commit path).
+    Registered HERE so kube-vet's metrics-sync rule binds the churn
+    harness's scrape and the flightrec SLO names to the registry
+    universe. ``higher_evictions`` is an invariant counter: the
+    never-evict-equal-or-higher rule is structural in the solve, so any
+    non-zero value is a bug, and the storm record requires it to be 0."""
+
+    _singleton = None
+
+    def __init__(self, registry: Optional[Registry] = None):
+        reg = registry or default_registry()
+        self.attempts = reg.counter(
+            "scheduler_preemption_attempts_total",
+            "Pods the wave solver placed via preemption (evict+bind "
+            "commits attempted)")
+        self.victims = reg.counter(
+            "scheduler_preemption_victims_total",
+            "Lower-priority pods evicted by committed preemptions")
+        self.conflicts = reg.counter(
+            "scheduler_preemption_conflicts_total",
+            "Evict+bind items that lost their CAS (per-item 409; the "
+            "pod requeues and the next wave re-sees truth)")
+        self.higher_evictions = reg.counter(
+            "scheduler_preemption_higher_evictions_total",
+            "Victims at equal-or-higher priority than their preemptor — "
+            "MUST stay 0 (structural invariant of the band planes)")
+        self.bind_seconds = reg.histogram(
+            "scheduler_preemption_bind_seconds",
+            "Preempt-to-bind latency: wave drain of a preempting pod -> "
+            "its evict+bind committed",
+            buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0))
+
+
+def preemption_metrics() -> PreemptionMetrics:
+    if PreemptionMetrics._singleton is None:
+        PreemptionMetrics._singleton = PreemptionMetrics()
+    return PreemptionMetrics._singleton
+
+
 # -- kube-flightrec: continuous in-process metric time-series ---------------
 #
 # /metrics answers "what is the value NOW"; every wall to date (r07 bind

@@ -234,7 +234,47 @@ class TestSLOWatchdog:
         assert {"sustained_binds_floor", "solve_p50_ceiling",
                 "solverd_queue_saturation", "watch_lag_zero",
                 "parity_divergence_zero", "spans_dropped_zero",
-                "process_rss_ceiling"} <= names
+                "process_rss_ceiling",
+                # kube-preempt: the priority-storm scenario's own alarm
+                # + the victims:rate headline series + the must-be-zero
+                # equal-or-higher-eviction invariant
+                "preempt_to_bind_p95_ceiling",
+                "preemption_victims_rate_visible",
+                "preemption_higher_evictions_zero"} <= names
+
+    def test_preempt_to_bind_rule_fires_and_resolves(self):
+        """kube-preempt SLO: sustained p95 above the ceiling while load
+        is offered fires exactly once; recovery resolves exactly once —
+        the storm record's alarms section depends on both transitions."""
+        rule = next(r for r in default_churn_rules()
+                    if r.name == "preempt_to_bind_p95_ceiling")
+        assert rule.active_only and rule.op == "ceil"
+        # the ceiling must sit at or below the histogram's top finite
+        # bucket (30 s) so an overflow conservatively fires
+        assert rule.threshold <= 30.0
+        dog = SLOWatchdog([rule])
+        # quiet preemptions: under the ceiling, nothing fires
+        assert dog.observe(rule, 1.0, _ns(0), active=True) is None
+        # sustained violation past for_s: ONE firing transition
+        assert dog.observe(rule, 25.0, _ns(5), active=True) is None
+        tr = dog.observe(rule, 28.0, _ns(5 + int(rule.for_s) + 1),
+                         active=True, samples=[[_ns(16), 28.0]])
+        assert tr is not None and tr["state"] == "firing"
+        assert dog.firing() == ["preempt_to_bind_p95_ceiling"]
+        # evictions drain, p95 recovers: ONE resolved transition
+        tr = dog.observe(rule, 2.0, _ns(40), active=True)
+        assert tr["state"] == "resolved"
+        assert dog.firing() == []
+        assert [t["state"] for t in dog.transitions] == \
+            ["firing", "resolved"]
+
+    def test_preemption_invariant_rule_fires_on_any_higher_eviction(self):
+        rule = next(r for r in default_churn_rules()
+                    if r.name == "preemption_higher_evictions_zero")
+        dog = SLOWatchdog([rule])
+        assert dog.observe(rule, 0.0, _ns(0)) is None  # invariant holds
+        tr = dog.observe(rule, 1.0, _ns(1))
+        assert tr is not None and tr["state"] == "firing"
 
 
 # -- aggregator multi-pid merge ---------------------------------------------

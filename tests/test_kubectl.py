@@ -412,3 +412,75 @@ def test_rolling_update(cluster, tmp_path):
     names = [rc.metadata.name
              for rc in client.replication_controllers("default").list().items]
     assert "web" not in names, names
+
+
+# ---------------------------------------------------------------------------
+# kube-preempt: PriorityClass get/describe + pod Priority
+# ---------------------------------------------------------------------------
+
+def _mk_priority_classes(client):
+    client.resource("priorityclasses").create(api.PriorityClass(
+        metadata=api.ObjectMeta(name="critical"), value=1000,
+        description="storm tier"))
+    client.resource("priorityclasses").create(api.PriorityClass(
+        metadata=api.ObjectMeta(name="best-effort"), value=-10,
+        global_default=True, preemption_policy=api.PreemptNever))
+
+
+def test_get_priorityclasses_table(cluster):
+    _, client, factory, out, err = cluster
+    _mk_priority_classes(client)
+    assert kubectl(factory, "get", "priorityclasses") == 0, err.getvalue()
+    text = out.getvalue()
+    assert "VALUE" in text and "GLOBAL-DEFAULT" in text \
+        and "PREEMPTIONPOLICY" in text
+    assert "critical" in text and "1000" in text
+    assert "best-effort" in text and "Never" in text and "true" in text
+    # the short alias resolves too
+    out.truncate(0); out.seek(0)
+    assert kubectl(factory, "get", "pc", "critical") == 0, err.getvalue()
+    assert "critical" in out.getvalue()
+
+
+def test_get_priorityclass_json_roundtrips(cluster):
+    _, client, factory, out, err = cluster
+    _mk_priority_classes(client)
+    assert kubectl(factory, "get", "priorityclasses", "critical",
+                   "-o", "json") == 0, err.getvalue()
+    doc = json.loads(out.getvalue())
+    assert doc["kind"] == "PriorityClass"
+    assert doc["value"] == 1000
+
+
+def test_describe_priorityclass(cluster):
+    _, client, factory, out, err = cluster
+    _mk_priority_classes(client)
+    assert kubectl(factory, "describe", "priorityclasses",
+                   "critical") == 0, err.getvalue()
+    text = out.getvalue()
+    assert "Name:\tcritical" in text
+    assert "Value:\t1000" in text
+    assert "PreemptionPolicy:\tPreemptLowerPriority" in text
+    # the short alias canonicalizes for the describer lookup too
+    out.truncate(0); out.seek(0)
+    assert kubectl(factory, "describe", "pc", "critical") == 0, \
+        err.getvalue()
+    assert "Value:\t1000" in out.getvalue()
+
+
+def test_describe_pod_shows_priority(cluster, tmp_path):
+    _, client, factory, out, err = cluster
+    _mk_priority_classes(client)
+    doc = {"kind": "Pod", "apiVersion": "v1",
+           "metadata": {"name": "vip"},
+           "spec": {"containers": [{"name": "c", "image": "img"}],
+                    "priorityClassName": "critical"}}
+    f = tmp_path / "vip.yaml"
+    f.write_text(yaml.safe_dump(doc))
+    assert kubectl(factory, "create", "-f", str(f)) == 0, err.getvalue()
+    out.truncate(0); out.seek(0)
+    assert kubectl(factory, "describe", "pods", "vip") == 0, err.getvalue()
+    text = out.getvalue()
+    # admission resolved the class into the integer priority
+    assert "Priority:\t1000" in text
+    assert "Priority Class Name:\tcritical" in text

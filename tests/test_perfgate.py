@@ -133,6 +133,36 @@ class TestShapeAndBaseline:
         assert pg.shape_key(storm) != clean
         assert pg.shape_key(fan) != pg.shape_key(storm)
 
+    def test_priority_storm_is_its_own_topology_class(self, pg, r10):
+        """kube-preempt: a priority-storm record offers into a FULL
+        cluster — its sustained rate is an evict+bind number and must
+        never baseline-gate the clean 50k/10k series (or vice versa)."""
+        clean = pg.shape_key(r10)
+        pr = copy.deepcopy(r10)
+        pr["priority_storm"] = {"fill_pods": 8000, "storm_pods": 4000}
+        assert pg.shape_key(pr) != clean
+        lag = copy.deepcopy(r10)
+        lag["lag_storm"] = 2
+        assert pg.shape_key(pr) != pg.shape_key(lag)
+        # and the baseline search honors the split: a clean fresh record
+        # must not pick the storm as its best prior even at a higher rate
+        storm_rec = copy.deepcopy(r10)
+        storm_rec["priority_storm"] = {"storm_pods": 1}
+        storm_rec["sustained_pods_per_s"] = 99999.0
+        import json as _json
+        import tempfile, os as _os
+        with tempfile.TemporaryDirectory() as td:
+            for name, rec in (("CHURN_MP_r20_storm.json", storm_rec),
+                              ("CHURN_MP_r21_clean.json", r10)):
+                with open(_os.path.join(td, name), "w") as fh:
+                    _json.dump(rec, fh)
+            fresh = copy.deepcopy(r10)
+            _path, base = pg.find_baseline(fresh, 22, td)
+            assert base is not None
+            assert not base.get("priority_storm")
+            assert base["sustained_pods_per_s"] == \
+                r10["sustained_pods_per_s"]
+
     def test_baseline_is_best_prior_not_latest(self, pg, r10):
         # r10's search space holds r05 (333), r07 (232), r08 (426), r09
         # (453): best == r09's sustained rate, regardless of file order
