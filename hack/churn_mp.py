@@ -556,6 +556,14 @@ TIMELINE_MIN_SERIES = 5
 PREEMPTION_FIELDS = ("attempts", "victims", "conflicts",
                      "higher_evictions", "bind_count", "bind_p50_s",
                      "bind_p95_s")
+# kube-explain evidence, required from r13 on: why-pending visibility.
+# A clean contract run discloses pods: 0 with an empty reason histogram
+# — proving the layer costs nothing when every pod binds — and the
+# async-event-recorder posted/dropped counters ride along so an event
+# storm can never shed diagnostics silently.
+UNSCHEDULABLE_FIELDS = ("pods", "reasons", "explain_invocations",
+                        "explain_seconds", "explain_skipped",
+                        "events_posted", "events_dropped")
 
 
 def validate_record(rec: dict, round_no: int = 8) -> list:
@@ -615,6 +623,16 @@ def validate_record(rec: dict, round_no: int = 8) -> list:
                     f"timeline.series:{len(series)}<{TIMELINE_MIN_SERIES}")
         if not isinstance(rec.get("alarms"), list):
             missing.append("alarms")
+    if round_no >= 13:
+        # r13 introduced kube-explain: the unschedulable section (reason
+        # histogram + explain cost + event-recorder loss disclosure) is
+        # part of the record contract from here on
+        un = rec.get("unschedulable")
+        if not isinstance(un, dict):
+            missing.append("unschedulable")
+        elif "error" not in un:
+            missing += [f"unschedulable.{k}" for k in UNSCHEDULABLE_FIELDS
+                        if k not in un]
     if rec.get("priority_storm"):
         pr = rec.get("preemption")
         if not isinstance(pr, dict):
@@ -739,6 +757,43 @@ def _scrape_preemption(ports) -> dict:
         _hist_quantile(buckets, count, 0.5), 4) if count else None
     out["bind_p95_s"] = round(
         _hist_quantile(buckets, count, 0.95), 4) if count else None
+    return out
+
+
+def _scrape_unschedulable(ports) -> dict:
+    """kube-explain evidence merged across scheduler workers: the
+    unschedulable-pod count, the dominant-reason histogram
+    (scheduler_unschedulable_total{reason=...}), the explain layer's
+    own cost (invocations, CPU seconds, skips), and the async event
+    recorder's posted/dropped disclosure — the record's
+    ``unschedulable`` section (required r13+)."""
+    out = {"pods": 0, "explain_invocations": 0, "explain_seconds": 0.0,
+           "explain_skipped": 0, "events_posted": 0, "events_dropped": 0}
+    reasons: dict = {}
+    for port in ports:
+        raw = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+        for line in raw.splitlines():
+            if not line:
+                continue
+            val = line.rsplit(None, 1)[-1]
+            if line.startswith("scheduler_unschedulable_pods_total "):
+                out["pods"] += int(float(val))
+            elif line.startswith("scheduler_unschedulable_total{"):
+                reason = line.split('reason="', 1)[1].split('"', 1)[0]
+                reasons[reason] = reasons.get(reason, 0) + int(float(val))
+            elif line.startswith("scheduler_explain_invocations_total "):
+                out["explain_invocations"] += int(float(val))
+            elif line.startswith("scheduler_explain_seconds_total "):
+                out["explain_seconds"] += float(val)
+            elif line.startswith("scheduler_explain_skipped_total{"):
+                out["explain_skipped"] += int(float(val))
+            elif line.startswith("event_recorder_posted_total "):
+                out["events_posted"] += int(float(val))
+            elif line.startswith("event_recorder_dropped_total{"):
+                out["events_dropped"] += int(float(val))
+    out["explain_seconds"] = round(out["explain_seconds"], 4)
+    out["reasons"] = reasons
     return out
 
 
@@ -1651,6 +1706,23 @@ def main(argv=None) -> int:
             latency.setdefault("trace_shards", 0)
             latency.setdefault("spans_dropped", 0)
         record["latency"] = latency
+        # kube-explain + event-recorder disclosure (required r13+): a
+        # clean run proves pods: 0 / reasons: {} — the layer costs
+        # nothing when every pod binds; a degraded run carries the
+        # why-pending histogram
+        try:
+            record["unschedulable"] = _scrape_unschedulable(
+                sched_metrics_ports)
+            un = record["unschedulable"]
+            print(f"[churn-mp] unschedulable: {un['pods']} pods "
+                  f"({un['reasons'] or 'none'}), "
+                  f"{un['explain_invocations']} explain invocations "
+                  f"({un['explain_seconds']}s), events "
+                  f"{un['events_posted']} posted / "
+                  f"{un['events_dropped']} dropped",
+                  file=sys.stderr, flush=True)
+        except Exception as e:
+            record["unschedulable"] = {"error": f"scrape failed: {e}"}
         if args.lag_storm:
             # marks the record as an induced-storm shape: perfgate's
             # shape key keeps it out of the clean trajectory's baselines
@@ -1681,7 +1753,7 @@ def main(argv=None) -> int:
                       f"p50/p95 = {pr['bind_p50_s']}/{pr['bind_p95_s']} s",
                       file=sys.stderr, flush=True)
         flush_flightrec(record)
-        missing = validate_record(record, round_no=12)
+        missing = validate_record(record, round_no=13)
         if missing:
             print(f"[churn-mp] WARNING: record missing contract fields: "
                   f"{missing}", file=sys.stderr, flush=True)

@@ -357,6 +357,19 @@ def default_churn_rules(binds_floor: float = 50.0,
         SLORule("preemption_higher_evictions_zero",
                 ("scheduler_preemption_higher_evictions_total",),
                 reduce="last", op="ceil", threshold=0.0, scope="sum"),
+        # kube-explain (models/explain.py): a burst of FailedScheduling
+        # while load is offered means pods are bouncing off a full or
+        # misconfigured cluster faster than they drain — the
+        # unschedulable-rate curve rides the timeline as the
+        # slo:failed_scheduling_burst headline, and the by-reason
+        # breakdown (scheduler_unschedulable_total{reason=...}) in the
+        # record's `unschedulable` section says WHY. A clean contract
+        # run has zero unschedulable pods: the rule stays no-data quiet.
+        SLORule("failed_scheduling_burst",
+                "scheduler_unschedulable_pods_total",
+                reduce="rate", op="ceil", threshold=50.0,
+                window_s=20.0, for_s=10.0, service="scheduler",
+                scope="sum", active_only=True),
     ]
 
 

@@ -11,10 +11,11 @@ from __future__ import annotations
 import datetime
 import threading
 import time
-from collections import deque
-from typing import Any, Dict, Optional, Tuple
+from collections import OrderedDict, deque
+from typing import Any, Optional, Tuple
 
 from kubernetes_tpu.api import types as api
+from kubernetes_tpu.util import metrics
 
 __all__ = ["EventRecorder", "AsyncEventRecorder"]
 
@@ -24,13 +25,33 @@ def _now() -> datetime.datetime:
 
 
 class EventRecorder:
-    def __init__(self, client, source: api.EventSource):
+    # LRU bound on the compression cache (ref: events_cache.go — the
+    # reference caches a bounded window too). The key embeds the full
+    # message, and under 50k-pod churn every FailedScheduling/Scheduled
+    # message embeds a distinct pod name: unbounded, the cache grew one
+    # entry per pod FOREVER — a guaranteed leak in exactly the processes
+    # (scheduler, kubelet) that live for the whole run. Evicting an
+    # entry only costs compression: the next identical event posts fresh
+    # instead of bumping count.
+    _CACHE_MAX = 4096
+
+    def __init__(self, client, source: api.EventSource,
+                 max_cache: int = _CACHE_MAX):
         self.client = client
         self.source = source
         self._lock = threading.Lock()
+        self._max_cache = max_cache
         # compression key -> last written Event (ref: events_cache.go caches
-        # the full object so the bump is a single update round-trip)
-        self._cache: Dict[Tuple, api.Event] = {}
+        # the full object so the bump is a single update round-trip);
+        # LRU via OrderedDict move-to-end on hit, evict-oldest on insert
+        self._cache: "OrderedDict[Tuple, api.Event]" = OrderedDict()
+
+    def _cache_put(self, key: Tuple, ev: api.Event) -> None:
+        with self._lock:
+            self._cache[key] = ev
+            self._cache.move_to_end(key)
+            while len(self._cache) > self._max_cache:
+                self._cache.popitem(last=False)
 
     def _ref(self, obj: Any) -> api.ObjectReference:
         m = obj.metadata
@@ -48,6 +69,8 @@ class EventRecorder:
         try:
             with self._lock:
                 cached = self._cache.get(key)
+                if cached is not None:
+                    self._cache.move_to_end(key)
             if cached is not None:
                 # compression: bump count + lastTimestamp on the cached event
                 try:
@@ -55,8 +78,7 @@ class EventRecorder:
                     cached.last_timestamp = now
                     ev_client = self.client.events(cached.metadata.namespace)
                     out = ev_client.update(cached)
-                    with self._lock:
-                        self._cache[key] = out
+                    self._cache_put(key, out)
                     return out
                 except Exception:
                     # the cached event expired (events carry a TTL) or raced:
@@ -70,8 +92,7 @@ class EventRecorder:
                 involved_object=ref, reason=reason, message=message,
                 source=self.source, first_timestamp=now, last_timestamp=now, count=1)
             out = self.client.events(ev.metadata.namespace).create(ev)
-            with self._lock:
-                self._cache[key] = out
+            self._cache_put(key, out)
             return out
         except Exception:
             return None  # event recording must never break the caller
@@ -106,7 +127,13 @@ class AsyncEventRecorder:
         self._tokens = float(burst)
         self._burst = float(burst)
         self._last = time.monotonic()
+        # `dropped` stays as the legacy attribute (rate-limit drops
+        # only, as before); the registered counter family is the
+        # observable surface — event_recorder_posted_total /
+        # event_recorder_dropped_total{reason} feed /metrics, flightrec,
+        # and the churn record's disclosure
         self.dropped = 0
+        self._mx = metrics.event_recorder_metrics()
         self._worker = threading.Thread(target=self._run, daemon=True,
                                         name="event-recorder")
         self._worker.start()
@@ -120,6 +147,7 @@ class AsyncEventRecorder:
         self._last = now
         if self._tokens < 1.0:
             self.dropped += 1
+            self._mx.dropped.inc("rate_limited")
             return False
         self._tokens -= 1.0
         return True
@@ -128,6 +156,11 @@ class AsyncEventRecorder:
         with self._cond:
             if self._stopped or not self._admit():
                 return
+            if self._q.maxlen is not None and \
+                    len(self._q) == self._q.maxlen:
+                # deque(maxlen) sheds the OLDEST entry on append — count
+                # the loss the storm is about to cause
+                self._mx.dropped.inc("queue_full")
             self._q.append((obj, reason, message_fmt, args))
             self._cond.notify()
 
@@ -141,7 +174,13 @@ class AsyncEventRecorder:
                 obj, reason, fmt, args = self._q.popleft()
                 self._in_flight = 1
             try:
-                self.recorder.eventf(obj, reason, fmt, *args)
+                out = self.recorder.eventf(obj, reason, fmt, *args)
+                if out is not None:
+                    self._mx.posted.inc()
+                else:
+                    # EventRecorder.eventf never raises; None means the
+                    # apiserver write failed — a loss, disclosed
+                    self._mx.dropped.inc("post_failed")
             finally:
                 with self._cond:
                     self._in_flight = 0

@@ -46,7 +46,7 @@ def build_kubelet(opts):
     from kubernetes_tpu.api import types as api
     from kubernetes_tpu.client.client import Client
     from kubernetes_tpu.client.http import HTTPTransport
-    from kubernetes_tpu.client.record import EventRecorder
+    from kubernetes_tpu.client.record import AsyncEventRecorder, EventRecorder
     from kubernetes_tpu.kubelet.config import (ApiserverSource, FileSource,
                                                HTTPSource, PodConfig)
     from kubernetes_tpu.kubelet.kubelet import Kubelet
@@ -63,8 +63,16 @@ def build_kubelet(opts):
 
     hostname = opts.hostname_override or socket.gethostname()
     client = Client(HTTPTransport(opts.api_servers))
-    recorder = EventRecorder(client, api.EventSource(component="kubelet",
-                                                     host=hostname))
+    # async like the scheduler (and the reference's StartRecording
+    # goroutine, event.go:53): the sync loop was posting events
+    # SYNCHRONOUSLY, stalling pod lifecycle on an apiserver round-trip
+    # per event — a slow apiserver turned every container start into a
+    # blocking write. Bounded queue + background worker; drops are
+    # counted (event_recorder_dropped_total), never a stalled sync loop.
+    recorder = AsyncEventRecorder(
+        EventRecorder(client, api.EventSource(component="kubelet",
+                                              host=hostname)),
+        qps=50.0, burst=100)
     # the runtime seam (ref: dockertools): ProcessRuntime runs pods as real
     # local process groups with the native pause sandbox; FakeRuntime is
     # the in-memory double for tests/demos
@@ -169,6 +177,9 @@ def kubelet_server(argv: List[str],
     for src in sources:
         src.stop()
     kubelet.stop()
+    rec = getattr(kubelet, "recorder", None)
+    if rec is not None and hasattr(rec, "stop"):
+        rec.stop()  # drain + join the async posting worker
     return 0
 
 

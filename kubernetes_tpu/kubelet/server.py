@@ -115,7 +115,15 @@ class _Handler(BaseHTTPRequestHandler):
         if head == "portForward":
             return self._handle_port_forward(parts[1:], query)
         if head == "metrics":
-            return self._send(200, ks.metrics.render_text().encode(),
+            body = ks.metrics.render_text()
+            if ks.metrics is not metricspkg.default_registry():
+                # default-registry merge (the apiserver's pattern):
+                # process-wide families — the async event recorder's
+                # posted/dropped counters above all — must ride the
+                # kubelet's scrape too, or its event shedding would be
+                # invisible exactly where events originate
+                body += metricspkg.default_registry().render_text()
+            return self._send(200, body.encode(),
                               "text/plain; version=0.0.4")
         if head == "debug" and len(parts) >= 2 and parts[1] == "pprof":
             # ref: every reference binary mounts pprof (master.go:431-435)

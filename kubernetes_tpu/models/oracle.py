@@ -32,7 +32,7 @@ from kubernetes_tpu.scheduler.listers import (
     FakeServiceLister,
 )
 
-__all__ = ["solve_serial", "preempt_serial"]
+__all__ = ["solve_serial", "preempt_serial", "explain_serial"]
 
 
 def solve_serial(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
@@ -317,3 +317,177 @@ def preempt_serial(nodes: Sequence[api.Node],
                     v.metadata.namespace, api.pod_priority(v))
              for v in victims), key=lambda v: (v.priority, v.uid)))
     return decisions, victim_out
+
+
+# ---------------------------------------------------------------------------
+# kube-explain serial oracle
+# ---------------------------------------------------------------------------
+
+def _rank_key(name: str):
+    """Canonical resource-attribution rank (models/explain.canonical_rank
+    twin): cpu, memory, then lexicographic."""
+    if name == api.ResourceCPU:
+        return (0, "")
+    if name == api.ResourceMemory:
+        return (1, "")
+    return (2, name)
+
+
+def explain_serial(nodes: Sequence[api.Node],
+                   existing_pods: Sequence[api.Pod],
+                   pending_pods: Sequence[api.Pod],
+                   services: Sequence[api.Service] = (),
+                   provider: str = schedplugins.DEFAULT_PROVIDER,
+                   policy: Optional[schedplugins.Policy] = None):
+    """Serial twin of models/explain.explain_wave: decisions via the
+    proven serial rule (:func:`preempt_serial` — normal placement first,
+    lowest-sufficient-prefix preemption when possible), then each
+    unschedulable pod's per-reason node-elimination counts re-derived in
+    plain Python from the object graph against the state its own turn
+    saw. Returns ``(decisions, diags)`` — ``diags[j]`` is None for
+    placed pods, else a ``models.explain.PodDiagnosis``. The batched
+    path (solve + explain_wave over the same wave) must match both
+    bit-for-bit; tests/test_explain.py gates it.
+
+    The attribution contract (one reason per eliminated node, serial
+    short-circuit order; Insufficient-<dim> by canonical rank;
+    overcommitted when only the greedy pre-exceeded flag fails;
+    conservative victim retention for ports/PDs) is defined in
+    models/explain.py — this is its independent implementation.
+    """
+    from kubernetes_tpu.models.explain import (
+        PodDiagnosis,
+        REASON_HOST,
+        REASON_LABEL,
+        REASON_OVERCOMMIT,
+        REASON_PD,
+        REASON_PORT,
+        REASON_SELECTOR,
+        insufficient_reason,
+    )
+    from kubernetes_tpu.models.policy import batch_policy_from
+    from kubernetes_tpu.models.preempt import (
+        band_values_of,
+        preemption_possible,
+    )
+
+    pol = batch_policy_from(provider, policy)
+    decisions, victims = preempt_serial(nodes, existing_pods, pending_pods,
+                                        services, provider, policy)
+    node_order = [n.metadata.name for n in nodes]
+    node_index = {nm: i for i, nm in enumerate(node_order)}
+    caps = {n.metadata.name: _preds.capacity_values(n.spec.capacity)
+            for n in nodes}
+    labels = {n.metadata.name: dict(n.metadata.labels or {}) for n in nodes}
+    extra_ok = {name: True for name in node_order}
+    for name in node_order:
+        for lbls, presence in pol.label_presence:
+            if any((l in labels[name]) != presence for l in lbls):
+                extra_ok[name] = False
+                break
+
+    # wave-start diagnostic state, greedy-walked in existing-list order
+    # (snapshot.greedy_fit_accumulators semantics)
+    fit_used: Dict[str, Dict[str, int]] = {n: {} for n in node_order}
+    exceeded: Dict[str, bool] = {n: False for n in node_order}
+    ports: Dict[str, set] = {n: set() for n in node_order}
+    pds: Dict[str, set] = {n: set() for n in node_order}
+    by_uid: Dict[str, api.Pod] = {}
+    for p in existing_pods:
+        by_uid[p.metadata.uid] = p
+        host = p.status.host
+        if host not in caps:
+            continue
+        cap = caps[host]
+        used = fit_used[host]
+        req = _req_vec(p)
+        if all(_preds.dim_fits(k, cap.get(k, 0),
+                               cap.get(k, 0) - used.get(k, 0), v)
+               for k, v in req.items()):
+            for k, v in req.items():
+                used[k] = used.get(k, 0) + v
+        else:
+            exceeded[host] = True
+        for c in p.spec.containers:
+            for cp in c.ports:
+                if cp.host_port:
+                    ports[host].add(cp.host_port)
+        for v in p.spec.volumes:
+            if v.source.gce_persistent_disk is not None:
+                pds[host].add(v.source.gce_persistent_disk.pd_name)
+
+    gate = preemption_possible(
+        band_values_of(existing_pods, node_index), pending_pods)
+
+    def pod_ports_of(pod: api.Pod) -> set:
+        return {cp.host_port for c in pod.spec.containers
+                for cp in c.ports if cp.host_port}
+
+    def pod_pds_of(pod: api.Pod) -> set:
+        return {v.source.gce_persistent_disk.pd_name
+                for v in pod.spec.volumes
+                if v.source.gce_persistent_disk is not None}
+
+    def diagnose(pod: api.Pod) -> PodDiagnosis:
+        req = _req_vec(pod)
+        zero_req = not any(req.values())
+        p_ports = pod_ports_of(pod)
+        p_pds = pod_pds_of(pod)
+        counts: Dict[str, int] = {}
+
+        def hit(reason: str) -> None:
+            counts[reason] = counts.get(reason, 0) + 1
+
+        for name in node_order:
+            cap = caps[name]
+            used = fit_used[name]
+            if pol.use_ports and p_ports & ports[name]:
+                hit(REASON_PORT)
+                continue
+            if pol.use_resources and not zero_req:
+                bad = [k for k, v in req.items()
+                       if not _preds.dim_fits(
+                           k, cap.get(k, 0),
+                           cap.get(k, 0) - used.get(k, 0), v)]
+                if bad:
+                    hit(insufficient_reason(min(bad, key=_rank_key)))
+                    continue
+                if exceeded[name]:
+                    hit(REASON_OVERCOMMIT)
+                    continue
+            if pol.use_disk and p_pds & pds[name]:
+                hit(REASON_PD)
+                continue
+            if pol.use_selector and pod.spec.node_selector and \
+                    any(labels[name].get(k) != v
+                        for k, v in pod.spec.node_selector.items()):
+                hit(REASON_SELECTOR)
+                continue
+            if pol.use_host and pod.spec.host and pod.spec.host != name:
+                hit(REASON_HOST)
+                continue
+            if not extra_ok[name]:
+                hit(REASON_LABEL)
+        pstate = ""
+        if gate:
+            pstate = "no_prefix" if api.pod_can_preempt(pod) else "Never"
+        return PodDiagnosis(len(node_order), counts, pstate)
+
+    diags: List[Optional[PodDiagnosis]] = []
+    for j, pod in enumerate(pending_pods):
+        host = decisions[j]
+        if host is None:
+            diags.append(diagnose(pod))
+            continue
+        diags.append(None)
+        used = fit_used[host]
+        for v in victims[j] or ():
+            # eviction frees resources only; the victim's ports/PDs are
+            # conservatively retained for the rest of the wave
+            for k, amt in _req_vec(by_uid[v.uid]).items():
+                used[k] = used.get(k, 0) - amt
+        for k, amt in _req_vec(pod).items():
+            used[k] = used.get(k, 0) + amt
+        ports[host] |= pod_ports_of(pod)
+        pds[host] |= pod_pds_of(pod)
+    return decisions, diags

@@ -443,10 +443,30 @@ class EventStrategy(Strategy):
         return validation.validate_event(new)
 
 
+def event_attr_func(ev: api.Event):
+    """Event selectable fields (ref: pkg/registry/event getAttrs /
+    EventToSelectableFields): kubectl describe lists a pod's events with
+    ``involvedObject.name=...,involvedObject.kind=...`` — without these
+    the describe events table silently matched nothing, so the
+    kube-explain FailedScheduling breakdown (and every other event) was
+    invisible to ``kubectl describe pod``."""
+    ref = ev.involved_object
+    return accessor.labels(ev), {
+        "metadata.name": ev.metadata.name,
+        "involvedObject.kind": ref.kind,
+        "involvedObject.namespace": ref.namespace,
+        "involvedObject.name": ref.name,
+        "involvedObject.uid": ref.uid,
+        "reason": ev.reason,
+        "source": ev.source.component,
+    }
+
+
 def make_event_registry(helper: StoreHelper, ttl_seconds: float = 3600.0) -> GenericRegistry:
     """ref: pkg/registry/event/registry.go — events carry an etcd TTL."""
     return GenericRegistry(helper, "/registry/events", api.Event, api.EventList,
-                           EventStrategy(), ttl_func=lambda ev: ttl_seconds)
+                           EventStrategy(), ttl_func=lambda ev: ttl_seconds,
+                           attr_func=event_attr_func)
 
 
 # ---------------------------------------------------------------------------

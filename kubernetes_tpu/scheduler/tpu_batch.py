@@ -63,6 +63,7 @@ from datetime import timezone
 from typing import List, NamedTuple, Optional
 
 from kubernetes_tpu.api import types as api
+from kubernetes_tpu.models import explain as explain_mod
 from kubernetes_tpu.models import gang
 from kubernetes_tpu.models import preempt as preempt_mod
 from kubernetes_tpu.models.batch_solver import decisions_to_names, solve
@@ -162,11 +163,20 @@ class _WaveDecisions(NamedTuple):
     unschedulable) plus, for pods the solver placed VIA PREEMPTION
     (kube-preempt), the concrete victim sets the commit must evict
     atomically with the bind. ``t0`` is the solve-dispatch instant, the
-    start of the preempt-to-bind latency window."""
+    start of the preempt-to-bind latency window.
+
+    ``snap``/``chosen``/``scores`` carry the solved wave's inputs and
+    raw outputs to the loop thread so kube-explain (models/explain.py)
+    can decompose any unschedulable rows against the planes the scan
+    consumed — references only, nothing is copied, and they die with
+    the wave."""
 
     hosts: list
     victims: list           # aligned; None = normal placement
     t0: float = 0.0
+    snap: object = None     # ClusterSnapshot the solve consumed
+    chosen: object = None   # raw [P] node indices (-1 = unschedulable)
+    scores: object = None   # raw [P] score channel (preempt encoding)
 
 
 class _SpecResult(NamedTuple):
@@ -249,6 +259,11 @@ class BatchScheduler:
         # modeler changelog cursor for the O(changed) wave path; None
         # until the first full sync establishes the resident planes
         self._delta_token = None
+        # kube-explain: rate-limited unschedulability diagnosis over the
+        # solved wave's planes (models/explain.py); only consulted when a
+        # wave returns unschedulable pods, so a wave where every pod
+        # binds never pays for it
+        self._explainer = explain_mod.Explainer()
         self._stop = threading.Event()
         # pod-lifecycle latency (always-on metrics; the kube-trace span
         # layer is the opt-in causal complement): bind instants by uid,
@@ -412,7 +427,7 @@ class BatchScheduler:
                         "pods (policy forces the full encoder)")
                 hosts = [None if preempt_mod.is_preempt_score(int(s))
                          else h for h, s in zip(hosts, scores)]
-        return _WaveDecisions(hosts, victims, t0)
+        return _WaveDecisions(hosts, victims, t0, snap, chosen, scores)
 
     def _default_solve(self, nodes, existing, pending, services, tctx=None):
         get_existing = existing if callable(existing) else lambda: existing
@@ -494,18 +509,45 @@ class BatchScheduler:
         for normal placements); unschedulable pods are evented + handed to
         the error handler (backoff + requeue). ``decisions`` is a
         _WaveDecisions, or a bare host-name list from a custom solve_fn
-        (which never preempts)."""
+        (which never preempts).
+
+        kube-explain: when the wave carries its solved snapshot and some
+        pod is unschedulable, the diagnosis layer (rate-limited, loop
+        thread only — models/explain.Explainer) renders the k8s-idiom
+        per-filter breakdown into the FailedScheduling event, replacing
+        the empty-map FitError line. Runs HERE — after the solve result
+        exists and before this wave's commit is submitted — so it never
+        sits inside the pipelined solve/commit overlap window. A
+        declined diagnosis keeps the legacy message; the error handed to
+        the requeue path is unchanged either way."""
         c = self.config
         if isinstance(decisions, _WaveDecisions):
             hosts, victims = decisions.hosts, decisions.victims
         else:
             hosts, victims = decisions, [None] * len(decisions)
+        diag_msgs = {}
+        n_unsched = sum(1 for h in hosts if h is None)
+        if isinstance(decisions, _WaveDecisions) \
+                and decisions.snap is not None and n_unsched:
+            try:
+                diag_msgs = self._explainer.diagnose_wave(
+                    decisions.snap, decisions.chosen, decisions.scores,
+                    n_unsched=n_unsched)
+            except Exception:
+                _log.exception("kube-explain diagnosis failed; falling "
+                               "back to the generic FailedScheduling "
+                               "message")
         placed = []
-        for pod, host, vict in zip(pending, hosts, victims):
+        for row, (pod, host, vict) in enumerate(zip(pending, hosts,
+                                                    victims)):
             if host is None:
                 err = FitError(pod, {})
-                self._record(pod, "FailedScheduling",
-                             "Error scheduling: %s", err)
+                msg = diag_msgs.get(row)
+                if msg is not None:
+                    self._record(pod, "FailedScheduling", "%s", msg)
+                else:
+                    self._record(pod, "FailedScheduling",
+                                 "Error scheduling: %s", err)
                 c.error(pod, err)
             else:
                 placed.append((pod, host, vict))

@@ -19,6 +19,8 @@ __all__ = ["Counter", "Gauge", "Histogram", "Registry", "default_registry",
            "SolverdDeltaMetrics", "solverd_delta_metrics",
            "SolverdMeshMetrics", "solverd_mesh_metrics",
            "PodLatencyMetrics", "pod_latency_metrics",
+           "ExplainMetrics", "explain_metrics",
+           "EventRecorderMetrics", "event_recorder_metrics",
            "FlightRecorder", "flightrec_arm", "flightrec_disarm",
            "flightrec_armed", "flightrec_watch", "flightrec_vars",
            "flightrec_sample_now", "flightrec"]
@@ -462,6 +464,83 @@ def preemption_metrics() -> PreemptionMetrics:
     if PreemptionMetrics._singleton is None:
         PreemptionMetrics._singleton = PreemptionMetrics()
     return PreemptionMetrics._singleton
+
+
+class ExplainMetrics:
+    """kube-explain instrumentation (models/explain.py, consumed by the
+    wave scheduler's FailedScheduling path). Registered HERE so the
+    metrics-sync vet rule binds the churn harness's ``unschedulable``
+    record section and the ``failed_scheduling_burst`` SLO rule to the
+    registry universe.
+
+    Contract: ``scheduler_unschedulable_total{reason=...}`` buckets
+    (one per pod, its DOMINANT node-elimination reason; ``unexplained``
+    when diagnosis was skipped) always sum to
+    ``scheduler_unschedulable_pods_total`` — the unlabeled counter the
+    SLO watchdog and the flightrec headline rate ride on."""
+
+    _singleton = None
+
+    def __init__(self, registry: Optional[Registry] = None):
+        reg = registry or default_registry()
+        self.pods = reg.counter(
+            "scheduler_unschedulable_pods_total",
+            "Pods a solved wave returned unschedulable (each requeue "
+            "that fails again counts again — this is the pending "
+            "pressure signal, not a distinct-pod count)")
+        self.reasons = reg.counter(
+            "scheduler_unschedulable_total",
+            "Unschedulable pods by dominant node-elimination reason "
+            "(kube-explain taxonomy; 'unexplained' = diagnosis skipped)",
+            ("reason",))
+        self.invocations = reg.counter(
+            "scheduler_explain_invocations_total",
+            "Waves diagnosed by kube-explain (rate-limited; a wave "
+            "where every pod binds never invokes it)")
+        self.seconds = reg.counter(
+            "scheduler_explain_seconds_total",
+            "CPU seconds spent in kube-explain diagnosis "
+            "(thread_time on the wave loop thread)")
+        self.skipped = reg.counter(
+            "scheduler_explain_skipped_total",
+            "Waves with unschedulable pods whose diagnosis was "
+            "declined, by reason (rate_limited / unsupported / "
+            "hot_path / error)", ("reason",))
+
+
+def explain_metrics() -> ExplainMetrics:
+    if ExplainMetrics._singleton is None:
+        ExplainMetrics._singleton = ExplainMetrics()
+    return ExplainMetrics._singleton
+
+
+class EventRecorderMetrics:
+    """client/record.AsyncEventRecorder visibility: the ``dropped``
+    attribute used to be a bare int invisible to the metrics-sync vet
+    rule, flightrec, and the churn scrape — an event storm could shed
+    diagnostics with zero disclosure. Posted/dropped are now first-class
+    counters (drops by reason: rate_limited token-bucket rejections,
+    queue_full drop-oldest shedding, post_failed apiserver write
+    failures)."""
+
+    _singleton = None
+
+    def __init__(self, registry: Optional[Registry] = None):
+        reg = registry or default_registry()
+        self.posted = reg.counter(
+            "event_recorder_posted_total",
+            "Events successfully written to the apiserver by the "
+            "async recorder worker")
+        self.dropped = reg.counter(
+            "event_recorder_dropped_total",
+            "Events shed by the async recorder, by reason "
+            "(rate_limited / queue_full / post_failed)", ("reason",))
+
+
+def event_recorder_metrics() -> EventRecorderMetrics:
+    if EventRecorderMetrics._singleton is None:
+        EventRecorderMetrics._singleton = EventRecorderMetrics()
+    return EventRecorderMetrics._singleton
 
 
 # -- kube-flightrec: continuous in-process metric time-series ---------------

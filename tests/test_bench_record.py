@@ -252,6 +252,35 @@ def test_churn_record_schema_timeline_section_gated_by_round():
     assert "alarms" in churn_mp.validate_record(rec, round_no=11)
 
 
+def test_churn_record_schema_unschedulable_section_gated_by_round():
+    """r12 records predate kube-explain; r13+ must carry the
+    unschedulable section (reason histogram, explain cost, and the
+    async-event-recorder posted/dropped disclosure) — a clean run
+    proves pods: 0 instead of omitting the evidence."""
+    churn_mp = _load_churn_mp()
+    rec = _churn_sample_record()
+    rec["solverd"]["mesh"] = {k: 1 for k in churn_mp.SOLVERD_MESH_FIELDS}
+    rec["latency"] = {k: 1 for k in churn_mp.LATENCY_FIELDS}
+    rec["timeline"] = {"sample_period_s": 1.0,
+                       "series": {f"slo:rule{i}": [[0.0, 1.0]]
+                                  for i in range(6)},
+                       "headline": [f"slo:rule{i}" for i in range(6)]}
+    rec["alarms"] = []
+    assert churn_mp.validate_record(rec, round_no=12) == []
+    assert "unschedulable" in churn_mp.validate_record(rec, round_no=13)
+    rec["unschedulable"] = {
+        "pods": 0, "reasons": {}, "explain_invocations": 0,
+        "explain_seconds": 0.0, "explain_skipped": 0,
+        "events_posted": 50_000, "events_dropped": 0,
+    }
+    assert churn_mp.validate_record(rec, round_no=13) == []
+    del rec["unschedulable"]["reasons"]
+    del rec["unschedulable"]["events_dropped"]
+    missing = churn_mp.validate_record(rec, round_no=13)
+    assert "unschedulable.reasons" in missing
+    assert "unschedulable.events_dropped" in missing
+
+
 def test_committed_churn_records_conform():
     """Every committed CHURN_MP record from r07 on must satisfy the
     schema (r08+ additionally the apiserver hot-path fields) — the

@@ -205,6 +205,20 @@ def test_metrics_endpoint(server):
     assert b"kubelet_sync_total" in body
 
 
+def test_metrics_endpoint_merges_default_registry(server):
+    """Process-wide families (the async event recorder's posted/dropped
+    counters) must appear on the kubelet's own /metrics — its private
+    per-server registry alone would hide event shedding exactly where
+    events originate."""
+    from kubernetes_tpu.util import metrics as metricspkg
+    srv, *_ = server
+    metricspkg.event_recorder_metrics()   # register the family
+    status, body = get(srv, "/metrics")
+    assert status == 200
+    assert b"event_recorder_posted_total" in body
+    assert b"event_recorder_dropped_total" in body
+
+
 def test_kubectl_exec_and_port_forward_through_cluster():
     """kubectl exec + port-forward via the kubelet endpoints
     (ref: cmd/exec.go, cmd/portforward.go over the SPDY slot)."""

@@ -122,6 +122,87 @@ def test_async_recorder_stop_is_idempotent_and_rejects_after():
     arec.eventf(mk_pod(), "Scheduled", "ok")   # no-op, no crash
 
 
+def test_recorder_cache_is_lru_bounded():
+    """The compression cache must not grow one entry per unique message
+    forever (50k-pod churn embeds a distinct pod name in every message):
+    bounded LRU, eviction costs only compression."""
+    client, rec = setup()
+    rec._max_cache = 8
+    for i in range(50):
+        rec.eventf(mk_pod(f"p{i}"), "Scheduled", "assigned %s", f"p{i}")
+    assert len(rec._cache) == 8
+    # the newest keys survived; re-posting one bumps count (still cached)
+    rec.eventf(mk_pod("p49"), "Scheduled", "assigned %s", "p49")
+    evs = {e.involved_object.name: e
+           for e in client.events("default").list().items}
+    assert evs["p49"].count == 2
+    # an evicted key posts a fresh object instead of bumping (count 1 on
+    # the new event), and the cache stays at the bound
+    rec.eventf(mk_pod("p0"), "Scheduled", "assigned %s", "p0")
+    assert len(rec._cache) == 8
+    p0_events = [e for e in client.events("default").list().items
+                 if e.involved_object.name == "p0"]
+    assert [e.count for e in p0_events] == [1, 1]
+
+
+def test_recorder_cache_lru_touch_on_hit():
+    """A hot key re-used between inserts is the LAST evicted (true LRU,
+    not FIFO): the scheduler's one steady compressed event survives a
+    storm of one-off messages."""
+    client, rec = setup()
+    rec._max_cache = 4
+    hot = mk_pod("hot")
+    rec.eventf(hot, "Scheduled", "steady")
+    for i in range(10):
+        rec.eventf(mk_pod(f"cold{i}"), "Scheduled", "one-off %d", i)
+        rec.eventf(hot, "Scheduled", "steady")     # touch: moves to MRU
+    evs = [e for e in client.events("default").list().items
+           if e.involved_object.name == "hot"]
+    assert len(evs) == 1 and evs[0].count == 11
+
+
+def test_async_recorder_posted_and_dropped_counters():
+    """The dropped count is a registered metric family now, not a bare
+    attribute: queue_full shedding and rate_limited rejections land in
+    event_recorder_dropped_total{reason}, successes in
+    event_recorder_posted_total — visible to /metrics, flightrec, and
+    the churn record's disclosure."""
+    from kubernetes_tpu.util import metrics
+    mx = metrics.event_recorder_metrics()
+    posted0 = mx.posted.value()
+    qfull0 = mx.dropped.value("queue_full")
+    rl0 = mx.dropped.value("rate_limited")
+
+    client, rec = setup()
+    gate = threading.Event()
+    orig = rec.eventf
+    rec.eventf = lambda *a, **kw: (gate.wait(10.0), orig(*a, **kw))[1]
+    arec = AsyncEventRecorder(rec, max_queue=8)
+    try:
+        for i in range(30):                  # storm >> queue bound
+            arec.eventf(mk_pod(f"m{i}"), "Scheduled", "ok")
+        gate.set()
+        assert arec.flush(timeout=10.0)
+        posted = mx.posted.value() - posted0
+        shed = mx.dropped.value("queue_full") - qfull0
+        assert posted >= 1 and shed >= 1
+        assert posted + shed >= 30 - 1       # worker may hold one in flight
+    finally:
+        gate.set()
+        arec.stop()
+
+    client, rec = setup()
+    arec = AsyncEventRecorder(rec, qps=0.0001, burst=1)
+    try:
+        arec.eventf(mk_pod("a"), "Scheduled", "ok")
+        arec.eventf(mk_pod("b"), "Scheduled", "ok")   # token bucket empty
+        assert arec.flush(timeout=5.0)
+        assert mx.dropped.value("rate_limited") - rl0 == 1
+        assert arec.dropped == 1             # legacy attribute still kept
+    finally:
+        arec.stop()
+
+
 def test_async_recorder_event_qps_token_bucket():
     """Client-side event rate limit (the successor codebases' --event-qps):
     a burst beyond the bucket is dropped without blocking the caller, and
