@@ -4,6 +4,13 @@
 # the way the reference deploys its binaries (ref: cluster/saltbase
 # service layout). Ctrl-C tears everything down.
 #
+# KTPU_DATA_DIR=<dir> makes the cluster CRASH-DURABLE
+# (docs/design/ha.md): a kube-store process owns a DurableStore
+# (WAL + snapshots) on that directory and the apiserver speaks to it
+# over --store-server — kill any process, restart the stack on the same
+# dir, and the cluster resumes with its resourceVersions intact. Empty
+# keeps the historical in-memory in-process store.
+#
 # Usage: cluster/multi-process-up.sh [port]
 
 set -euo pipefail
@@ -16,8 +23,29 @@ cleanup() { kill "${PIDS[@]}" 2>/dev/null || true; }
 trap cleanup EXIT INT TERM
 
 SOLVERD_PORT="${SOLVERD_PORT:-10450}"
+KTPU_DATA_DIR="${KTPU_DATA_DIR:-}"
+STORE_PORT="${STORE_PORT:-2379}"
+STORE_METRICS_PORT="${STORE_METRICS_PORT:-10460}"
 
-python -m kubernetes_tpu.cmd.apiserver --port "${PORT}" &
+if [[ -n "${KTPU_DATA_DIR}" ]]; then
+    mkdir -p "${KTPU_DATA_DIR}"
+    python -m kubernetes_tpu.cmd.storeserver --port "${STORE_PORT}" \
+        --data-dir "${KTPU_DATA_DIR}" \
+        --metrics-port "${STORE_METRICS_PORT}" &
+    PIDS+=($!)
+    # the store must answer before the apiserver's first list
+    for _ in $(seq 1 60); do
+        if (exec 3<>"/dev/tcp/127.0.0.1/${STORE_PORT}") 2>/dev/null; then
+            exec 3>&- 3<&-
+            break
+        fi
+        sleep 0.5
+    done
+    python -m kubernetes_tpu.cmd.apiserver --port "${PORT}" \
+        --store-server "127.0.0.1:${STORE_PORT}" &
+else
+    python -m kubernetes_tpu.cmd.apiserver --port "${PORT}" &
+fi
 PIDS+=($!)
 sleep 1
 python -m kubernetes_tpu.cmd.controller_manager --master "${MASTER}" &

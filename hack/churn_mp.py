@@ -115,7 +115,18 @@ def feed(prefix: str, count: int, rate: float, master: str,
     path). Requests are PIPELINED up to ``depth`` in flight: the send
     side paces at the target rate while a reader thread drains status
     lines, so the offered rate tracks the contract instead of the
-    server's per-request latency."""
+    server's per-request latency.
+
+    kube-chaos restart transparency (docs/design/ha.md): the feeder must
+    never surface a component respawn as a failed run. Responses arrive
+    in request order on one pipelined connection, so the acked prefix is
+    exact — on a connection death or a 5xx (an apiserver worker or
+    kube-store dying mid-call), the feeder reconnects and RESUMES from
+    the first unacked request. Re-sent creates that had in fact applied
+    answer 409; those are tolerated (and counted) only once the feeder
+    is in recovery — a 409 or 4xx on the first pass is still a real bug
+    and aborts. A recovery that makes no progress for 90 s aborts too:
+    retrying forever would hide a dead control plane."""
     import socket
     import threading
     import urllib.parse
@@ -134,90 +145,171 @@ def feed(prefix: str, count: int, rate: float, master: str,
         log_fh = open(replay, "rb")
         log_mm = mmap.mmap(log_fh.fileno(), 0, access=mmap.ACCESS_READ)
         log_mv = memoryview(log_mm)
-    sock = socket.create_connection((u.hostname, u.port))
-    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-
-    done = [0]          # responses seen
-    bad = []            # non-2xx status lines
-    lock = threading.Lock()
-    got_all = threading.Event()
 
     status_re = re.compile(rb"HTTP/1\.1 (\d{3})")
+    acked = [0]         # responses accepted, == the acked request prefix
+    bad = []            # fatal status lines / errors
+    # 409s are tolerated ONLY for request indices below this high-water
+    # mark — exactly the requests a broken stream forced us to re-send.
+    # A blanket "recovering" latch would let a first-pass duplicate-
+    # create bug late in the run masquerade as delivery.
+    tolerate_below = [0]
+    stats = {"reconnects": 0, "retried_conflicts": 0, "retried_5xx": 0}
+    lock = threading.Lock()
 
-    def reader():
-        buf = b""
-        while done[0] < count:
-            try:
-                chunk = sock.recv(1 << 16)
-            except OSError:
-                break
-            if not chunk:
-                break
-            buf += chunk
-            n, last_end = 0, 0
-            for m in status_re.finditer(buf):
-                n += 1
-                last_end = m.end()
-                if m.group(1)[:1] != b"2":
-                    with lock:
-                        bad.append(m.group(1).decode("ascii"))
-            # drop consumed bytes; keep a tail short enough to never lose
-            # a status marker split across chunks, long enough to hold one
-            buf = buf[last_end:]
-            if len(buf) > 16:
-                buf = buf[-16:]
-            done[0] += n
-            if bad:
-                break
-        got_all.set()
-
-    rt = threading.Thread(target=reader, daemon=True)
-    rt.start()
     interval = 1.0 / rate
     t0 = time.perf_counter()
     next_t = t0
     behind_max = 0.0
-    sent = 0
-    for i in range(count):
-        if log_mm is not None:
-            req = log_mv[idx[i]:idx[i + 1]]
-        else:
-            req = _render_request(prefix, i, priority_class)
-        while sent - done[0] >= depth and not bad:
-            time.sleep(0.0005)
-        if bad:
-            break
+    stalled_since = None  # wall deadline for zero-progress recovery
+
+    while acked[0] < count and not bad:
+        base = acked[0]
         try:
-            sock.sendall(req)
+            sock = socket.create_connection((u.hostname, u.port),
+                                            timeout=5.0)
         except OSError as e:
-            with lock:
-                bad.append(f"send: {e}")
+            now = time.monotonic()
+            if stalled_since is None:
+                stalled_since = now
+            if now - stalled_since > 90.0:
+                bad.append(f"connect: {e}")
+                break
+            time.sleep(0.5)
+            continue
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        conn_down = threading.Event()
+
+        def reader(sock=sock, conn_down=conn_down, base=base):
+            buf = b""
+            accepted = 0   # contiguous accepted responses on THIS conn
+            while acked[0] < count:
+                try:
+                    chunk = sock.recv(1 << 16)
+                except OSError:
+                    break
+                if not chunk:
+                    break
+                buf += chunk
+                last_end, poison = 0, False
+                for m in status_re.finditer(buf):
+                    code = m.group(1)
+                    # responses arrive in request order on the pipelined
+                    # connection: this status answers request base+accepted
+                    idx = base + accepted
+                    if code[:1] == b"2":
+                        accepted += 1
+                        last_end = m.end()
+                        continue
+                    if code == b"409" and idx < tolerate_below[0]:
+                        # a RE-SENT create that had applied before the
+                        # outage: the pod exists — counts as delivered.
+                        # A 409 at or past the re-send high-water mark is
+                        # a first-pass duplicate — a real bug, fatal.
+                        with lock:
+                            stats["retried_conflicts"] += 1
+                        accepted += 1
+                        last_end = m.end()
+                        continue
+                    if code[:1] == b"5":
+                        # a component died mid-call (e.g. the store
+                        # behind the apiserver): poison this stream at
+                        # the failed request and resume from it
+                        with lock:
+                            stats["retried_5xx"] += 1
+                        poison = True
+                        break
+                    with lock:
+                        bad.append(code.decode("ascii"))
+                    poison = True
+                    break
+                acked[0] = min(count, base + accepted)
+                if poison:
+                    break
+                # drop consumed bytes; keep a tail short enough to never
+                # lose a status marker split across chunks
+                buf = buf[last_end:]
+                if len(buf) > 16:
+                    buf = buf[-16:]
+            conn_down.set()
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+        rt = threading.Thread(target=reader, daemon=True)
+        rt.start()
+        i = base
+        while i < count and not bad:
+            if log_mm is not None:
+                req = log_mv[idx[i]:idx[i + 1]]
+            else:
+                req = _render_request(prefix, i, priority_class)
+            while i - acked[0] >= depth and not bad \
+                    and not conn_down.is_set():
+                time.sleep(0.0005)
+            if bad or conn_down.is_set():
+                break
+            try:
+                sock.sendall(req)
+            except OSError:
+                break
+            i += 1
+            next_t += interval
+            now = time.perf_counter()
+            behind_max = max(behind_max, now - next_t)
+            if next_t > now:
+                time.sleep(next_t - now)
+        if i >= count:
+            # everything sent on this connection: wait for the acked
+            # prefix to drain (or the connection to die — then resume)
+            deadline = time.monotonic() + 120.0
+            while acked[0] < count and not conn_down.is_set() \
+                    and time.monotonic() < deadline:
+                time.sleep(0.005)
+        try:
+            sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            sock.close()
+        except OSError:
+            pass
+        rt.join(timeout=5.0)
+        if acked[0] >= count or bad:
             break
-        sent += 1
-        next_t += interval
-        now = time.perf_counter()
-        behind_max = max(behind_max, now - next_t)
-        if next_t > now:
-            time.sleep(next_t - now)
-    # drain the remaining in-flight responses
-    drained = got_all.wait(timeout=120.0)
+        # the stream ended short (reconnect, poison, drain timeout, send
+        # error): resume from the acked prefix on a fresh connection;
+        # everything sent on THIS conn (up to index i) may have applied,
+        # so 409s below i are tolerable on the resend
+        tolerate_below[0] = max(tolerate_below[0], i)
+        with lock:
+            stats["reconnects"] += 1
+        if acked[0] > base:
+            stalled_since = None       # progress was made
+        elif stalled_since is None:
+            stalled_since = time.monotonic()
+        elif time.monotonic() - stalled_since > 90.0:
+            bad.append(f"no progress past {acked[0]}/{count} for 90s")
+            break
+
     dt = time.perf_counter() - t0
-    sock.close()
     if bad:
         print(json.dumps({"error": f"create failed: {bad[:3]}",
-                          "created": done[0]}), flush=True)
+                          "created": acked[0], **stats}), flush=True)
         return 1
-    if not drained or done[0] < count:
-        print(json.dumps({"error": f"server acknowledged only {done[0]}"
-                          f"/{count} creates", "created": done[0]}),
-              flush=True)
+    if acked[0] < count:
+        print(json.dumps({"error": f"server acknowledged only {acked[0]}"
+                          f"/{count} creates", "created": acked[0],
+                          **stats}), flush=True)
         return 1
     print(json.dumps({"created": count, "seconds": round(dt, 3),
                       "rate": round(count / dt, 1),
                       "behind_max_s": round(behind_max, 3),
                       # self-reported: /proc is gone by the time the
                       # parent aggregates the per-stage CPU budget
-                      "cpu_s": round(time.process_time(), 3)}), flush=True)
+                      "cpu_s": round(time.process_time(), 3),
+                      **stats}), flush=True)
     return 0
 
 
@@ -556,6 +648,17 @@ TIMELINE_MIN_SERIES = 5
 PREEMPTION_FIELDS = ("attempts", "victims", "conflicts",
                      "higher_evictions", "bind_count", "bind_p50_s",
                      "bind_p95_s")
+# kube-chaos evidence, required whenever a record claims a fault-
+# injected run (a ``chaos`` section present): the declarative kill
+# schedule, what actually got killed (events), per-component restart
+# counts and respawn-to-ready recovery times — plus the ``store``
+# section proving the WAL path (group commits, compactions, byte sizes)
+# and what the LAST recovery of the (possibly respawned) kube-store
+# cost. A chaos claim without these is an anecdote.
+CHAOS_FIELDS = ("schedule", "events", "restarts", "recovery_s")
+STORE_FIELDS = ("wal_records", "wal_ops", "wal_group_commits",
+                "wal_bytes_written", "wal_size", "snapshot_size",
+                "compactions", "torn", "recovery")
 # kube-explain evidence, required from r13 on: why-pending visibility.
 # A clean contract run discloses pods: 0 with an empty reason histogram
 # — proving the layer costs nothing when every pod binds — and the
@@ -640,10 +743,101 @@ def validate_record(rec: dict, round_no: int = 8) -> list:
         elif "error" not in pr:
             missing += [f"preemption.{k}" for k in PREEMPTION_FIELDS
                         if k not in pr]
+    if rec.get("chaos") is not None:
+        ch = rec["chaos"]
+        if not isinstance(ch, dict):
+            missing.append("chaos")
+        else:
+            missing += [f"chaos.{k}" for k in CHAOS_FIELDS if k not in ch]
+        st = rec.get("store")
+        if not isinstance(st, dict):
+            missing.append("store")
+        elif "error" not in st:
+            missing += [f"store.{k}" for k in STORE_FIELDS if k not in st]
     cb = rec.get("cpu_budget_s")
     if cb is not None and not isinstance(cb, dict):
         missing.append("cpu_budget_s:not-a-dict")
     return missing
+
+
+# -- kube-chaos: declarative kill schedule ----------------------------------
+
+_CHAOS_ALIASES = {"store": "storeserver", "kube-store": "storeserver",
+                  "apiserver": "apiserver0", "scheduler": "scheduler0"}
+
+
+def parse_chaos(spec: str) -> list:
+    """``'apiserver@120s,solverd@240s:SIGKILL,scheduler@300s'`` ->
+    ``[{"component", "t_s", "signal"}, ...]`` sorted by time.
+
+    Components name the harness's children: ``apiserverN`` /
+    ``schedulerN`` (bare ``apiserver``/``scheduler`` = worker 0),
+    ``solverd``, ``storeserver`` (aliases ``store``, ``kube-store``).
+    Times are seconds after the offered-load window opens (feeders
+    launch). The default signal is SIGKILL — the chaos contract is
+    crash recovery, not graceful shutdown."""
+    import signal as signal_mod
+    out = []
+    for part in filter(None, (p.strip() for p in spec.split(","))):
+        if "@" not in part:
+            raise ValueError(f"chaos entry {part!r}: expected "
+                             "component@TIME[s][:SIGNAL]")
+        name, _, rest = part.partition("@")
+        t_str, _, sig = rest.partition(":")
+        t_str = t_str.strip().rstrip("s")
+        try:
+            t_s = float(t_str)
+        except ValueError:
+            raise ValueError(
+                f"chaos entry {part!r}: bad time {t_str!r}") from None
+        sig = (sig or "SIGKILL").strip().upper()
+        if not sig.startswith("SIG"):
+            sig = "SIG" + sig
+        if not hasattr(signal_mod, sig):
+            raise ValueError(f"chaos entry {part!r}: unknown signal {sig}")
+        name = _CHAOS_ALIASES.get(name.strip(), name.strip())
+        out.append({"component": name, "t_s": t_s, "signal": sig})
+    return sorted(out, key=lambda e: e["t_s"])
+
+
+def _scrape_store(metrics_port: int) -> dict:
+    """The WAL-path evidence from kube-store's --metrics-port: the
+    ``store_wal_*`` counters (reset by a respawn — the scraped values
+    cover the CURRENT process's life, which for a chaos run is exactly
+    the post-kill story) plus the /healthz recovery disclosure (what the
+    last crash recovery replayed and how long it took)."""
+    raw = urllib.request.urlopen(
+        f"http://127.0.0.1:{metrics_port}/metrics", timeout=5
+    ).read().decode()
+    vals = {}
+    keys = {"store_wal_records_total", "store_wal_ops_total",
+            "store_wal_group_commits_total", "store_wal_fsyncs_total",
+            "store_wal_bytes_total", "store_wal_compactions_total",
+            "store_wal_size_bytes", "store_snapshot_size_bytes",
+            "store_wal_torn_bytes_total"}
+    for line in raw.splitlines():
+        key, _, val = line.rpartition(" ")
+        if key in keys:
+            vals[key] = float(val)
+    out = {
+        "wal_records": int(vals.get("store_wal_records_total", 0)),
+        "wal_ops": int(vals.get("store_wal_ops_total", 0)),
+        "wal_group_commits": int(
+            vals.get("store_wal_group_commits_total", 0)),
+        "wal_fsyncs": int(vals.get("store_wal_fsyncs_total", 0)),
+        "wal_bytes_written": int(vals.get("store_wal_bytes_total", 0)),
+        "compactions": int(vals.get("store_wal_compactions_total", 0)),
+        # record keys carry no _bytes suffix (units documented in
+        # docs/design/ha.md): the metrics-sync vet rule reserves
+        # series-shaped names for real registry series
+        "wal_size": int(vals.get("store_wal_size_bytes", 0)),
+        "snapshot_size": int(vals.get("store_snapshot_size_bytes", 0)),
+        "torn": int(vals.get("store_wal_torn_bytes_total", 0)),
+    }
+    health = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{metrics_port}/healthz", timeout=5).read())
+    out["recovery"] = health.get("recovery", {})
+    return out
 
 
 def _scrape_pod_latency(ports) -> dict:
@@ -908,6 +1102,14 @@ def main(argv=None) -> int:
                     "daemon default): lets sub-floor shapes — e.g. the "
                     "priority-storm cluster — run through the mesh "
                     "executor's device-resident plane path")
+    ap.add_argument("--solver-fallback", "--solver_fallback",
+                    choices=("inprocess", "requeue"), default="inprocess",
+                    help="pass through to every kube-scheduler worker "
+                    "(--solver-fallback): chaos runs that kill solverd "
+                    "use 'requeue' so the outage costs seconds of "
+                    "requeued waves, not minutes of cold in-process "
+                    "compile at full shape — the supervisor respawns "
+                    "the daemon anyway")
     ap.add_argument("--solverd-gather", type=float, default=0.003,
                     help="kube-solverd gather window seconds; raise it "
                     "when several scheduler workers share the daemon so "
@@ -982,6 +1184,39 @@ def main(argv=None) -> int:
     ap.add_argument("--storm-fill-per-node", type=int, default=8,
                     help="template pods per node at exact capacity in "
                     "--priority-storm mode")
+    ap.add_argument("--chaos", default="",
+                    help="kube-chaos kill schedule: comma-separated "
+                    "component@TIME[s][:SIGNAL] entries, e.g. "
+                    "'apiserver@120s,solverd@240s:SIGKILL,"
+                    "scheduler@300s,kube-store@360s'. Times are seconds "
+                    "after the feeders launch; default signal SIGKILL. "
+                    "Every supervised child that dies — scheduled or "
+                    "organic — is respawned, counted, and its "
+                    "respawn-to-ready time recorded; the record gains "
+                    "chaos + store sections and perfgate isolates the "
+                    "+chaos shape from the clean series")
+    ap.add_argument("--store-data-dir", "--store_data_dir", default="",
+                    help="kube-store --data-dir: persist the cluster "
+                    "store (DurableStore WAL + snapshots) so a killed "
+                    "kube-store recovers; with --apiservers 1 the "
+                    "apiserver's in-process store persists instead. A "
+                    "--chaos schedule that kills the store requires it.")
+    ap.add_argument("--store-compact-every", "--store_compact_every",
+                    type=int, default=10_000,
+                    help="kube-store --compact-every (snapshot + WAL "
+                    "truncate period, records)")
+    ap.add_argument("--store-fsync", action="store_true",
+                    help="kube-store --fsync (media-crash durability; "
+                    "default flush-only survives process kill)")
+    ap.add_argument("--warm-max-bucket", "--warm_max_bucket", type=int,
+                    default=1024,
+                    help="largest pow-2 wave bucket compiled during "
+                    "warmup; small harness runs (the chaos e2e test) "
+                    "drop it to skip compiles their shape never uses")
+    ap.add_argument("--bound-timeout", type=float, default=180.0,
+                    help="seconds to wait for all pods bound after the "
+                    "feed; chaos runs need headroom for recovery "
+                    "windows and post-outage backlog")
     ap.add_argument("--port", type=int, default=18410)
     ap.add_argument("--out", default=None)
     ap.add_argument("--platform", choices=["cpu", "ambient"], default="cpu",
@@ -998,12 +1233,148 @@ def main(argv=None) -> int:
     logdir = "/tmp/churn_mp_logs"
     os.makedirs(logdir, exist_ok=True)
 
-    def spawn(name, *cmd, env=None):
-        log = open(os.path.join(logdir, f"{name}.log"), "w")
+    import socket as socket_mod
+    import threading
+
+    # -- kube-chaos supervision (docs/design/ha.md) ---------------------
+    # EVERY control-plane child registers a readiness probe and is
+    # respawned if it dies — scheduled kill or organic crash alike
+    # (generalizing the bespoke solverd supervisor PR 7 shipped).
+    # Restarts and respawn-to-ready times are counted into the record
+    # AND into the parent's own metric registry, which rides the
+    # flightrec timeline as the 'harness' target so the
+    # component_restart / recovery_time_ceiling SLO rules judge the
+    # outages live.
+    supervised = {}       # name -> {"cmd", "env", "ready"}
+    restarts = {}         # name -> respawn count
+    recovery_times = {}   # name -> [respawn-to-ready seconds, ...]
+    recovery_timeouts = {}  # name -> ready-waits that never completed
+    supervise_stop = threading.Event()
+    _spawned_names = set()
+
+    def spawn(name, *cmd, env=None, ready=None):
+        # append on respawn: the pre-kill log is crash evidence
+        mode = "a" if name in _spawned_names else "w"
+        _spawned_names.add(name)
+        log = open(os.path.join(logdir, f"{name}.log"), mode)
         p = subprocess.Popen(cmd, env=env or child_env, stdout=log,
                              stderr=log)
         procs.append((name, p))
+        if ready is not None:
+            supervised[name] = {"cmd": cmd, "env": env or child_env,
+                                "ready": ready}
         return p
+
+    def _tcp_ready(port, deadline_s=60.0):
+        def ready():
+            end = time.monotonic() + deadline_s
+            while time.monotonic() < end and not supervise_stop.is_set():
+                try:
+                    socket_mod.create_connection(
+                        ("127.0.0.1", port), timeout=1.0).close()
+                    return True
+                except OSError:
+                    time.sleep(0.2)
+            return False
+        return ready
+
+    def _http_ready(url, deadline_s=60.0):
+        def ready():
+            end = time.monotonic() + deadline_s
+            while time.monotonic() < end and not supervise_stop.is_set():
+                try:
+                    urllib.request.urlopen(url, timeout=1.0)
+                    return True
+                except Exception:
+                    time.sleep(0.2)
+            return False
+        return ready
+
+    from kubernetes_tpu.util import metrics as metrics_pkg
+    _chaos_mx = metrics_pkg.chaos_metrics()
+
+    _recovering = set()  # names with a ready-wait in flight
+
+    def _await_ready(name, info, t0r):
+        """Readiness watch for one respawn, off the monitor loop: a
+        slow boot (jax import, store recovery) must not head-of-line
+        block the NEXT component's respawn — a schedule that kills the
+        scheduler then kube-store would otherwise leave the store dead
+        behind a 60 s ready-wait."""
+        try:
+            ok_r = info["ready"]()
+            rec_s = time.monotonic() - t0r
+            if ok_r:
+                recovery_times.setdefault(name, []).append(round(rec_s, 2))
+                _chaos_mx.recovery_s.observe(rec_s)
+            elif not supervise_stop.is_set():
+                # a timed-out ready-wait is a FAILED recovery, recorded
+                # as such — logging the probe deadline as a recovery
+                # time would misstate a wedged respawn as a slow one
+                recovery_timeouts[name] = recovery_timeouts.get(name, 0) + 1
+                print(f"[churn-mp] ERROR: respawned {name} never "
+                      f"became ready", file=sys.stderr, flush=True)
+        finally:
+            _recovering.discard(name)
+
+    def _supervise():
+        while not supervise_stop.wait(0.5):
+            for name, info in list(supervised.items()):
+                if name in _recovering:
+                    continue  # its respawn's ready-wait is in flight
+                _n, p = next(np_ for np_ in reversed(procs)
+                             if np_[0] == name)
+                if p.poll() is None:
+                    continue
+                if supervise_stop.is_set():
+                    return  # teardown began after this tick's wait
+                restarts[name] = restarts.get(name, 0) + 1
+                _chaos_mx.restarts.inc()
+                print(f"[churn-mp] WARNING: {name} exited "
+                      f"rc={p.returncode}; respawning "
+                      f"(restart #{restarts[name]})",
+                      file=sys.stderr, flush=True)
+                t0r = time.monotonic()
+                _recovering.add(name)
+                spawn(name, *info["cmd"], env=info["env"],
+                      ready=info["ready"])
+                threading.Thread(
+                    target=_await_ready, args=(name, info, t0r),
+                    daemon=True,
+                    name=f"chaos-ready-{name}").start()
+
+    chaos_events = parse_chaos(args.chaos) if args.chaos else []
+    kill_log = []
+    run_window = threading.Event()  # set while offered load/drain runs
+
+    def _killer(t_base):
+        import signal as signal_mod
+        for ev in chaos_events:
+            delay = t_base + ev["t_s"] - time.monotonic()
+            if delay > 0 and supervise_stop.wait(delay):
+                return
+            if not run_window.is_set():
+                # the run completed (or aborted) before this kill's
+                # time: disclose the skip — a kill landing during the
+                # scrape phase would corrupt evidence, not prove
+                # recovery
+                kill_log.append(dict(ev, skipped="after run window"))
+                continue
+            name = ev["component"]
+            target = next((np_ for np_ in reversed(procs)
+                           if np_[0] == name and np_[1].poll() is None),
+                          None)
+            if target is None:
+                kill_log.append(dict(ev, error="no live process"))
+                continue
+            try:
+                target[1].send_signal(getattr(signal_mod, ev["signal"]))
+                kill_log.append(dict(ev, pid=target[1].pid))
+                print(f"[churn-mp] CHAOS: {ev['signal']} -> {name} "
+                      f"(pid {target[1].pid}) at t+{ev['t_s']:.0f}s",
+                      file=sys.stderr, flush=True)
+            except OSError as e:
+                kill_log.append(dict(ev, error=repr(e)))
 
     def cpu_budget() -> dict:
         """utime+stime per stage for every still-running child — the
@@ -1020,8 +1391,6 @@ def main(argv=None) -> int:
         return agg
 
     flight_agg = None  # the in-run kube-flightrec aggregator
-    solverd_stop = None      # supervisor controls (set when --solverd)
-    solverd_restarts = [0]
 
     def flush_flightrec(record: dict) -> None:
         """Timeline + alarms into the record (and the full-resolution
@@ -1054,6 +1423,59 @@ def main(argv=None) -> int:
             record["timeline"] = {"error": f"flightrec flush failed: {e}"}
             record.setdefault("alarms", [])
 
+    def _chaos_record_sections(record: dict) -> None:
+        """The kube-chaos evidence, on BOTH the success and abort paths
+        (the outage runs are exactly the ones where the restart counts
+        and recovery times matter): the kill schedule + what actually
+        happened, per-component restarts and respawn-to-ready times,
+        feeder recovery stats, and the kube-store WAL/recovery scrape."""
+        if restarts:
+            # organic (unscheduled) deaths are disclosed on every run
+            record.setdefault("component_restarts", dict(restarts))
+        if not args.chaos:
+            if args.store_data_dir and store_metrics_port:
+                try:
+                    record["store"] = _scrape_store(store_metrics_port)
+                except Exception as e:
+                    record["store"] = {"error": f"scrape failed: {e}"}
+            return
+        chaos_sec = {
+            "schedule": args.chaos,
+            "events": list(kill_log),
+            "restarts": {name: restarts.get(name, 0)
+                         for name in sorted(
+                             {e["component"] for e in chaos_events}
+                             | set(restarts))},
+            "recovery_s": {k: list(v)
+                           for k, v in sorted(recovery_times.items())},
+        }
+        if recovery_timeouts:
+            chaos_sec["recovery_timeouts"] = dict(recovery_timeouts)
+        fr = {}
+        for s in stats:
+            if isinstance(s, dict):
+                for k in ("reconnects", "retried_conflicts",
+                          "retried_5xx"):
+                    fr[k] = fr.get(k, 0) + int(s.get(k, 0))
+        chaos_sec["feeders"] = fr
+        record["chaos"] = chaos_sec
+        if store_metrics_port:
+            try:
+                record["store"] = _scrape_store(store_metrics_port)
+            except Exception as e:
+                record["store"] = {"error": f"scrape failed: {e}"}
+        else:
+            # single-apiserver topology: the durable store lives inside
+            # the apiserver; recovery is disclosed via its /healthz
+            try:
+                h = json.loads(urllib.request.urlopen(
+                    f"{master}/healthz", timeout=5).read())
+                record["store"] = {
+                    "error": "in-process store (no kube-store metrics)",
+                    "recovery": h.get("recovery", {})}
+            except Exception as e:
+                record["store"] = {"error": f"healthz failed: {e}"}
+
     api_extra = []
     if args.trace:
         api_extra.append("--trace")
@@ -1061,22 +1483,59 @@ def main(argv=None) -> int:
         api_extra.append("--flightrec")
     if args.watch_lag_limit:
         api_extra += ["--watch-lag-limit", str(args.watch_lag_limit)]
+    store_metrics_port = 0
     try:
+        # chaos schedules may only name components this topology runs
+        valid = {f"apiserver{w}" for w in range(args.apiservers)} \
+            | {f"scheduler{w}" for w in range(args.schedulers)} \
+            | ({"solverd"} if args.solverd else set()) \
+            | ({"storeserver"} if args.apiservers > 1 else set())
+        if args.apiservers == 1:
+            valid.add("apiserver0")  # alias for the single apiserver
+        for ev in chaos_events:
+            if ev["component"] not in valid:
+                raise RuntimeError(
+                    f"--chaos names {ev['component']!r}, which this "
+                    f"topology does not run (valid: {sorted(valid)})")
+        if any(ev["component"] == "storeserver" for ev in chaos_events) \
+                and not args.store_data_dir:
+            raise RuntimeError(
+                "--chaos kills kube-store but --store-data-dir is "
+                "unset: the cluster state would not survive the kill")
         if args.apiservers > 1:
             # reference topology at scale: one store process (etcd analog)
             # + N apiserver workers sharing the port via SO_REUSEPORT
             store_port = args.port + 1
-            spawn("storeserver", PY, "-m", "kubernetes_tpu.cmd.storeserver",
-                  "--port", str(store_port))
+            store_metrics_port = args.port + 2
+            store_cmd = [PY, "-m", "kubernetes_tpu.cmd.storeserver",
+                         "--port", str(store_port),
+                         "--metrics-port", str(store_metrics_port)]
+            if args.store_data_dir:
+                os.makedirs(args.store_data_dir, exist_ok=True)
+                store_cmd += ["--data-dir", args.store_data_dir,
+                              "--compact-every",
+                              str(args.store_compact_every)]
+                if args.store_fsync:
+                    store_cmd.append("--fsync")
+            if args.flightrec:
+                store_cmd.append("--flightrec")
+            spawn("storeserver", *store_cmd,
+                  ready=_tcp_ready(store_port))
             for w in range(args.apiservers):
                 spawn(f"apiserver{w}", PY, "-m",
                       "kubernetes_tpu.cmd.apiserver",
                       "--port", str(args.port), "--reuse-port",
                       "--store-server", f"127.0.0.1:{store_port}",
-                      *api_extra)
+                      *api_extra,
+                      ready=_http_ready(f"{master}/healthz/ping"))
         else:
-            spawn("apiserver", PY, "-m", "kubernetes_tpu.cmd.apiserver",
-                  "--port", str(args.port), *api_extra)
+            api_cmd = [PY, "-m", "kubernetes_tpu.cmd.apiserver",
+                       "--port", str(args.port), *api_extra]
+            if args.store_data_dir:
+                os.makedirs(args.store_data_dir, exist_ok=True)
+                api_cmd += ["--data-dir", args.store_data_dir]
+            spawn("apiserver0", *api_cmd,
+                  ready=_http_ready(f"{master}/healthz/ping"))
         deadline = time.time() + 60
         while time.time() < deadline:
             try:
@@ -1160,48 +1619,20 @@ def main(argv=None) -> int:
                   *(["--flightrec"] if args.flightrec else []),
                   *(["--trace-device", args.trace_device]
                     if args.trace_device else []),
-                  env=sd_env)
+                  env=sd_env,
+                  # supervised like every other child (the bespoke
+                  # solverd respawner PR 7 shipped, generalized): a
+                  # daemon that dies mid-run — scheduled kill or native
+                  # crash — is respawned instead of leaving every
+                  # scheduler in the in-process fallback for the rest
+                  # of the run; the RemoteSolver cooldown reconnects
+                  # within seconds and the delta wire resyncs with one
+                  # full frame. Restarts are DISCLOSED in the record.
+                  ready=_tcp_ready(solverd_port))
             # the daemon must own its socket before any worker's first
             # wave, or every worker starts in the fallback cooldown
-            import socket as _socket
-            sdeadline = time.time() + 30
-            while time.time() < sdeadline:
-                try:
-                    _socket.create_connection(
-                        ("127.0.0.1", solverd_port), timeout=1).close()
-                    break
-                except OSError:
-                    time.sleep(0.2)
-            else:
+            if not _tcp_ready(solverd_port, deadline_s=30.0)():
                 raise RuntimeError("kube-solverd never came up")
-
-            # supervisor: a daemon that dies mid-run (native crashes
-            # included) is respawned instead of leaving every scheduler
-            # in the in-process fallback for the rest of the run — the
-            # RemoteSolver cooldown reconnects within ~5 s and the delta
-            # wire resyncs with one full frame. Restarts are DISCLOSED
-            # in the record (solverd_restarts); a clean run carries 0.
-            import threading as _threading
-            solverd_stop = _threading.Event()
-            solverd_cmd = list(procs[-1][1].args)
-
-            def _supervise_solverd():
-                while not solverd_stop.wait(2.0):
-                    _name, p = next(np_ for np_ in reversed(procs)
-                                    if np_[0] == "solverd")
-                    if p.poll() is None:
-                        continue
-                    if solverd_stop.is_set():
-                        return  # teardown began after this tick's wait
-                    solverd_restarts[0] += 1
-                    print(f"[churn-mp] WARNING: kube-solverd exited "
-                          f"rc={p.returncode}; respawning "
-                          f"(restart #{solverd_restarts[0]})",
-                          file=sys.stderr, flush=True)
-                    spawn("solverd", *solverd_cmd, env=sd_env)
-
-            _threading.Thread(target=_supervise_solverd, daemon=True,
-                              name="solverd-supervisor").start()
 
         sched_metrics_ports = [args.port + 9 + w
                                for w in range(args.schedulers)]
@@ -1211,15 +1642,24 @@ def main(argv=None) -> int:
                    "--wave-period", str(args.wave_period),
                    "--metrics-port", str(sched_metrics_ports[w])]
             if solver_addr:
-                cmd += ["--solver-addr", solver_addr]
+                cmd += ["--solver-addr", solver_addr,
+                        "--solver-fallback", args.solver_fallback]
             if args.pipeline:
                 cmd += ["--pipeline"]
             if args.trace:
                 cmd += ["--trace"]
             if args.flightrec:
                 cmd += ["--flightrec"]
-            spawn(f"scheduler{w}", *cmd)
+            spawn(f"scheduler{w}", *cmd,
+                  ready=_http_ready(f"http://127.0.0.1:"
+                                    f"{sched_metrics_ports[w]}"
+                                    f"/healthz/ping"))
 
+        # every child is registered: the supervisor watches from here
+        threading.Thread(target=_supervise, daemon=True,
+                         name="chaos-supervisor").start()
+
+        harness_port = 0
         if args.flightrec:
             # the live aggregator: discovers every control-plane process
             # (incl. all SO_REUSEPORT apiserver worker pids via the
@@ -1239,6 +1679,21 @@ def main(argv=None) -> int:
                 targets.append({"name": "solverd",
                                 "url": f"http://127.0.0.1:"
                                        f"{solverd_metrics_port}"})
+            if store_metrics_port:
+                # kube-store's WAL/recovery series ride the timeline too
+                targets.append({"name": "storeserver",
+                                "url": f"http://127.0.0.1:"
+                                       f"{store_metrics_port}"})
+            # the harness itself is a target: the supervisor's
+            # component_restarts_total / component_recovery_seconds live
+            # in THIS process's registry, and the SLO rules judging the
+            # outages need them on the merged timeline
+            from kubernetes_tpu.cmd.scheduler import _serve_debug
+            metrics_pkg.flightrec_arm("harness", period_s=1.0)
+            harness_port = args.port + 3
+            _serve_debug(harness_port, service="harness")
+            targets.append({"name": "harness",
+                            "url": f"http://127.0.0.1:{harness_port}"})
             flight_agg = FlightAggregator(
                 targets,
                 rules=default_churn_rules(
@@ -1381,7 +1836,7 @@ def main(argv=None) -> int:
         print("[churn-mp] warmup (compiling wave buckets)...",
               file=sys.stderr, flush=True)
         warm_total = 0
-        size = 1024
+        size = args.warm_max_bucket
         while size >= 1:
             feed(f"warm{size}", size, 100000.0, master)
             warm_total += size
@@ -1451,6 +1906,11 @@ def main(argv=None) -> int:
             # the offered-load window opens: the active-only SLO rules
             # (the sustained-binds floor) start judging from here
             flight_agg.set_active(True)
+        if chaos_events:
+            # the kill schedule's clock starts with the offered load
+            run_window.set()
+            threading.Thread(target=_killer, args=(time.monotonic(),),
+                             daemon=True, name="chaos-killer").start()
         t0 = time.perf_counter()
         feeders = [subprocess.Popen(
             [PY, os.path.abspath(__file__), "--_feed", f"churn{f}",
@@ -1490,6 +1950,7 @@ def main(argv=None) -> int:
         errors = [s["error"] for s in stats
                   if isinstance(s, dict) and "error" in s]
         if abort_err or errors:
+            run_window.clear()
             for f, p in enumerate(feeders):
                 if p.poll() is None:
                     p.terminate()
@@ -1526,6 +1987,7 @@ def main(argv=None) -> int:
                 record["latency"] = _scrape_pod_latency(sched_metrics_ports)
             except Exception as e:
                 record["latency"] = {"error": f"scrape failed: {e}"}
+            _chaos_record_sections(record)
             flush_flightrec(record)
             print(json.dumps(record, indent=1))
             if args.out:
@@ -1552,7 +2014,9 @@ def main(argv=None) -> int:
 
             ok = wait_storm_done()
         else:
-            ok = wait_all_bound(warm_total + args.pods)
+            ok = wait_all_bound(warm_total + args.pods,
+                                timeout=args.bound_timeout)
+        run_window.clear()  # kills from here would corrupt the scrapes
         total_s = time.perf_counter() - t0
         if flight_agg is not None:
             # load window closed: active-only rules stand down (a binds
@@ -1588,6 +2052,11 @@ def main(argv=None) -> int:
         if args.priority_storm:
             sched_desc += (" | PRIORITY STORM: cluster pre-filled to "
                            "capacity, storm binds via atomic evict+bind")
+        if args.chaos:
+            sched_desc += (" | CHAOS: scheduled SIGKILLs + supervised "
+                           "respawns mid-run"
+                           + (" (kube-store on DurableStore)"
+                              if args.store_data_dir else ""))
         budget = cpu_budget()
         budget["feeders"] = round(sum(s.get("cpu_s", 0.0) for s in stats), 2)
         record = {
@@ -1643,7 +2112,7 @@ def main(argv=None) -> int:
                 record["solverd"] = {"error": f"scrape failed: {e}"}
             # supervisor evidence: 0 on a clean run; a respawned daemon
             # (native crash mid-churn) is disclosed, never hidden
-            record["solverd_restarts"] = solverd_restarts[0]
+            record["solverd_restarts"] = restarts.get("solverd", 0)
         if args.pipeline:
             try:
                 pipes = [_scrape_pipeline(p) for p in sched_metrics_ports]
@@ -1752,8 +2221,9 @@ def main(argv=None) -> int:
                       f"evictions (must be 0); preempt-to-bind "
                       f"p50/p95 = {pr['bind_p50_s']}/{pr['bind_p95_s']} s",
                       file=sys.stderr, flush=True)
+        _chaos_record_sections(record)
         flush_flightrec(record)
-        missing = validate_record(record, round_no=13)
+        missing = validate_record(record, round_no=14)
         if missing:
             print(f"[churn-mp] WARNING: record missing contract fields: "
                   f"{missing}", file=sys.stderr, flush=True)
@@ -1764,19 +2234,28 @@ def main(argv=None) -> int:
                 f.write(out + "\n")
         return 0 if ok else 1
     finally:
-        if solverd_stop is not None:
-            solverd_stop.set()  # the supervisor must not respawn a
-            #                     daemon this teardown just terminated
+        supervise_stop.set()  # the supervisor must not respawn a child
+        #                       this teardown just terminated
         for _name, p in list(procs):
             p.terminate()
-        if solverd_stop is not None:
-            # second sweep: a supervisor tick in flight when stop was
-            # set may have appended one last respawn mid-iteration —
-            # nothing this harness started may outlive it
-            time.sleep(0.2)
-            for _name, p in list(procs):
-                if p.poll() is None:
+        if supervised:
+            # sweep until quiescent: a supervisor tick in flight when
+            # stop was set may append one last respawn mid-iteration
+            # (and a slow Popen can land it AFTER a single fixed-delay
+            # second sweep — the leak that held the solverd port against
+            # the next harness run). Nothing this harness started may
+            # outlive it.
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                time.sleep(0.2)
+                live = [p for _n, p in procs if p.poll() is None]
+                if not live:
+                    break
+                for p in live:
                     p.terminate()
+            for _name, p in procs:
+                if p.poll() is None:
+                    p.kill()
 
 
 if __name__ == "__main__":

@@ -81,6 +81,10 @@ def shape_key(rec: dict) -> str:
         suffix += "+lagstorm"
     if rec.get("priority_storm"):
         suffix += "+prioritystorm"
+    if rec.get("chaos"):
+        # kube-chaos runs kill and respawn components mid-run: their
+        # sustained rate measures recovery, not the clean control plane
+        suffix += "+chaos"
     return cfg + suffix
 
 

@@ -370,6 +370,29 @@ def default_churn_rules(binds_floor: float = 50.0,
                 reduce="rate", op="ceil", threshold=50.0,
                 window_s=20.0, for_s=10.0, service="scheduler",
                 scope="sum", active_only=True),
+        # kube-chaos (docs/design/ha.md): a component kill+respawn mid-
+        # run must FIRE while the outage is live and RESOLVE once the
+        # restart-rate window slides clear — the r14 record requires
+        # every outage-driven rule to show both transitions. The
+        # counter lives in the churn harness's supervisor (its own
+        # /debug/vars target), so only a supervised run can ever move
+        # it; active_only keeps teardown kills after the load window
+        # from reading as outages.
+        SLORule("component_restart", "component_restarts_total",
+                reduce="rate", op="ceil", threshold=0.0,
+                window_s=20.0, for_s=0.0, scope="sum",
+                active_only=True),
+        # bounded recovery, live: respawn-to-ready p95 above the
+        # ceiling means the control plane is not actually
+        # crash-durable at this shape (a kube-store replaying an
+        # unbounded WAL, an apiserver worker wedged on a dead store).
+        # Threshold sits below the histogram's 60 s top finite bucket
+        # so an overflow conservatively fires instead of reading
+        # 'no data'.
+        SLORule("recovery_time_ceiling", "component_recovery_seconds",
+                reduce="p95", op="ceil", threshold=45.0,
+                window_s=120.0, for_s=0.0, scope="sum",
+                active_only=True),
     ]
 
 

@@ -1293,6 +1293,14 @@ class APIServer:
             items.append({"name": "store", "status": probe.FAILURE,
                           "message": repr(e)})
             ok = False
+        # kube-chaos recovery disclosure (docs/design/ha.md): when the
+        # backing store is an in-process DurableStore, /healthz carries
+        # what the last crash recovery cost — replayed records, snapshot
+        # age, torn-tail bytes, recovery wall time — so a respawned
+        # apiserver proves "bounded recovery" instead of asserting it
+        # (the remote-store topology discloses the same via kube-store's
+        # own /healthz)
+        recovery = getattr(self.master.store, "recovery", None)
         try:
             w, _translate = self.master.dispatch(
                 "watch_raw", "namespaces", namespace="", label_selector="",
@@ -1305,8 +1313,11 @@ class APIServer:
             items.append({"name": "watch-hub", "status": probe.FAILURE,
                           "message": repr(e)})
             ok = False
-        return ({"kind": "ComponentStatusList", "healthy": ok,
-                 "items": items}, ok)
+        payload: Dict[str, Any] = {"kind": "ComponentStatusList",
+                                   "healthy": ok, "items": items}
+        if recovery is not None:
+            payload["recovery"] = dict(recovery)
+        return payload, ok
 
     # -- kube-flightrec ----------------------------------------------------
 

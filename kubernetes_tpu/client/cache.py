@@ -24,6 +24,7 @@ from kubernetes_tpu.api import errors
 from kubernetes_tpu.api import labels as labels_pkg
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.api.meta import accessor
+from kubernetes_tpu.util.retry import Backoff
 
 __all__ = ["meta_namespace_key_func", "Store", "FIFO", "ListWatch", "Reflector",
            "Poller", "StorePodLister", "StoreNodeLister", "StoreServiceLister"]
@@ -230,7 +231,10 @@ class Reflector:
     list -> Store.replace -> watch(rv) -> apply events, tracking the last seen
     resourceVersion; when the watch ends or the version window expires
     (ErrIndexOutdated / 410 Gone), relist and resume. Crash-only: any error
-    sleeps briefly and starts over (ref: util.Forever usage, reflector.go:84).
+    backs off (capped exponential + jitter, reset on a successful
+    iteration — an apiserver respawn must cost a few retries, not a
+    50 ms hammer loop against a refused port) and starts over
+    (ref: util.Forever usage, reflector.go:84).
     """
 
     def __init__(self, listwatch: ListWatch, store, resync_period: float = 0.0,
@@ -241,6 +245,7 @@ class Reflector:
         self.name = name
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._backoff = Backoff(base=0.05, cap=2.0)
         self.last_sync_resource_version = ""
 
     def run(self) -> "Reflector":
@@ -262,10 +267,14 @@ class Reflector:
         while not self._stop.is_set():
             try:
                 self._list_and_watch()
+                self._backoff.reset()  # listed fine: the source is healthy
             except Exception:
                 if self._stop.is_set():
                     return
-                time.sleep(0.05)
+                # interruptible backoff: stop() during an outage must not
+                # hold the thread for the full capped delay
+                if self._stop.wait(self._backoff.next()):
+                    return
 
     def _list_and_watch(self) -> None:
         lst = self.lw.list_fn()

@@ -15,6 +15,7 @@ import json
 import select
 import socket
 import threading
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -26,6 +27,7 @@ from kubernetes_tpu.api import errors
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.api.latest import scheme as default_scheme
 from kubernetes_tpu.util import tracing
+from kubernetes_tpu.util.retry import Backoff
 
 __all__ = ["HTTPTransport"]
 
@@ -79,7 +81,14 @@ class HTTPTransport:
     def __init__(self, base_url: str, scheme=None, version: str = "",
                  auth: Optional[tuple] = None, timeout: float = 30.0,
                  ca_cert: str = "", client_cert: str = "", client_key: str = "",
-                 insecure_skip_tls_verify: bool = False):
+                 insecure_skip_tls_verify: bool = False,
+                 connect_retry_s: float = 15.0):
+        # restart transparency (docs/design/ha.md): a refused/failed
+        # CONNECT — an apiserver worker mid-respawn — retries with
+        # capped exponential backoff + jitter for up to connect_retry_s
+        # before surfacing. Nothing was sent, so the retry can never
+        # double-execute. 0 disables (fail-fast probes).
+        self.connect_retry_s = connect_retry_s
         self.base_url = base_url.rstrip("/")
         self.scheme = scheme or default_scheme
         self.version = version or test_version_override \
@@ -250,8 +259,25 @@ class HTTPTransport:
             w = tracing.wire()
             if w:
                 headers[tracing.HEADER] = w
+        deadline = time.monotonic() + self.connect_retry_s
+        connect_backoff = Backoff(base=0.05, cap=1.0)
         for attempt in (0, 1):
-            conn = self._conn()
+            while True:
+                try:
+                    conn = self._conn()
+                    break
+                except (ConnectionError, TimeoutError):
+                    # TRANSIENT connect failure (refused/reset/timeout —
+                    # an apiserver worker mid-respawn): no bytes out, so
+                    # retrying is always safe. Permanent failures (DNS
+                    # gaierror, TLS cert verification) fall through and
+                    # surface immediately — backing off on those would
+                    # turn a typo'd --master into a silent 15 s stall.
+                    if self.connect_retry_s <= 0 or \
+                            time.monotonic() + connect_backoff.peek() \
+                            >= deadline:
+                        raise
+                    connect_backoff.sleep_next()
             sent = False
             try:
                 conn.request(method, path, body=body, headers=headers)

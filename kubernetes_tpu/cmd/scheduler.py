@@ -33,6 +33,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "with automatic in-process fallback when the "
                         "daemon is absent, busy, or unhealthy. Empty = "
                         "always solve in-process.")
+    p.add_argument("--solver-fallback", "--solver_fallback",
+                   choices=("inprocess", "requeue"), default="inprocess",
+                   help="tpu-batch with --solver-addr: what a wave does "
+                        "while the daemon is away. 'inprocess' solves "
+                        "locally (correct when nothing will respawn the "
+                        "daemon; at full shape the cold compile can stall "
+                        "the worker for minutes); 'requeue' fails the "
+                        "wave — pods requeue and the next wave retries "
+                        "the daemon, which a supervisor (hack/churn_mp "
+                        "--chaos, docs/design/ha.md) respawns within "
+                        "seconds. CAS-convergent either way.")
     p.add_argument("--pipeline", action="store_true",
                    help="tpu-batch: speculative double-buffered wave "
                         "scheduling — overlap the encode of wave k+1 "
@@ -233,7 +244,9 @@ def build_scheduler(opts):
                             solver_addr=getattr(opts, "solver_addr", ""),
                             pipeline=getattr(opts, "pipeline", False),
                             mesh=getattr(opts, "mesh", "auto"),
-                            pods_axis=getattr(opts, "pods_axis", 1))
+                            pods_axis=getattr(opts, "pods_axis", 1),
+                            solver_fallback=getattr(
+                                opts, "solver_fallback", "inprocess"))
     if getattr(opts, "pipeline", False) and opts.algorithm != "tpu-batch":
         print("kube-scheduler: --pipeline requires --algorithm tpu-batch; "
               "ignoring", file=sys.stderr)

@@ -6,8 +6,16 @@ cmd/kube-apiserver flags --etcd_servers). This is that missing process
 for the rebuild: it owns the one MemStore/DurableStore and serves it to
 any number of apiserver workers over the RemoteStore protocol.
 
+kube-chaos (docs/design/ha.md) grew it an observability sidecar:
+``--metrics-port`` serves /healthz (recovery disclosure: replayed
+records, snapshot age, recovery wall time — the numbers that make
+"bounded recovery" a measured claim), /metrics (the ``store_wal_*``
+family), and /debug/vars (flightrec), so a respawned kube-store proves
+what its recovery cost instead of silently replaying.
+
 Usage: python -m kubernetes_tpu.cmd.storeserver [--port 2379]
-           [--data-dir DIR]
+           [--data-dir DIR] [--fsync] [--compact-every N]
+           [--metrics-port PORT] [--flightrec]
 """
 
 from __future__ import annotations
@@ -26,6 +34,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--data-dir", "--data_dir", default="",
                    help="persist state here (WAL + snapshots); empty = "
                         "in-memory only")
+    p.add_argument("--fsync", action="store_true",
+                   help="fsync(2) every WAL group commit (media-crash "
+                        "durability; default flush-only survives process "
+                        "kill)")
+    p.add_argument("--compact-every", "--compact_every", type=int,
+                   default=10_000,
+                   help="snapshot + truncate the WAL every N records")
+    p.add_argument("--metrics-port", "--metrics_port", type=int, default=0,
+                   help="serve /metrics, /healthz (recovery disclosure) "
+                        "and /debug/vars on this port (0 disables)")
+    p.add_argument("--flightrec", action="store_true",
+                   help="kube-flightrec: sample every metric series into "
+                        "the per-process ring from boot (served at "
+                        "GET /debug/vars on --metrics-port)")
+    p.add_argument("--flightrec-period", "--flightrec_period", type=float,
+                   default=1.0, help="flight recorder sample period, "
+                        "seconds")
     return p
 
 
@@ -40,12 +65,48 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if opts.data_dir:
         from kubernetes_tpu.storage.durable import DurableStore
-        store = DurableStore(opts.data_dir)
+        store = DurableStore(opts.data_dir, fsync=opts.fsync,
+                             compact_every=opts.compact_every)
     else:
         from kubernetes_tpu.storage.memstore import MemStore
         store = MemStore()
+    if opts.flightrec:
+        from kubernetes_tpu.util import metrics as metrics_pkg
+        metrics_pkg.flightrec_arm(
+            "storeserver", period_s=opts.flightrec_period)
+    if opts.metrics_port:
+        from kubernetes_tpu import probe
+        from kubernetes_tpu.cmd.scheduler import _serve_debug
+
+        def health():
+            payload = {
+                "kind": "ComponentStatusList", "healthy": True,
+                "items": [{"name": "store", "status": probe.SUCCESS,
+                           "message": f"{type(store).__name__} serving "
+                                      f"index {store.index}"}],
+            }
+            recovery = getattr(store, "recovery", None)
+            if recovery is not None:
+                payload["recovery"] = dict(recovery)
+                payload["data_dir"] = opts.data_dir
+            return payload, True
+
+        _serve_debug(opts.metrics_port, service="storeserver",
+                     health=health)
     srv = StoreServer(store, host=opts.address, port=opts.port)
+    # the "listening" line FIRST — harness readiness checks key on it;
+    # the recovery disclosure follows (and stays on /healthz forever)
     print(f"kube-store listening on {srv.address}", flush=True)
+    recovery = getattr(store, "recovery", None)
+    if recovery is not None:
+        print(f"kube-store recovered {opts.data_dir}: "
+              f"{recovery['replayed_records']} WAL records "
+              f"({recovery['replayed_ops']} ops) replayed in "
+              f"{recovery['recovery_s']}s, snapshot "
+              + (f"age {recovery['snapshot_age_s']}s"
+                 if recovery["snapshot"] else "absent")
+              + (f", torn tail {recovery['torn_bytes']}B discarded"
+                 if recovery["torn_bytes"] else ""), flush=True)
     try:
         srv.serve_forever()
     except KeyboardInterrupt:

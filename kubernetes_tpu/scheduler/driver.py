@@ -165,6 +165,16 @@ class SchedulerConfig:
     # Recorded here (not on the driver) so any wave-capable driver built
     # from this config inherits the cluster's solver topology.
     solver_addr: str = ""
+    # What a wave does when the daemon is away (kube-scheduler
+    # --solver-fallback): "inprocess" solves the wave locally (the
+    # original degradation ladder — correct when no supervisor will
+    # bring the daemon back, but at full shape the cold in-process
+    # compile can stall the worker for minutes), "requeue" fails the
+    # wave instead — every pod requeues through the error handler and
+    # the next wave retries the daemon, which a kube-chaos supervisor
+    # respawns within seconds (docs/design/ha.md). CAS-convergent
+    # either way.
+    solver_fallback: str = "inprocess"
     # Speculative double-buffered wave scheduling (kube-scheduler
     # --pipeline): overlap the encode of wave k+1 with the solve/commit of
     # wave k. Decisions stay bit-identical to the causal path — the
@@ -308,8 +318,8 @@ class ConfigFactory:
                algorithm_override=None,
                recorder: Optional[EventRecorder] = None,
                solver_addr: str = "", pipeline: bool = False,
-               mesh: str = "auto", pods_axis: int = 1
-               ) -> SchedulerConfig:
+               mesh: str = "auto", pods_axis: int = 1,
+               solver_fallback: str = "inprocess") -> SchedulerConfig:
         """ref: factory.go:77-172 CreateFromProvider/CreateFromConfig/
         CreateFromKeys."""
         # reflector: unassigned pods -> FIFO (field selector spec.host=)
@@ -360,6 +370,7 @@ class ConfigFactory:
             provider=provider,
             policy=policy,
             solver_addr=solver_addr,
+            solver_fallback=solver_fallback,
             pipeline=pipeline,
             mesh=mesh,
             pods_axis=pods_axis,

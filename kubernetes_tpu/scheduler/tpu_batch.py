@@ -230,7 +230,10 @@ class BatchScheduler:
         addr = getattr(config, "solver_addr", "")
         if solver is None and addr:
             from kubernetes_tpu.solver.client import RemoteSolver
-            solver = RemoteSolver(addr)
+            solver = RemoteSolver(
+                addr,
+                fallback=getattr(config, "solver_fallback",
+                                 "inprocess") != "requeue")
         self.solver = solver
         # speculative double-buffered wave loop (module docstring); None
         # inherits the config's recorded --pipeline flag

@@ -40,6 +40,7 @@ import numpy as np
 from kubernetes_tpu.models.policy import BatchPolicy
 from kubernetes_tpu.solver import protocol
 from kubernetes_tpu.util import tracing
+from kubernetes_tpu.util.retry import Backoff
 
 __all__ = ["RemoteSolver", "SolverBusy", "SolverUnavailable"]
 
@@ -91,6 +92,14 @@ class RemoteSolver:
         self._local = threading.local()
         self._lock = threading.Lock()
         self._unhealthy_until = 0.0
+        # exponential cooldown: a daemon mid-respawn costs a retry after
+        # ~cooldown_s/8, doubling (jittered) to the cooldown_s cap while
+        # it stays dead — reconnecting within seconds of a kube-chaos
+        # respawn instead of always paying the full fixed cooldown,
+        # while a permanently-dead daemon still costs one connect per
+        # cap. Reset on the first successful remote wave.
+        self._cooldown = Backoff(base=max(0.25, cooldown_s / 8.0),
+                                 cap=max(0.25, cooldown_s))
         # visible in tests and the scheduler's /metrics narrative
         self.remote_waves = 0
         self.fallback_waves = 0
@@ -156,7 +165,12 @@ class RemoteSolver:
 
     def _mark_unhealthy(self) -> None:
         with self._lock:
-            self._unhealthy_until = time.monotonic() + self.cooldown_s
+            self._unhealthy_until = time.monotonic() + self._cooldown.next()
+
+    def _mark_healthy(self) -> None:
+        with self._lock:
+            self._unhealthy_until = 0.0
+            self._cooldown.reset()
 
     def ping(self) -> dict:
         """Daemon health + version handshake; raises SolverUnavailable."""
@@ -334,6 +348,7 @@ class RemoteSolver:
             return solve_in_process(snap, host=host,
                                     mesh=self.fallback_mesh)
         self.remote_waves += 1
+        self._mark_healthy()  # the daemon answered: cooldown resets
         if gangs:
             chosen = gang.apply_all_or_nothing(snap.pod_rid, chosen)
             scores = np.where(chosen < 0, np.int32(NEG), scores)

@@ -10,6 +10,13 @@ the persistence option behind the SAME contract:
   ``_record_locked`` — the single choke point — and is appended to
   ``wal.log`` as one JSON line under the store lock, so the WAL order IS
   the index order;
+- the batched verbs GROUP-COMMIT (docs/design/ha.md): ``txn_many`` seals
+  every op of one atomic evict+bind item into ONE WAL record
+  (``{"txn": [op, ...]}``), so a crash can never resurrect half a
+  transaction on replay, and the whole call's records land in one
+  write+flush(+fsync) — one durability syscall per wave instead of one
+  per op; ``compare_and_swap_many`` keeps per-op records but shares the
+  single flush;
 - ``snapshot.json`` is written atomically (tmp + rename) every
   ``compact_every`` WAL records, then the WAL restarts; a crash between
   the two is safe because replay skips entries at or below the snapshot
@@ -19,9 +26,17 @@ the persistence option behind the SAME contract:
   as wall-clock, rebased to the store clock on load), and the bounded
   watch-history window all come back — so reflectors resume from their
   pre-crash resourceVersion without relisting, and CAS against a
-  pre-crash resourceVersion behaves identically;
-- durability level: flush-per-record by default (survives process kill);
-  ``fsync=True`` for media-crash durability at a syscall per write.
+  pre-crash resourceVersion behaves identically. A torn final record (a
+  crash mid-append) is truncated and disclosed, never a crash loop;
+- recovery is DISCLOSED, not silent: ``self.recovery`` carries replayed
+  record/op counts, snapshot age, torn-tail bytes, and the recovery wall
+  time; the same numbers ride the ``store_wal_*`` / ``store_recovery_*``
+  metric families (util/metrics.StoreWalMetrics) so kube-store's
+  /healthz and the chaos churn record can prove "bounded recovery"
+  instead of asserting it;
+- durability level: flush-per-group-commit by default (survives process
+  kill); ``fsync=True`` for media-crash durability at a syscall per
+  group.
 
 Wire-in: ``Master(MasterConfig(store=DurableStore(dir)))`` — nothing else
 in the stack knows persistence exists.
@@ -34,9 +49,11 @@ import json
 import logging
 import os
 import time
-from typing import Callable, Optional
+from typing import Callable, List, Optional
 
 from kubernetes_tpu.storage.memstore import KV, MemStore, StoreEvent
+from kubernetes_tpu.util import chaos
+from kubernetes_tpu.util import metrics as metrics_pkg
 
 __all__ = ["DurableStore"]
 
@@ -65,11 +82,20 @@ class DurableStore(MemStore):
         self._fsync = fsync
         self._compact_every = compact_every
         self._wal_records = 0
+        self._wal_bytes = 0
         self._wal_f = None  # set after recovery; _record_locked no-ops until
+        # group-commit state: None outside a batched verb; a list of op
+        # entries for the item being applied while inside one
+        self._txn_buf: Optional[List[dict]] = None
+        self._txn_lines: List[str] = []
+        self._txn_ops = 0
+        self._mx = metrics_pkg.store_wal_metrics()
         os.makedirs(directory, exist_ok=True)
         self._recover()
         self._wal_f = open(os.path.join(directory, _WAL), "a",
                            encoding="utf-8")
+        self._wal_bytes = os.path.getsize(os.path.join(directory, _WAL))
+        self._mx.wal_size.set(self._wal_bytes)
         # carry the replayed record count into the compaction budget (and
         # compact now if the inherited WAL already exceeds it): otherwise a
         # frequently-restarted server never snapshots and the WAL — and
@@ -90,21 +116,83 @@ class DurableStore(MemStore):
             return None
         return self._clock() + (exp_wall - self._wall())
 
-    def _record_locked(self, ev: StoreEvent) -> None:
-        super()._record_locked(ev)  # watchers + history first
-        if self._wal_f is None:
-            return  # replaying recovery
+    def _entry_of(self, ev: StoreEvent) -> dict:
         entry = {"a": ev.action, "k": ev.key, "i": ev.index}
         if ev.kv is not None:
             entry["v"] = ev.kv.value
             entry["c"] = ev.kv.created_index
             if ev.kv.expiration is not None:
                 entry["e"] = self._exp_to_wall(ev.kv.expiration)
-        self._wal_f.write(json.dumps(entry) + "\n")
+        return entry
+
+    def _record_locked(self, ev: StoreEvent) -> None:
+        super()._record_locked(ev)  # watchers + history first
+        if self._wal_f is None:
+            return  # replaying recovery
+        entry = self._entry_of(ev)
+        if self._txn_buf is not None:
+            # inside a batched verb: buffer; the boundary seals the item
+            # into one record and the commit writes the whole call once
+            self._txn_buf.append(entry)
+            self._txn_ops += 1
+            return
+        self._wal_append_locked([json.dumps(entry)], ops=1)
+
+    # -- group commit (the batched-verb hooks) ------------------------------
+    def _txn_begin_locked(self) -> None:
+        if self._wal_f is None:
+            return
+        self._txn_buf = []
+        self._txn_lines = []
+        self._txn_ops = 0
+
+    def _txn_boundary_locked(self) -> None:
+        buf = self._txn_buf
+        if not buf:
+            return  # outside a batch, or the item recorded nothing
+        # one line per atomic unit: a single-op unit keeps the serial
+        # verbs' record format (replay-compatible with pre-group WALs);
+        # a multi-op unit becomes a txn record — all-or-nothing by
+        # construction, because a JSON line either parses or is torn
+        line = json.dumps(buf[0]) if len(buf) == 1 \
+            else json.dumps({"txn": buf})
+        self._txn_lines.append(line)
+        self._txn_buf = []
+
+    def _txn_commit_locked(self) -> None:
+        if self._wal_f is None:
+            self._txn_buf = None
+            return
+        self._txn_boundary_locked()  # seal a dangling unit defensively
+        lines, ops = self._txn_lines, self._txn_ops
+        self._txn_buf = None
+        self._txn_lines = []
+        self._txn_ops = 0
+        if lines:
+            self._wal_append_locked(lines, ops=ops)
+
+    def _wal_append_locked(self, lines: List[str], ops: int) -> None:
+        """The ONLY writer of WAL bytes: one write+flush(+fsync) per
+        call — per op for the serial verbs, per wave for the batched
+        ones. The chaos crash points bracket the physical append so the
+        WAL atomicity tests can kill the store exactly where SIGKILL
+        would land (before the append: nothing durable; after: every
+        sealed record durable — never a fraction of one)."""
+        chaos.crash_if_armed("durable.wal_append.pre")
+        data = "\n".join(lines) + "\n"
+        self._wal_f.write(data)
         self._wal_f.flush()
         if self._fsync:
             os.fsync(self._wal_f.fileno())
-        self._wal_records += 1
+            self._mx.fsyncs.inc()
+        chaos.crash_if_armed("durable.wal_append.post")
+        self._wal_records += len(lines)
+        self._wal_bytes += len(data)
+        self._mx.records.inc(by=len(lines))
+        self._mx.ops.inc(by=ops)
+        self._mx.group_commits.inc()
+        self._mx.bytes_written.inc(by=len(data))
+        self._mx.wal_size.set(self._wal_bytes)
         if self._wal_records >= self._compact_every:
             self._compact_locked()
 
@@ -148,6 +236,11 @@ class DurableStore(MemStore):
         self._wal_f = open(os.path.join(self._dir, _WAL), "w",
                            encoding="utf-8")
         self._wal_records = 0
+        self._wal_bytes = 0
+        self._mx.compactions.inc()
+        self._mx.wal_size.set(0)
+        self._mx.snapshot_size.set(
+            os.path.getsize(os.path.join(self._dir, _SNAP)))
 
     def compact(self) -> None:
         """Force a snapshot + WAL truncation (tests, shutdown hooks)."""
@@ -180,11 +273,35 @@ class DurableStore(MemStore):
         if len(self._history) > self.HISTORY_WINDOW:
             del self._history[: len(self._history) - self.HISTORY_WINDOW]
 
+    def _replay_record(self, d: dict) -> int:
+        """Apply one WAL record (a serial op, or a txn group whose ops
+        land all together — the record parsed, so the whole item is
+        here). Returns the op count."""
+        if "txn" in d:
+            ops = 0
+            for e in d["txn"]:
+                if e["i"] <= self._snap_index_guard:
+                    continue  # pre-snapshot entry (crash mid-compact)
+                self._apply_entry(e)
+                ops += 1
+            return ops
+        if d["i"] <= self._snap_index_guard:
+            return 0
+        self._apply_entry(d)
+        return 1
+
     def _recover(self) -> None:
+        t0 = time.perf_counter()
         self._snap_index_guard = 0
         self._recovered_records = 0
+        recovered_ops = 0
+        snapshot_age_s = 0.0
+        torn_bytes = 0
         snap_path = os.path.join(self._dir, _SNAP)
         if os.path.exists(snap_path):
+            snapshot_age_s = max(0.0, self._wall()
+                                 - os.path.getmtime(snap_path))
+            self._mx.snapshot_size.set(os.path.getsize(snap_path))
             with open(snap_path, encoding="utf-8") as f:
                 snap = json.load(f)
             # clamp to the base-1 floor: a snapshot written by a pre-base-1
@@ -206,44 +323,61 @@ class DurableStore(MemStore):
                     self._kv_from_dict(d.get("kv")),
                     self._kv_from_dict(d.get("pv"))))
         wal_path = os.path.join(self._dir, _WAL)
-        if not os.path.exists(wal_path):
-            return
-        with open(wal_path, "rb") as f:
-            data = f.read()
-        good_end = 0
-        bad_at = None
-        pos = 0
-        for raw in data.splitlines(keepends=True):
-            line = raw.strip()
-            pos += len(raw)
-            if not line:
+        if os.path.exists(wal_path):
+            with open(wal_path, "rb") as f:
+                data = f.read()
+            good_end = 0
+            bad_at = None
+            pos = 0
+            for raw in data.splitlines(keepends=True):
+                line = raw.strip()
+                pos += len(raw)
+                if not line:
+                    good_end = pos
+                    continue
+                try:
+                    d = json.loads(line)
+                except ValueError:
+                    bad_at = pos - len(raw)
+                    break  # torn/corrupt record: stop replay at the last good one
                 good_end = pos
-                continue
-            try:
-                d = json.loads(line)
-            except ValueError:
-                bad_at = pos - len(raw)
-                break  # torn/corrupt record: stop replay at the last good one
-            good_end = pos
-            self._recovered_records += 1
-            if d["i"] <= self._snap_index_guard:
-                continue  # pre-snapshot entry (crash mid-compact)
-            self._apply_entry(d)
-        if bad_at is not None:
-            # Truncate to the last good record: reopening in append mode
-            # would otherwise weld the next write onto the torn fragment,
-            # and the NEXT restart would discard that merged line plus
-            # everything after it (silent data loss + index regression).
-            discarded = len(data) - good_end
-            tail = data[good_end:]
-            # a parseable line after the bad one means mid-file corruption,
-            # not a crash-torn tail — surface it loudly either way
-            midfile = any(_parses(l) for l in tail.splitlines()[1:])
-            _log.error(
-                "WAL %s: unparseable record at byte %d; discarding %d "
-                "trailing bytes (%s) and truncating to last good record",
-                wal_path, bad_at, discarded,
-                "MID-FILE CORRUPTION — parseable records were lost"
-                if midfile else "torn tail from a crash")
-            with open(wal_path, "r+b") as f:
-                f.truncate(good_end)
+                self._recovered_records += 1
+                recovered_ops += self._replay_record(d)
+            if bad_at is not None:
+                # Truncate to the last good record: reopening in append mode
+                # would otherwise weld the next write onto the torn fragment,
+                # and the NEXT restart would discard that merged line plus
+                # everything after it (silent data loss + index regression).
+                discarded = len(data) - good_end
+                torn_bytes = discarded
+                tail = data[good_end:]
+                # a parseable line after the bad one means mid-file corruption,
+                # not a crash-torn tail — surface it loudly either way
+                midfile = any(_parses(l) for l in tail.splitlines()[1:])
+                _log.error(
+                    "WAL %s: unparseable record at byte %d; discarding %d "
+                    "trailing bytes (%s) and truncating to last good record",
+                    wal_path, bad_at, discarded,
+                    "MID-FILE CORRUPTION — parseable records were lost"
+                    if midfile else "torn tail from a crash")
+                with open(wal_path, "r+b") as f:
+                    f.truncate(good_end)
+        recovery_s = time.perf_counter() - t0
+        # the disclosure contract (docs/design/ha.md): what recovery did,
+        # visible to /healthz (kube-store, apiserver) and the chaos churn
+        # record — a store that silently replayed for 40 s is a wall, not
+        # an implementation detail
+        self.recovery = {
+            "replayed_records": self._recovered_records,
+            "replayed_ops": recovered_ops,
+            "snapshot": os.path.exists(snap_path),
+            "snapshot_age_s": round(snapshot_age_s, 3),
+            "torn_bytes": torn_bytes,
+            "recovery_s": round(recovery_s, 4),
+            "index": self._index,
+        }
+        self._mx.recovery_s.observe(recovery_s)
+        self._mx.replayed.set(self._recovered_records)
+        self._mx.snapshot_age.set(snapshot_age_s)
+        if torn_bytes:
+            self._mx.torn_bytes.inc(by=torn_bytes)

@@ -163,6 +163,26 @@ class MemStore:
             if _match(ev.key, prefix, recursive):
                 w.send(watchpkg.Event(ev.action, ev))
 
+    # -- transaction/group persistence hooks --------------------------------
+    # No-ops here; DurableStore overrides them to group-commit the WAL.
+    # The batched verbs bracket their apply phases so a persistent store
+    # can (a) make each txn item ATOMIC on disk — every op of one
+    # evict+bind lands in ONE WAL record, so a crash can never resurrect
+    # half a transaction on replay — and (b) write the whole call's
+    # records in one append+flush instead of one flush per op (the
+    # N-fsyncs-per-wave group commit).
+
+    def _txn_begin_locked(self) -> None:
+        """A batched verb's apply phase begins (lock held)."""
+
+    def _txn_boundary_locked(self) -> None:
+        """One atomic unit's ops are complete (lock held): everything
+        recorded since the last boundary must persist all-or-nothing."""
+
+    def _txn_commit_locked(self) -> None:
+        """The batched verb is done (lock held): persist every sealed
+        unit with one physical write+flush."""
+
     # -- reads -------------------------------------------------------------
     @property
     def index(self) -> int:
@@ -271,27 +291,35 @@ class MemStore:
         out: List[object] = []
         with self._lock:
             self._sweep_locked()
-            for key, value, prev_index in items:
-                try:
-                    self._maybe_raise("compare_and_swap", key)
-                except StoreError as e:
-                    out.append(e)
-                    continue
-                prev = self._data.get(key)
-                if prev is None:
-                    out.append(ErrKeyNotFound(key))
-                    continue
-                if prev.modified_index != prev_index:
-                    out.append(ErrCASConflict(
-                        f"{key}: index mismatch (have {prev.modified_index}, "
-                        f"want {prev_index})"))
-                    continue
-                self._index += 1
-                kv = KV(key, value, prev.created_index, self._index, None)
-                self._data[key] = kv
-                self._record_locked(
-                    StoreEvent("compareAndSwap", key, self._index, kv, prev))
-                out.append(kv)
+            self._txn_begin_locked()
+            try:
+                for key, value, prev_index in items:
+                    try:
+                        self._maybe_raise("compare_and_swap", key)
+                    except StoreError as e:
+                        out.append(e)
+                        continue
+                    prev = self._data.get(key)
+                    if prev is None:
+                        out.append(ErrKeyNotFound(key))
+                        continue
+                    if prev.modified_index != prev_index:
+                        out.append(ErrCASConflict(
+                            f"{key}: index mismatch (have "
+                            f"{prev.modified_index}, want {prev_index})"))
+                        continue
+                    self._index += 1
+                    kv = KV(key, value, prev.created_index, self._index, None)
+                    self._data[key] = kv
+                    self._record_locked(StoreEvent(
+                        "compareAndSwap", key, self._index, kv, prev))
+                    # each CAS is its own atomic unit (per-op records on
+                    # disk, exactly as the serial verb writes them); the
+                    # commit below still flushes the wave ONCE
+                    self._txn_boundary_locked()
+                    out.append(kv)
+            finally:
+                self._txn_commit_locked()
         return out
 
     def txn_many(self, items: List[Tuple[List[Tuple[str, str, int]],
@@ -311,11 +339,35 @@ class MemStore:
         out: List[object] = []
         with self._lock:
             self._sweep_locked()
-            for cas_ops, delete_ops in items:
-                err: Optional[StoreError] = None
-                for key, _value, prev_index in cas_ops:
+            self._txn_begin_locked()
+            try:
+                self._txn_many_locked(items, out)
+            finally:
+                self._txn_commit_locked()
+        return out
+
+    def _txn_many_locked(self, items, out: List[object]) -> None:
+        for cas_ops, delete_ops in items:
+            err: Optional[StoreError] = None
+            for key, _value, prev_index in cas_ops:
+                try:
+                    self._maybe_raise("compare_and_swap", key)
+                except StoreError as e:
+                    err = e
+                    break
+                prev = self._data.get(key)
+                if prev is None:
+                    err = ErrKeyNotFound(key)
+                    break
+                if prev.modified_index != prev_index:
+                    err = ErrCASConflict(
+                        f"{key}: index mismatch (have "
+                        f"{prev.modified_index}, want {prev_index})")
+                    break
+            if err is None:
+                for key, prev_index in delete_ops:
                     try:
-                        self._maybe_raise("compare_and_swap", key)
+                        self._maybe_raise("delete", key)
                     except StoreError as e:
                         err = e
                         break
@@ -328,44 +380,29 @@ class MemStore:
                             f"{key}: index mismatch (have "
                             f"{prev.modified_index}, want {prev_index})")
                         break
-                if err is None:
-                    for key, prev_index in delete_ops:
-                        try:
-                            self._maybe_raise("delete", key)
-                        except StoreError as e:
-                            err = e
-                            break
-                        prev = self._data.get(key)
-                        if prev is None:
-                            err = ErrKeyNotFound(key)
-                            break
-                        if prev.modified_index != prev_index:
-                            err = ErrCASConflict(
-                                f"{key}: index mismatch (have "
-                                f"{prev.modified_index}, want {prev_index})")
-                            break
-                if err is not None:
-                    out.append(err)
-                    continue
-                written: List[KV] = []
-                for key, value, _prev_index in cas_ops:
-                    prev = self._data[key]
-                    self._index += 1
-                    kv = KV(key, value, prev.created_index, self._index,
-                            None)
-                    self._data[key] = kv
-                    self._record_locked(StoreEvent(
-                        "compareAndSwap", key, self._index, kv, prev))
-                    written.append(kv)
-                for key, _prev_index in delete_ops:
-                    prev = self._data[key]
-                    del self._data[key]
-                    self._remove_key_locked(key)
-                    self._index += 1
-                    self._record_locked(StoreEvent(
-                        "delete", key, self._index, None, prev))
-                out.append(written)
-        return out
+            if err is not None:
+                out.append(err)
+                continue
+            written: List[KV] = []
+            for key, value, _prev_index in cas_ops:
+                prev = self._data[key]
+                self._index += 1
+                kv = KV(key, value, prev.created_index, self._index,
+                        None)
+                self._data[key] = kv
+                self._record_locked(StoreEvent(
+                    "compareAndSwap", key, self._index, kv, prev))
+                written.append(kv)
+            for key, _prev_index in delete_ops:
+                prev = self._data[key]
+                del self._data[key]
+                self._remove_key_locked(key)
+                self._index += 1
+                self._record_locked(StoreEvent(
+                    "delete", key, self._index, None, prev))
+            out.append(written)
+            # seal the item: its ops persist as ONE atomic WAL record
+            self._txn_boundary_locked()
 
     def delete(self, key: str, prev_index: Optional[int] = None) -> KV:
         with self._lock:

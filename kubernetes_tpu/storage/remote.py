@@ -23,12 +23,16 @@ the same StoreError classes clients of MemStore already handle.
 from __future__ import annotations
 
 import json
+import select
 import socket
 import struct
 import threading
+import time
 from typing import List, Optional, Tuple
 
 from kubernetes_tpu import watch as watchpkg
+from kubernetes_tpu.util import chaos
+from kubernetes_tpu.util.retry import Backoff
 from kubernetes_tpu.storage.memstore import (
     KV,
     ErrCASConflict,
@@ -107,14 +111,25 @@ class StoreServer:
     analog). One thread per connection; watch connections stream."""
 
     def __init__(self, store: Optional[MemStore] = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 reuse_port: bool = False):
         self.store = store if store is not None else MemStore()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        if reuse_port and hasattr(socket, "SO_REUSEPORT"):
+            # OPT-IN only (in-process kill+respawn tests, embedded
+            # deployments that re-listen while pre-crash client sockets
+            # drain FIN_WAIT): two live kube-store processes sharing a
+            # port would split clients across divergent stores, so the
+            # production binary never sets it — a real process death
+            # frees the port on its own
+            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
         self._sock.bind((host, port))
         self._sock.listen(128)
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._conns_lock = threading.Lock()
+        self._conns: set = set()
 
     @property
     def port(self) -> int:
@@ -137,6 +152,24 @@ class StoreServer:
             self._sock.close()
         except OSError:
             pass
+        # close live per-connection sockets too — a real process death
+        # does, and leaving them open both leaks conn threads and keeps
+        # the port EADDRINUSE against an in-process respawn (the
+        # kill+respawn tests restart a StoreServer on the same port).
+        # shutdown() first: the conn thread is blocked in recv, which
+        # defers the fd close — shutdown sends the FIN immediately and
+        # wakes the reader regardless (the http watch on_stop pattern).
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
 
     def serve_forever(self) -> None:
         self._accept_loop()
@@ -148,6 +181,8 @@ class StoreServer:
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._conns_lock:
+                self._conns.add(conn)
             threading.Thread(target=self._serve_conn, args=(conn,),
                              daemon=True, name="store-conn").start()
 
@@ -157,11 +192,18 @@ class StoreServer:
                 req = _recv_frame(conn)
                 if req is None:
                     return
+                # kube-chaos seams (util/chaos, armed by tests only):
+                # a mid-stream connection reset is exactly what a killed
+                # server produces; a delay is a wedged-but-alive one
+                chaos.delay_if_armed("store.serve.delay")
+                if chaos.take_flag("store.serve.reset"):
+                    return
                 op = req.get("op", "")
                 if op == "watch":
                     self._serve_watch(conn, req)
                     return  # the connection is consumed by the stream
                 try:
+                    chaos.error_if_armed("store.serve.error")
                     resp = self._dispatch(op, req)
                 except StoreError as e:
                     resp = _err_out(e)
@@ -169,6 +211,8 @@ class StoreServer:
         except (OSError, ValueError):
             pass
         finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
             try:
                 conn.close()
             except OSError:
@@ -265,12 +309,30 @@ class RemoteStore:
     One pooled connection per thread (apiserver handler threads are
     long-lived); watches open a dedicated streaming connection each, and
     stopping the client-side Watcher closes it, which the server notices.
+
+    Restart transparency (docs/design/ha.md): a kube-store respawn must
+    look like latency, not errors. Three mechanisms compose:
+
+    - a zero-timeout readability probe evicts pooled connections the
+      restarted server half-closed BEFORE a request lands on them (the
+      Go http.Transport background-read idiom client/http uses) — the
+      common post-restart path never even sees an error;
+    - refused/failed CONNECTS retry with capped exponential backoff +
+      jitter for up to ``reconnect_window_s`` (nothing was sent, always
+      safe; jitter keeps N handler threads from reconnecting in
+      lockstep);
+    - a connection that dies MID-CALL retries through the same window
+      for idempotent reads; writes still raise (the op may have applied
+      — the callers' CAS/409 discipline owns that ambiguity, same as
+      client/http._open for non-idempotent methods).
     """
 
-    def __init__(self, address: str, call_timeout_s: float = 30.0):
+    def __init__(self, address: str, call_timeout_s: float = 30.0,
+                 reconnect_window_s: float = 20.0):
         host, _, port = address.rpartition(":")
         self._addr = (host or "127.0.0.1", int(port))
         self._call_timeout_s = call_timeout_s
+        self._reconnect_window_s = reconnect_window_s
         self._local = threading.local()
 
     # -- plumbing ----------------------------------------------------------
@@ -280,36 +342,85 @@ class RemoteStore:
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return sock
 
-    def _call(self, req: dict, idempotent: bool = False):
-        for attempt in (0, 1):
-            sock = getattr(self._local, "sock", None)
-            if sock is None:
-                sock = self._local.sock = self._connect()
+    @staticmethod
+    def _stale(sock: socket.socket) -> bool:
+        """True when an idle pooled connection is unusable: any pending
+        byte/EOF on an idle request/response connection means the server
+        closed or desynced (a restarted kube-store RSTs every pre-crash
+        socket). poll(2), not select(2) — fd>=1024 must not false-flag."""
+        try:
+            p = select.poll()
+            p.register(sock, select.POLLIN | select.POLLHUP | select.POLLERR)
+            return bool(p.poll(0))
+        except (OSError, ValueError):
+            return True
+
+    def _drop_sock(self) -> None:
+        sock = getattr(self._local, "sock", None)
+        if sock is not None:
+            self._local.sock = None
             try:
-                _send_frame(sock, req)
+                sock.close()
             except OSError:
-                # the pooled connection died while idle and the request
-                # never went out: reconnect and resend (always safe)
-                try:
-                    sock.close()
-                except OSError:
-                    pass
-                sock = self._local.sock = self._connect()
-                _send_frame(sock, req)
+                pass
+
+    def _connect_with_backoff(self, deadline: float) -> socket.socket:
+        """Dial until ``deadline``; OSError past it surfaces as
+        StoreError (the caller's per-op failure)."""
+        backoff = Backoff(base=0.05, cap=1.0)
+        while True:
             try:
+                return self._connect()
+            except OSError as e:
+                if time.monotonic() + backoff.peek() >= deadline:
+                    raise StoreError(
+                        f"store at {self._addr[0]}:{self._addr[1]} "
+                        f"unreachable for {self._reconnect_window_s:.0f}s: "
+                        f"{e}") from None
+                backoff.sleep_next()
+
+    def _call(self, req: dict, idempotent: bool = False):
+        deadline = time.monotonic() + self._reconnect_window_s
+        retry_backoff = Backoff(base=0.02, cap=0.5)
+        while True:
+            sock = getattr(self._local, "sock", None)
+            if sock is not None and self._stale(sock):
+                self._drop_sock()
+                sock = None
+            if sock is None:
+                sock = self._local.sock = \
+                    self._connect_with_backoff(deadline)
+            sent = False
+            recv_err: Optional[Exception] = None
+            resp = None
+            try:
+                _send_frame(sock, req)
+                sent = True
                 resp = _recv_frame(sock)
             except OSError as e:
-                resp, recv_err = None, e
-            else:
-                recv_err = None
+                recv_err = e
             if resp is None:
+                self._drop_sock()
+                if not sent:
+                    # the request never went out: reconnect and resend
+                    # (always safe) — but bounded by the SAME window as
+                    # everything else, with a small backoff: a store in
+                    # a fast crash loop accepts connects and resets the
+                    # send, which would otherwise busy-spin here forever
+                    if time.monotonic() >= deadline:
+                        raise StoreError(
+                            f"store at {self._addr[0]}:{self._addr[1]} "
+                            f"resetting sends for "
+                            f"{self._reconnect_window_s:.0f}s: {recv_err}")
+                    retry_backoff.sleep_next()
+                    continue
                 # the server died between send and response. Reads are
-                # idempotent — reconnect and retry once (a restarted
-                # kube-store serves them from recovered state). Writes are
-                # NOT retried: the op may have applied (same discipline as
-                # client/http._open for non-idempotent methods).
-                self._local.sock = None
-                if idempotent and attempt == 0:
+                # idempotent — retry through the window (a restarted
+                # kube-store serves them from recovered state). Writes
+                # are NOT retried: the op may have applied (same
+                # discipline as client/http._open for non-idempotent
+                # methods).
+                if idempotent and time.monotonic() < deadline:
                     continue
                 raise StoreError("store connection "
                                  + (f"failed mid-call: {recv_err}"
@@ -385,7 +496,10 @@ class RemoteStore:
     def watch(self, prefix: str, from_index: int = 0,
               recursive: bool = True,
               lag_limit: Optional[int] = None) -> watchpkg.Watcher:
-        sock = self._connect()
+        # the open handshake is read-only: ride a store respawn with the
+        # same backoff window the request/response ops use
+        sock = self._connect_with_backoff(
+            time.monotonic() + self._reconnect_window_s)
         # the open handshake stays under the connect timeout (a wedged
         # store must fail watch() in bounded time) ...
         _send_frame(sock, {"op": "watch", "prefix": prefix,

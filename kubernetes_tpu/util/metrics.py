@@ -21,6 +21,8 @@ __all__ = ["Counter", "Gauge", "Histogram", "Registry", "default_registry",
            "PodLatencyMetrics", "pod_latency_metrics",
            "ExplainMetrics", "explain_metrics",
            "EventRecorderMetrics", "event_recorder_metrics",
+           "StoreWalMetrics", "store_wal_metrics",
+           "ChaosMetrics", "chaos_metrics",
            "FlightRecorder", "flightrec_arm", "flightrec_disarm",
            "flightrec_armed", "flightrec_watch", "flightrec_vars",
            "flightrec_sample_now", "flightrec"]
@@ -541,6 +543,107 @@ def event_recorder_metrics() -> EventRecorderMetrics:
     if EventRecorderMetrics._singleton is None:
         EventRecorderMetrics._singleton = EventRecorderMetrics()
     return EventRecorderMetrics._singleton
+
+
+class StoreWalMetrics:
+    """kube-chaos: the ``store_wal_*`` family — durability-path evidence
+    from storage/durable.DurableStore, exported wherever the store
+    lives (kube-store's --metrics-port, or the apiserver's /metrics
+    merge when the store is in-process). Registered HERE so the churn
+    harness's ``store`` record section and the metrics-sync vet rule
+    bind to the registry universe.
+
+    The group-commit invariant these numbers prove: ``records >= ops``
+    would be the per-op seed behavior; after the fix one record carries
+    a whole txn item, so an evict+bind wave moves ``ops`` up by the op
+    count but ``records`` by the item count and ``group_commits`` (=
+    physical write+flush passes) by ONE per batched verb call."""
+
+    _singleton = None
+
+    def __init__(self, registry: Optional[Registry] = None):
+        reg = registry or default_registry()
+        self.records = reg.counter(
+            "store_wal_records_total",
+            "WAL records appended (one JSON line each; a txn record "
+            "carries every op of one atomic item)")
+        self.ops = reg.counter(
+            "store_wal_ops_total",
+            "Mutations persisted through the WAL (ops inside txn "
+            "records included)")
+        self.group_commits = reg.counter(
+            "store_wal_group_commits_total",
+            "Physical WAL write+flush passes (one per batched verb "
+            "call — the N-fsyncs-per-wave fix's denominator)")
+        self.fsyncs = reg.counter(
+            "store_wal_fsyncs_total",
+            "fsync(2) calls on the WAL (fsync=True stores only)")
+        self.bytes_written = reg.counter(
+            "store_wal_bytes_total", "Bytes appended to the WAL")
+        self.compactions = reg.counter(
+            "store_wal_compactions_total",
+            "Snapshot+truncate compaction passes")
+        self.wal_size = reg.gauge(
+            "store_wal_size_bytes", "Live WAL file size after the last "
+            "append or compaction")
+        self.snapshot_size = reg.gauge(
+            "store_snapshot_size_bytes",
+            "snapshot.json size after the last compaction or recovery")
+        self.recovery_s = reg.histogram(
+            "store_recovery_seconds",
+            "Wall time of one crash recovery (snapshot load + WAL "
+            "replay)",
+            buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                     30.0, 60.0))
+        self.replayed = reg.gauge(
+            "store_recovery_replayed_records",
+            "WAL records replayed by the most recent recovery")
+        self.snapshot_age = reg.gauge(
+            "store_recovery_snapshot_age_seconds",
+            "Age of the snapshot loaded by the most recent recovery "
+            "(0 when no snapshot existed)")
+        self.torn_bytes = reg.counter(
+            "store_wal_torn_bytes_total",
+            "Bytes discarded as a torn/corrupt WAL tail across "
+            "recoveries (a crash mid-append leaves at most one torn "
+            "record; anything more is media corruption and is logged "
+            "loudly)")
+
+
+def store_wal_metrics() -> StoreWalMetrics:
+    if StoreWalMetrics._singleton is None:
+        StoreWalMetrics._singleton = StoreWalMetrics()
+    return StoreWalMetrics._singleton
+
+
+class ChaosMetrics:
+    """kube-chaos supervisor instrumentation: component kills/respawns
+    and time-to-recovery, incremented by the churn harness's supervisor
+    (hack/churn_mp.py) in its own process and pulled into the flightrec
+    timeline through the harness's /debug/vars target — so the
+    ``component_restart`` and ``recovery_time_ceiling`` SLO rules fire
+    and resolve LIVE during the run, not in post-mortem."""
+
+    _singleton = None
+
+    def __init__(self, registry: Optional[Registry] = None):
+        reg = registry or default_registry()
+        self.restarts = reg.counter(
+            "component_restarts_total",
+            "Control-plane child processes respawned by the chaos "
+            "supervisor (scheduled kills and organic deaths alike; a "
+            "clean run carries 0)")
+        self.recovery_s = reg.histogram(
+            "component_recovery_seconds",
+            "Kill (or death detection) -> respawned child answering "
+            "its readiness probe",
+            buckets=(0.5, 1.0, 2.5, 5.0, 10.0, 20.0, 30.0, 45.0, 60.0))
+
+
+def chaos_metrics() -> ChaosMetrics:
+    if ChaosMetrics._singleton is None:
+        ChaosMetrics._singleton = ChaosMetrics()
+    return ChaosMetrics._singleton
 
 
 # -- kube-flightrec: continuous in-process metric time-series ---------------

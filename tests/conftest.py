@@ -59,6 +59,13 @@ if os.environ.get("KTPU_RACE"):
     _locksmith.arm()
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running e2e (tier-1 excludes via -m 'not slow'; the "
+        "--race rounds and full hack/test.sh runs include it)")
+
+
 def pytest_sessionfinish(session, exitstatus):
     """--race rounds fail loudly on any lock-order cycle locksmith saw,
     even if no schedule actually deadlocked during the run."""

@@ -1,0 +1,581 @@
+"""kube-chaos: crash-durable control plane, proven (docs/design/ha.md).
+
+The layers, bottom up:
+
+- WAL txn atomicity under an injected crash point: the seed
+  ``MemStore.txn_many`` path wrote one WAL line + flush PER OP, so a
+  crash between the CAS line and the delete line of one "atomic"
+  evict+bind resurrected a half-applied transaction on replay — the
+  crash-point tests here fail against that path and pass against the
+  group-commit fix (one buffered record + single flush per item);
+- torn-tail replay: a torn txn record drops the WHOLE item, never a
+  fraction, and recovery truncates + discloses it;
+- restart-transparent clients: RemoteStore rides a StoreServer
+  kill+respawn through its backoff window without surfacing an error;
+- the SLO rules (component_restart, recovery_time_ceiling) fire and
+  resolve through the watchdog, and stay quiet outside the offered-load
+  window (inactive gating);
+- the chaos schedule grammar + record contract;
+- a live kill+respawn e2e (slow; the --race suite runs it with
+  locksmith armed): every control-plane component SIGKILLed and
+  respawned mid-churn, all pods bound, zero divergence, restarts
+  disclosed.
+"""
+
+import importlib.util
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.storage.durable import DurableStore
+from kubernetes_tpu.storage.memstore import ErrCASConflict, MemStore
+from kubernetes_tpu.storage.remote import RemoteStore, StoreServer
+from kubernetes_tpu.storage.memstore import StoreError
+from kubernetes_tpu.util import chaos
+from kubernetes_tpu.util.retry import Backoff
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_churn_mp():
+    spec = importlib.util.spec_from_file_location(
+        "churn_mp", os.path.join(_REPO, "hack", "churn_mp.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _clear_chaos():
+    chaos.clear()
+    yield
+    chaos.clear()
+
+
+# -- WAL group commit + crash atomicity --------------------------------------
+
+
+def _seed_txn(store):
+    """One bind (CAS) + one victim (delete) — the evict+bind shape."""
+    a = store.create("/registry/pods/default/preemptor", "pending")
+    b = store.create("/registry/pods/default/victim", "bound")
+    return a, b
+
+
+def _txn(store, a, b):
+    return store.txn_many([(
+        [("/registry/pods/default/preemptor", "bound", a.modified_index)],
+        [("/registry/pods/default/victim", b.modified_index)],
+    )])
+
+
+def _split_state(reopened) -> str:
+    """-> 'none' | 'all' | 'SPLIT' for the evict+bind after recovery."""
+    bound = reopened.get("/registry/pods/default/preemptor").value == "bound"
+    victim_gone = "/registry/pods/default/victim" not in reopened._data
+    if bound and victim_gone:
+        return "all"
+    if not bound and not victim_gone:
+        return "none"
+    return "SPLIT"
+
+
+def test_txn_item_is_one_wal_record(tmp_path):
+    """The group-commit fix: every op of one atomic item lands in ONE
+    WAL record ({"txn": [...]}), written with one flush — the seed wrote
+    one line + one flush per op (the split window)."""
+    s = DurableStore(str(tmp_path))
+    a, b = _seed_txn(s)
+    n_before = len(open(tmp_path / "wal.log").read().strip().splitlines())
+    out = _txn(s, a, b)
+    assert not isinstance(out[0], Exception)
+    lines = open(tmp_path / "wal.log").read().strip().splitlines()
+    assert len(lines) - n_before == 1  # the whole item, one record
+    rec = json.loads(lines[-1])
+    assert [e["a"] for e in rec["txn"]] == ["compareAndSwap", "delete"]
+
+
+def test_cas_many_groups_the_wave_into_one_flush(tmp_path):
+    """compare_and_swap_many keeps per-op records (serial-verb format on
+    disk) but the wave pays ONE physical write+flush."""
+    from kubernetes_tpu.util.metrics import store_wal_metrics
+    s = DurableStore(str(tmp_path))
+    kvs = [s.create(f"/r/k{i}", "v") for i in range(16)]
+    mx = store_wal_metrics()
+    g0, r0 = mx.group_commits.total(), mx.records.total()
+    out = s.compare_and_swap_many(
+        [(f"/r/k{i}", "w", kvs[i].modified_index) for i in range(16)])
+    assert all(not isinstance(o, Exception) for o in out)
+    assert mx.records.total() - r0 == 16
+    assert mx.group_commits.total() - g0 == 1
+
+
+def test_txn_crash_before_append_applies_nothing(tmp_path):
+    """SIGKILL before the WAL append: the whole item is absent after
+    recovery — never a fraction. (Against the seed per-op path the same
+    crash point sits between the item's two appends and leaves the CAS
+    durable with the delete lost: the split this test exists to
+    forbid.)"""
+    s = DurableStore(str(tmp_path))
+    a, b = _seed_txn(s)
+    chaos.inject_crash("durable.wal_append.pre")
+    with pytest.raises(chaos.SimulatedCrash):
+        _txn(s, a, b)
+    chaos.clear()
+    assert _split_state(DurableStore(str(tmp_path))) == "none"
+
+
+def test_txn_crash_after_append_applies_all(tmp_path):
+    """SIGKILL after the (single) WAL append: the whole item is durable.
+    The seed path performed TWO appends for this item, so a crash after
+    the first one — exactly this arm — recovered a half-applied
+    transaction and this assertion read 'SPLIT'."""
+    s = DurableStore(str(tmp_path))
+    a, b = _seed_txn(s)
+    chaos.inject_crash("durable.wal_append.post")
+    with pytest.raises(chaos.SimulatedCrash):
+        _txn(s, a, b)
+    chaos.clear()
+    assert _split_state(DurableStore(str(tmp_path))) == "all"
+
+
+def test_torn_txn_record_drops_whole_item(tmp_path):
+    """A torn (partially-written) txn record on the WAL tail must drop
+    the WHOLE item on replay — and recovery truncates + discloses the
+    torn bytes instead of crashing."""
+    s = DurableStore(str(tmp_path))
+    a, b = _seed_txn(s)
+    out = _txn(s, a, b)
+    assert not isinstance(out[0], Exception)
+    wal = tmp_path / "wal.log"
+    full = open(wal, "rb").read()
+    lines = full.strip().splitlines(keepends=False)
+    # tear the final (txn) record mid-line, as a crash mid-append would
+    torn = b"\n".join(lines[:-1]) + b"\n" + lines[-1][: len(lines[-1]) // 2]
+    open(wal, "wb").write(torn)
+    r = DurableStore(str(tmp_path))
+    assert _split_state(r) == "none"   # the item vanished whole
+    assert r.recovery["torn_bytes"] > 0
+    # the torn fragment was truncated: a write + reopen cycle is clean
+    r.create("/after", "x")
+    r2 = DurableStore(str(tmp_path))
+    assert r2.get("/after").value == "x"
+    assert r2.recovery["torn_bytes"] == 0
+
+
+def test_recovery_disclosure_counts_records_and_ops(tmp_path):
+    s = DurableStore(str(tmp_path))
+    a, b = _seed_txn(s)
+    _txn(s, a, b)
+    r = DurableStore(str(tmp_path))
+    assert r.recovery["replayed_records"] == 3   # 2 creates + 1 txn
+    assert r.recovery["replayed_ops"] == 4       # ...carrying 4 ops
+    assert r.recovery["recovery_s"] >= 0.0
+    assert r.recovery["snapshot"] is False
+    # CAS semantics against recovered state hold (the resurrected-state
+    # equivalence the whole contract rests on)
+    cur = r.get("/registry/pods/default/preemptor")
+    with pytest.raises(ErrCASConflict):
+        r.compare_and_swap("/registry/pods/default/preemptor", "x",
+                           a.modified_index)
+    r.compare_and_swap("/registry/pods/default/preemptor", "x",
+                       cur.modified_index)
+
+
+def test_memstore_hooks_are_noops():
+    """The group-commit hooks must not change plain MemStore semantics
+    (it is also the test double everywhere)."""
+    s = MemStore()
+    a, b = _seed_txn(s)
+    out = _txn(s, a, b)
+    assert not isinstance(out[0], Exception)
+    assert s.get("/registry/pods/default/preemptor").value == "bound"
+
+
+# -- restart-transparent clients ---------------------------------------------
+
+
+class TestRemoteStoreRestart:
+    def test_rides_server_kill_and_respawn(self, tmp_path):
+        """Kill the StoreServer, respawn it on the same port + data dir:
+        the client's next ops ride the backoff window and succeed against
+        recovered state — a respawn is latency, not errors."""
+        # both instances opt into SO_REUSEPORT (the embedded-respawn
+        # deployment shape): re-listening while the pre-crash client
+        # socket drains FIN_WAIT needs the flag on BOTH listeners
+        store1 = DurableStore(str(tmp_path))
+        srv1 = StoreServer(store1, reuse_port=True).start()
+        port = srv1.port
+        cli = RemoteStore(srv1.address, reconnect_window_s=15.0)
+        kv = cli.create("/r/a", "1")
+        srv1.stop()   # the kill: every pooled client socket dies
+
+        def respawn():
+            time.sleep(0.5)
+            deadline = time.monotonic() + 10
+            while True:
+                try:
+                    StoreServer(DurableStore(str(tmp_path)),
+                                port=port, reuse_port=True).start()
+                    return
+                except OSError:
+                    assert time.monotonic() < deadline, "port never freed"
+                    time.sleep(0.1)
+
+        t = threading.Thread(target=respawn, daemon=True)
+        t.start()
+        # a read retries through the window; the recovered store serves
+        # the pre-kill resourceVersion
+        got = cli.get("/r/a")
+        assert got.value == "1" and got.modified_index == kv.modified_index
+        # a write lands too (the connect happened after the respawn, so
+        # nothing ambiguous occurred)
+        cli.compare_and_swap("/r/a", "2", got.modified_index)
+        assert cli.get("/r/a").value == "2"
+        t.join()
+
+    def test_stale_pooled_connection_evicted_before_send(self, tmp_path):
+        """A restarted server half-closes pooled sockets; the readability
+        probe must evict them BEFORE a write lands, so even non-idempotent
+        ops survive a restart that happened while the client was idle."""
+        store = DurableStore(str(tmp_path))
+        srv1 = StoreServer(store, reuse_port=True).start()
+        port = srv1.port
+        cli = RemoteStore(srv1.address, reconnect_window_s=10.0)
+        cli.create("/r/x", "1")          # pools a connection
+        srv1.stop()
+        deadline = time.monotonic() + 10
+        srv2 = None
+        while srv2 is None:
+            try:
+                srv2 = StoreServer(DurableStore(str(tmp_path)),
+                                   port=port, reuse_port=True).start()
+            except OSError:
+                assert time.monotonic() < deadline, "port never freed"
+                time.sleep(0.1)
+        try:
+            # non-idempotent op on the stale pool: the probe reconnects
+            # first, so this must NOT raise
+            cli.create("/r/y", "2")
+            assert cli.get("/r/y").value == "2"
+        finally:
+            srv2.stop()
+
+    def test_write_that_died_mid_call_raises(self):
+        """A write the server received but never answered must surface
+        (it may have applied) — the chaos connection-reset seam produces
+        exactly a killed server's behavior."""
+        srv = StoreServer(MemStore()).start()
+        try:
+            cli = RemoteStore(srv.address, reconnect_window_s=1.0)
+            cli.create("/r/a", "1")
+            chaos.inject_flag("store.serve.reset")
+            with pytest.raises(StoreError):
+                cli.create("/r/b", "2")
+            # the flag is spent: the retry path is clean again
+            cli.create("/r/c", "3")
+            assert cli.get("/r/c").value == "3"
+        finally:
+            srv.stop()
+
+    def test_idempotent_read_retries_through_reset(self):
+        srv = StoreServer(MemStore()).start()
+        try:
+            cli = RemoteStore(srv.address, reconnect_window_s=10.0)
+            cli.create("/r/a", "1")
+            chaos.inject_flag("store.serve.reset")
+            assert cli.get("/r/a").value == "1"   # retried, no error
+        finally:
+            srv.stop()
+
+    def test_injected_delay_and_error_seams(self):
+        srv = StoreServer(MemStore()).start()
+        try:
+            cli = RemoteStore(srv.address, reconnect_window_s=2.0)
+            cli.create("/r/a", "1")
+            chaos.inject_delay("store.serve.delay", 0.2)
+            t0 = time.monotonic()
+            assert cli.get("/r/a").value == "1"
+            assert time.monotonic() - t0 >= 0.15
+            chaos.inject_error("store.serve.error", StoreError("injected"))
+            with pytest.raises(StoreError):
+                cli.get("/r/a")
+        finally:
+            srv.stop()
+
+
+def test_http_transport_connect_retry_rides_restart():
+    """HTTPTransport retries refused connects (nothing sent — always
+    safe) with backoff: a server that starts listening 0.5s later is a
+    latency blip, not an error."""
+    from kubernetes_tpu.client.http import HTTPTransport
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+
+    def late_server():
+        time.sleep(0.5)
+        srv = socket.socket()
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", port))
+        srv.listen(1)
+        conn, _ = srv.accept()
+        conn.recv(65536)
+        body = b'{"kind": "Status", "apiVersion": "v1", "status": "Success"}'
+        conn.sendall(b"HTTP/1.1 200 OK\r\nContent-Length: "
+                     + str(len(body)).encode() + b"\r\n\r\n" + body)
+        conn.close()
+        srv.close()
+
+    t = threading.Thread(target=late_server, daemon=True)
+    t.start()
+    tr = HTTPTransport(f"http://127.0.0.1:{port}", connect_retry_s=10.0)
+    status, raw = tr._open(f"http://127.0.0.1:{port}/api/v1/x", "GET")
+    assert status == 200 and b"Success" in raw
+    t.join()
+    # fail-fast mode: connect_retry_s=0 surfaces the refusal immediately
+    tr2 = HTTPTransport(f"http://127.0.0.1:{port}", connect_retry_s=0.0)
+    with pytest.raises(OSError):
+        tr2._open(f"http://127.0.0.1:{port}/api/v1/x", "GET")
+
+
+def test_backoff_growth_cap_jitter_reset():
+    import random
+    b = Backoff(base=0.1, cap=1.0, factor=2.0, jitter=0.25,
+                rng=random.Random(7), sleep=lambda _s: None)
+    raw = [b.peek() for _ in range(1)]
+    delays = [b.next() for _ in range(6)]
+    assert raw[0] == 0.1
+    # jitter stays inside +/-25% of the capped exponential schedule
+    sched = [0.1, 0.2, 0.4, 0.8, 1.0, 1.0]
+    for d, s in zip(delays, sched):
+        assert s * 0.75 <= d <= s * 1.25
+    b.reset()
+    assert b.peek() == 0.1
+
+
+def test_solver_fallback_requeue_mode_plumbs():
+    """--solver-fallback requeue: the chaos topology's answer to a
+    solverd kill — waves fail-and-requeue for the seconds the
+    supervisor needs instead of paying a full-shape in-process compile.
+    The flag must parse and land on the config the wave scheduler reads
+    (fallback=False on its RemoteSolver)."""
+    from kubernetes_tpu.cmd.scheduler import build_parser
+    from kubernetes_tpu.scheduler.driver import SchedulerConfig
+    opts = build_parser().parse_args(
+        ["--algorithm", "tpu-batch", "--solver-addr", "127.0.0.1:1",
+         "--solver-fallback", "requeue"])
+    assert opts.solver_fallback == "requeue"
+    assert SchedulerConfig.__dataclass_fields__[
+        "solver_fallback"].default == "inprocess"
+
+
+def test_solver_cooldown_is_exponential_and_resets():
+    from kubernetes_tpu.solver.client import RemoteSolver
+    cli = RemoteSolver("127.0.0.1:1", cooldown_s=8.0)
+    first = cli._cooldown.peek()
+    cli._mark_unhealthy()
+    assert cli._in_cooldown()
+    second = cli._cooldown.peek()
+    assert first == pytest.approx(1.0) and second == pytest.approx(2.0)
+    cli._mark_healthy()
+    assert not cli._in_cooldown()
+    assert cli._cooldown.peek() == pytest.approx(1.0)
+
+
+# -- chaos seam unit behavior ------------------------------------------------
+
+
+def test_crash_point_skip_and_introspection():
+    chaos.inject_crash("p", skip=2)
+    chaos.crash_if_armed("p")
+    chaos.crash_if_armed("p")
+    with pytest.raises(chaos.SimulatedCrash):
+        chaos.crash_if_armed("p")
+    assert chaos.armed("p")["hits"] == 3
+    chaos.clear()
+    chaos.crash_if_armed("p")  # disarmed: no-op
+
+
+# -- SLO rules ---------------------------------------------------------------
+
+
+def _ns(s: float) -> int:
+    return int(s * 1e9)
+
+
+class TestChaosSLORules:
+    def _rule(self, name):
+        from kubernetes_tpu.addons.monitoring import default_churn_rules
+        return next(r for r in default_churn_rules() if r.name == name)
+
+    def test_component_restart_fires_and_resolves(self):
+        from kubernetes_tpu.addons.monitoring import SLOWatchdog
+        rule = self._rule("component_restart")
+        assert rule.active_only and rule.op == "ceil" \
+            and rule.threshold == 0.0
+        dog = SLOWatchdog([rule])
+        # restart rate > 0 while load is offered: fires immediately
+        tr = dog.observe(rule, 0.05, _ns(10), active=True)
+        assert tr is not None and tr["state"] == "firing"
+        # window slides clear: resolves (the fire AND resolve the r14
+        # record's alarms section must show)
+        tr = dog.observe(rule, 0.0, _ns(35), active=True)
+        assert tr is not None and tr["state"] == "resolved"
+
+    def test_component_restart_inactive_gated(self):
+        from kubernetes_tpu.addons.monitoring import SLOWatchdog
+        rule = self._rule("component_restart")
+        dog = SLOWatchdog([rule])
+        # teardown kills after the load window: not an outage
+        assert dog.observe(rule, 1.0, _ns(10), active=False) is None
+        assert dog.firing() == []
+
+    def test_recovery_ceiling_fires_resolves_and_gates(self):
+        from kubernetes_tpu.addons.monitoring import SLOWatchdog
+        rule = self._rule("recovery_time_ceiling")
+        assert rule.active_only and rule.reduce == "p95"
+        # threshold must sit at or below the histogram's top finite
+        # bucket or an overflow could never fire (the quantile clamps)
+        from kubernetes_tpu.util.metrics import chaos_metrics
+        assert rule.threshold <= max(chaos_metrics().recovery_s.buckets)
+        dog = SLOWatchdog([rule])
+        assert dog.observe(rule, 50.0, _ns(5), active=False) is None
+        tr = dog.observe(rule, 50.0, _ns(10), active=True)
+        assert tr is not None and tr["state"] == "firing"
+        tr = dog.observe(rule, 2.0, _ns(20), active=True)
+        assert tr is not None and tr["state"] == "resolved"
+
+    def test_restart_counter_rides_the_aggregated_timeline(self):
+        """End-to-end through FlightAggregator.ingest: a harness shard
+        carrying component_restarts_total drives the rule's rate."""
+        from kubernetes_tpu.addons.monitoring import FlightAggregator
+        agg = FlightAggregator(
+            [], rules=[self._rule("component_restart")])
+        agg.set_active(True)
+
+        def shard(t_s, total):
+            return {"pid": 77, "service": "harness", "period_s": 1.0,
+                    "series": {"component_restarts_total": {
+                        "type": "counter",
+                        "samples": [[_ns(t_s), total]]}}}
+
+        for t in range(8):
+            agg.ingest(shard(t, 0.0))
+        agg.evaluate(_ns(7))
+        assert agg.watchdog.firing() == []
+        agg.ingest(shard(8, 1.0))      # the kill
+        agg.evaluate(_ns(8))
+        assert agg.watchdog.firing() == ["component_restart"]
+        for t in range(9, 35):
+            agg.ingest(shard(t, 1.0))
+        agg.evaluate(_ns(34))          # window slid clear
+        assert agg.watchdog.firing() == []
+        states = [tr["state"] for tr in agg.alarms()
+                  if tr["rule"] == "component_restart"]
+        assert states == ["firing", "resolved"]
+
+
+# -- chaos schedule grammar + record contract --------------------------------
+
+
+def test_parse_chaos_grammar():
+    churn_mp = _load_churn_mp()
+    evs = churn_mp.parse_chaos(
+        "apiserver@120s,solverd@240s:SIGKILL,scheduler@300s,"
+        "kube-store@60:TERM")
+    assert [(e["component"], e["t_s"], e["signal"]) for e in evs] == [
+        ("storeserver", 60.0, "SIGTERM"),
+        ("apiserver0", 120.0, "SIGKILL"),
+        ("solverd", 240.0, "SIGKILL"),
+        ("scheduler0", 300.0, "SIGKILL"),
+    ]
+    with pytest.raises(ValueError):
+        churn_mp.parse_chaos("apiserver")
+    with pytest.raises(ValueError):
+        churn_mp.parse_chaos("apiserver@soon")
+    with pytest.raises(ValueError):
+        churn_mp.parse_chaos("apiserver@5:SIGWAT")
+
+
+def test_validate_record_requires_chaos_and_store_sections():
+    churn_mp = _load_churn_mp()
+    rec = {"config": "c", "chaos": {"schedule": "apiserver@5"}}
+    missing = churn_mp.validate_record(rec, round_no=7)
+    assert "chaos.events" in missing and "chaos.restarts" in missing
+    assert "chaos.recovery_s" in missing and "store" in missing
+    rec["chaos"].update(events=[], restarts={}, recovery_s={})
+    rec["store"] = {k: 0 for k in churn_mp.STORE_FIELDS}
+    assert [m for m in churn_mp.validate_record(rec, round_no=7)
+            if m.startswith(("chaos", "store"))] == []
+    del rec["store"]["recovery"]
+    assert "store.recovery" in churn_mp.validate_record(rec, round_no=7)
+    # a store scrape that failed is exempt beyond its marker
+    rec["store"] = {"error": "scrape failed"}
+    assert [m for m in churn_mp.validate_record(rec, round_no=7)
+            if m.startswith("store")] == []
+
+
+def test_perfgate_isolates_chaos_shape():
+    sys.path.insert(0, os.path.join(_REPO, "hack"))
+    try:
+        import perfgate
+    finally:
+        sys.path.pop(0)
+    clean = {"config": "churn multi-process: 100 pods"}
+    chaotic = {"config": "churn multi-process: 100 pods",
+               "chaos": {"schedule": "apiserver@5"}}
+    assert perfgate.shape_key(clean) != perfgate.shape_key(chaotic)
+    assert perfgate.shape_key(chaotic).endswith("+chaos")
+
+
+# -- the live kill+respawn e2e ----------------------------------------------
+
+
+@pytest.mark.slow
+def test_kill_and_respawn_every_component_e2e(tmp_path):
+    """The whole claim, live: kube-store (DurableStore), an apiserver
+    worker, the scheduler, and kube-solverd each SIGKILLed mid-churn and
+    respawned by the supervisor; every pod still binds, the feeders ride
+    the outages, restarts + recovery times are disclosed, and the record
+    validates against the chaos contract."""
+    out = tmp_path / "rec.json"
+    # the feed phase must outlast the whole kill schedule (pods/rate =
+    # 10 s of offered load; kills land in the first 6 s), or late kills
+    # are skipped as after-run-window and the per-component claim is
+    # silently weaker
+    cmd = [sys.executable, os.path.join(_REPO, "hack", "churn_mp.py"),
+           "--pods", "1500", "--rate", "150", "--nodes", "60",
+           "--feeders", "1", "--apiservers", "2", "--schedulers", "1",
+           "--solverd", "--warm-max-bucket", "128",
+           "--store-data-dir", str(tmp_path / "store"),
+           "--chaos",
+           "scheduler@1.5s,kube-store@3s,apiserver@4.5s,solverd@6s",
+           "--bound-timeout", "300", "--port", "18640",
+           "--out", str(out)]
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=_REPO)
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=560)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    rec = json.loads(out.read_text())
+    assert rec["all_bound"] is True
+    # zero divergence: the live batch-vs-serial bind parity probe
+    assert rec["apiserver"]["bind_parity"]["divergent"] == 0
+    ch = rec["chaos"]
+    killed = {e["component"] for e in ch["events"] if "pid" in e}
+    assert {"scheduler0", "storeserver", "apiserver0",
+            "solverd"} <= killed
+    for comp in killed:
+        assert ch["restarts"].get(comp, 0) >= 1, (comp, ch["restarts"])
+    # the respawned kube-store recovered real state, and disclosed it
+    assert rec["store"]["recovery"]["replayed_records"] > 0 \
+        or rec["store"]["recovery"]["snapshot"]
+    churn_mp = _load_churn_mp()
+    assert churn_mp.validate_record(rec, round_no=14) == []
