@@ -126,7 +126,17 @@ def feed(prefix: str, count: int, rate: float, master: str,
     answer 409; those are tolerated (and counted) only once the feeder
     is in recovery — a 409 or 4xx on the first pass is still a real bug
     and aborts. A recovery that makes no progress for 90 s aborts too:
-    retrying forever would hide a dead control plane."""
+    retrying forever would hide a dead control plane.
+
+    kube-fairshed backpressure: a 429 is RETRY, never poison — the
+    server refused the create before executing it (nothing applied), so
+    the feeder honors the response's Retry-After (sleeping the server's
+    measured-drain hint), reconnects, and resumes from the acked prefix
+    exactly like a crash recovery. Requests pipelined PAST the 429 may
+    have landed (the server keeps serving the connection), so the 409
+    tolerance window covers the resend, same as the 5xx path. Counted
+    in ``retried_429``; under --overload this is the designed steady
+    state, not an anomaly."""
     import socket
     import threading
     import urllib.parse
@@ -147,6 +157,7 @@ def feed(prefix: str, count: int, rate: float, master: str,
         log_mv = memoryview(log_mm)
 
     status_re = re.compile(rb"HTTP/1\.1 (\d{3})")
+    retry_after_re = re.compile(rb"Retry-After: (\d+)")
     acked = [0]         # responses accepted, == the acked request prefix
     bad = []            # fatal status lines / errors
     # 409s are tolerated ONLY for request indices below this high-water
@@ -154,7 +165,11 @@ def feed(prefix: str, count: int, rate: float, master: str,
     # A blanket "recovering" latch would let a first-pass duplicate-
     # create bug late in the run masquerade as delivery.
     tolerate_below = [0]
-    stats = {"reconnects": 0, "retried_conflicts": 0, "retried_5xx": 0}
+    stats = {"reconnects": 0, "retried_conflicts": 0, "retried_5xx": 0,
+             "retried_429": 0}
+    # Retry-After seconds to honor before the next reconnect (a 429'd
+    # stream); capped so a misbehaving hint can't wedge the feeder
+    resume_after = [0.0]
     lock = threading.Lock()
 
     interval = 1.0 / rate
@@ -211,6 +226,20 @@ def feed(prefix: str, count: int, rate: float, master: str,
                         accepted += 1
                         last_end = m.end()
                         continue
+                    if code == b"429":
+                        # kube-fairshed shed: the server refused this
+                        # create BEFORE executing it — retry, never
+                        # poison. Honor its Retry-After (the headers
+                        # follow the status line in this same buffer;
+                        # a split-across-chunks header falls back to
+                        # 1 s), then resume from the acked prefix.
+                        m2 = retry_after_re.search(buf, m.end())
+                        with lock:
+                            stats["retried_429"] += 1
+                            resume_after[0] = min(
+                                30.0, float(m2.group(1)) if m2 else 1.0)
+                        poison = True
+                        break
                     if code[:1] == b"5":
                         # a component died mid-call (e.g. the store
                         # behind the apiserver): poison this stream at
@@ -285,6 +314,13 @@ def feed(prefix: str, count: int, rate: float, master: str,
         tolerate_below[0] = max(tolerate_below[0], i)
         with lock:
             stats["reconnects"] += 1
+            hold = resume_after[0]
+            resume_after[0] = 0.0
+        if hold > 0:
+            # a 429'd stream: honor the server's Retry-After before
+            # resuming — the backpressure loop that keeps the admitted
+            # rate at what the control plane actually drains
+            time.sleep(hold)
         if acked[0] > base:
             stalled_since = None       # progress was made
         elif stalled_since is None:
@@ -516,6 +552,60 @@ def _scrape_apiserver(master: str) -> dict:
     return out
 
 
+def _label_of(line: str, key: str) -> str:
+    return line.split(key + '="', 1)[1].split('"', 1)[0]
+
+
+def _scrape_fairshed(master: str) -> dict:
+    """kube-fairshed admission evidence from the apiserver's /metrics:
+    per-flow admitted/shed counts (by reason), the MUST-BE-ZERO
+    system-flow shed invariant counter, the workload backlog depth, and
+    per-flow queue-wait p95 — the record's ``fairshed`` section
+    (required whenever the record carries the ``overload`` marker)."""
+    raw = urllib.request.urlopen(f"{master}/metrics", timeout=5
+                                 ).read().decode()
+    flows: dict = {}
+    system_shed = backlog = 0
+    qw: dict = {}   # flow -> {le: cumcount}
+    for line in raw.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        val = line.rsplit(None, 1)[-1]
+        if line.startswith("request_admitted_total{"):
+            flow = _label_of(line, "flow")
+            d = flows.setdefault(flow, {"admitted": 0, "shed": {}})
+            d["admitted"] += int(float(val))
+        elif line.startswith("request_shed_total{"):
+            flow = _label_of(line, "flow")
+            reason = _label_of(line, "reason")
+            d = flows.setdefault(flow, {"admitted": 0, "shed": {}})
+            d["shed"][reason] = d["shed"].get(reason, 0) + int(float(val))
+        elif line.startswith("fairshed_system_shed_total "):
+            system_shed = int(float(val))
+        elif line.startswith("fairshed_backlog_depth "):
+            backlog = int(float(val))
+        elif line.startswith("request_queue_wait_seconds_bucket{"):
+            flow = _label_of(line, "flow")
+            le_s = _label_of(line, "le")
+            le = float("inf") if le_s == "+Inf" else float(le_s)
+            qw.setdefault(flow, {})[le] = float(val)
+    p95 = {}
+    for flow, bmap in qw.items():
+        buckets = sorted(bmap.items())
+        count = max(bmap.values()) if bmap else 0.0
+        p95[flow] = round(_hist_quantile(buckets, count, 0.95), 4) \
+            if count else None
+    return {
+        "flows": flows,
+        "admitted_total": sum(d["admitted"] for d in flows.values()),
+        "shed_total": sum(sum(d["shed"].values())
+                          for d in flows.values()),
+        "system_shed": system_shed,
+        "backlog_depth": backlog,
+        "queue_wait_p95_s": p95,
+    }
+
+
 def bind_parity_probe(client, api, n_nodes: int, k: int = 64) -> dict:
     """Zero-divergence evidence for the batch endpoint ON THE LIVE SERVER:
     two identical pod sets, one bound per-pod (POST pods/{name}/binding),
@@ -569,23 +659,34 @@ def bind_cost_probe(client, api, n_nodes: int, k: int = 512,
     ns = "probe"
     total = k * rounds + per_pod_n
     names = [f"probe-{i:05d}" for i in range(total)]
-    for name in names:
-        client.pods(ns).create(api.Pod(
-            metadata=api.ObjectMeta(name=name, namespace=ns),
-            spec=api.PodSpec(containers=[api.Container(
-                name="c", image="img")])))
+
+    def create(lo, hi):
+        for i in range(lo, hi):
+            client.pods(ns).create(api.Pod(
+                metadata=api.ObjectMeta(name=names[i], namespace=ns),
+                spec=api.PodSpec(containers=[api.Container(
+                    name="c", image="img")])))
 
     def binding(i):
         return api.Binding(
             metadata=api.ObjectMeta(name=names[i], namespace=ns),
             pod_name=names[i], host=f"node-{i % n_nodes:05d}")
 
-    t0 = _time.perf_counter()
+    # create-then-bind PER ROUND (only the binds are timed): the
+    # probe's created-but-unbound footprint stays <= max(k, per_pod_n),
+    # so it never trips the kube-fairshed backlog governor the way a
+    # create-everything-first pass would (and never leaves dangling
+    # pending pods behind if it aborts mid-way)
+    batch_s = 0.0
     for r in range(rounds):
+        create(r * k, (r + 1) * k)
+        t0 = _time.perf_counter()
         res = client.pods(ns).bind_many(api.BindingList(
             items=[binding(i) for i in range(r * k, (r + 1) * k)]))
+        batch_s += _time.perf_counter() - t0
         assert not any(x.error for x in res.items)
-    batch_ms = (_time.perf_counter() - t0) / (k * rounds) * 1000
+    batch_ms = batch_s / (k * rounds) * 1000
+    create(k * rounds, total)
     t0 = _time.perf_counter()
     for i in range(k * rounds, total):
         client.pods(ns).bind(binding(i))
@@ -667,6 +768,14 @@ STORE_FIELDS = ("wal_records", "wal_ops", "wal_group_commits",
 UNSCHEDULABLE_FIELDS = ("pods", "reasons", "explain_invocations",
                         "explain_seconds", "explain_skipped",
                         "events_posted", "events_dropped")
+# kube-fairshed evidence, required whenever a record claims an overload
+# run (an ``overload`` marker present): per-flow admitted/shed counts,
+# the system-flow shed invariant (MUST read 0 — the starvation-freedom
+# contract), the backlog governor's depth, queue-wait quantiles, and
+# the feeders' Retry-After-driven retry count. An overload claim whose
+# lower bands shed nothing proves the governor never engaged.
+FAIRSHED_FIELDS = ("flows", "admitted_total", "shed_total", "system_shed",
+                   "backlog_depth", "queue_wait_p95_s", "retried_429")
 
 
 def validate_record(rec: dict, round_no: int = 8) -> list:
@@ -743,6 +852,18 @@ def validate_record(rec: dict, round_no: int = 8) -> list:
         elif "error" not in pr:
             missing += [f"preemption.{k}" for k in PREEMPTION_FIELDS
                         if k not in pr]
+    if rec.get("overload") is not None:
+        fsec = rec.get("fairshed")
+        if not isinstance(fsec, dict):
+            missing.append("fairshed")
+        elif "error" not in fsec:
+            missing += [f"fairshed.{k}" for k in FAIRSHED_FIELDS
+                        if k not in fsec]
+            if fsec.get("system_shed", 0) != 0:
+                # the starvation-freedom invariant is part of the record
+                # CONTRACT: an overload record with system sheds is
+                # non-conformant, not merely unflattering
+                missing.append("fairshed.system_shed:nonzero")
     if rec.get("chaos") is not None:
         ch = rec["chaos"]
         if not isinstance(ch, dict):
@@ -775,13 +896,23 @@ def parse_chaos(spec: str) -> list:
     ``solverd``, ``storeserver`` (aliases ``store``, ``kube-store``).
     Times are seconds after the offered-load window opens (feeders
     launch). The default signal is SIGKILL — the chaos contract is
-    crash recovery, not graceful shutdown."""
+    crash recovery, not graceful shutdown.
+
+    Latency injection (kube-fairshed: overload and gray slowness
+    compose in ONE schedule): ``apiserver@120s:delay=250ms`` pauses
+    the live process for exactly that long (SIGSTOP -> sleep ->
+    SIGCONT) instead of killing it — entries carry ``delay_s`` in
+    place of ``signal``. Durations take us/ms/s/m suffixes
+    (util/chaos.parse_duration; the in-process twin is the
+    ``apiserver.dispatch`` delay seam)."""
     import signal as signal_mod
+
+    from kubernetes_tpu.util.chaos import parse_duration
     out = []
     for part in filter(None, (p.strip() for p in spec.split(","))):
         if "@" not in part:
             raise ValueError(f"chaos entry {part!r}: expected "
-                             "component@TIME[s][:SIGNAL]")
+                             "component@TIME[s][:SIGNAL|:delay=DUR]")
         name, _, rest = part.partition("@")
         t_str, _, sig = rest.partition(":")
         t_str = t_str.strip().rstrip("s")
@@ -790,12 +921,22 @@ def parse_chaos(spec: str) -> list:
         except ValueError:
             raise ValueError(
                 f"chaos entry {part!r}: bad time {t_str!r}") from None
-        sig = (sig or "SIGKILL").strip().upper()
+        name = _CHAOS_ALIASES.get(name.strip(), name.strip())
+        sig = (sig or "SIGKILL").strip()
+        if sig.lower().startswith("delay="):
+            try:
+                delay_s = parse_duration(sig.partition("=")[2])
+            except ValueError:
+                raise ValueError(f"chaos entry {part!r}: bad delay "
+                                 f"duration {sig!r}") from None
+            out.append({"component": name, "t_s": t_s,
+                        "delay_s": delay_s})
+            continue
+        sig = sig.upper()
         if not sig.startswith("SIG"):
             sig = "SIG" + sig
         if not hasattr(signal_mod, sig):
             raise ValueError(f"chaos entry {part!r}: unknown signal {sig}")
-        name = _CHAOS_ALIASES.get(name.strip(), name.strip())
         out.append({"component": name, "t_s": t_s, "signal": sig})
     return sorted(out, key=lambda e: e["t_s"])
 
@@ -1184,6 +1325,28 @@ def main(argv=None) -> int:
     ap.add_argument("--storm-fill-per-node", type=int, default=8,
                     help="template pods per node at exact capacity in "
                     "--priority-storm mode")
+    ap.add_argument("--overload", action="store_true",
+                    help="kube-fairshed overload scenario: offer --rate "
+                    "(set it ≥ 2x the sustained capacity) into a "
+                    "fairshed-governed apiserver with the workload "
+                    "backlog limiter armed (--fairshed-backlog, default "
+                    "2500 in this mode). Excess creates shed with "
+                    "429 + measured-drain Retry-After; feeders honor it "
+                    "and resume from the acked prefix, so every pod is "
+                    "eventually admitted but the created-but-unbound "
+                    "backlog — the 37 s invisible e2e queue of the "
+                    "unprotected baseline — stays bounded. The record "
+                    "gains overload + fairshed sections (sheds REQUIRED "
+                    "and disclosed; system-flow sheds must be 0) and "
+                    "perfgate isolates the +overload shape. Requires "
+                    "--apiservers 1: backlog accounting is exact only "
+                    "when one worker sees both creates and binds.")
+    ap.add_argument("--fairshed-backlog", "--fairshed_backlog", type=int,
+                    default=0,
+                    help="pass through to the apiserver(s): shed "
+                    "workload pod creates once created-but-unbound "
+                    "exceeds this (0 keeps the governor off outside "
+                    "--overload)")
     ap.add_argument("--chaos", default="",
                     help="kube-chaos kill schedule: comma-separated "
                     "component@TIME[s][:SIGNAL] entries, e.g. "
@@ -1368,6 +1531,21 @@ def main(argv=None) -> int:
                 kill_log.append(dict(ev, error="no live process"))
                 continue
             try:
+                if "delay_s" in ev:
+                    # latency injection: a live gray stall of exactly
+                    # delay_s — SIGSTOP freezes every thread (requests
+                    # queue at the socket, in-flight work suspends),
+                    # SIGCONT resumes; the process never dies, so the
+                    # supervisor correctly sees nothing to respawn
+                    target[1].send_signal(signal_mod.SIGSTOP)
+                    time.sleep(ev["delay_s"])
+                    target[1].send_signal(signal_mod.SIGCONT)
+                    kill_log.append(dict(ev, pid=target[1].pid))
+                    print(f"[churn-mp] CHAOS: delay {ev['delay_s']*1000:.0f}"
+                          f"ms (SIGSTOP/SIGCONT) -> {name} "
+                          f"(pid {target[1].pid}) at t+{ev['t_s']:.0f}s",
+                          file=sys.stderr, flush=True)
+                    continue
                 target[1].send_signal(getattr(signal_mod, ev["signal"]))
                 kill_log.append(dict(ev, pid=target[1].pid))
                 print(f"[churn-mp] CHAOS: {ev['signal']} -> {name} "
@@ -1476,6 +1654,8 @@ def main(argv=None) -> int:
             except Exception as e:
                 record["store"] = {"error": f"healthz failed: {e}"}
 
+    if args.overload and not args.fairshed_backlog:
+        args.fairshed_backlog = 2500
     api_extra = []
     if args.trace:
         api_extra.append("--trace")
@@ -1483,8 +1663,16 @@ def main(argv=None) -> int:
         api_extra.append("--flightrec")
     if args.watch_lag_limit:
         api_extra += ["--watch-lag-limit", str(args.watch_lag_limit)]
+    if args.fairshed_backlog:
+        api_extra += ["--fairshed-backlog", str(args.fairshed_backlog)]
     store_metrics_port = 0
     try:
+        if args.overload and args.apiservers != 1:
+            # the backlog governor's ledger (created - bound) is exact
+            # only when ONE worker serves both creates and binds; a
+            # reuseport fleet splits the signal (the cross-worker drain
+            # feed is tracked as future work in the design doc)
+            raise RuntimeError("--overload requires --apiservers 1")
         # chaos schedules may only name components this topology runs
         valid = {f"apiserver{w}" for w in range(args.apiservers)} \
             | {f"scheduler{w}" for w in range(args.schedulers)} \
@@ -1497,8 +1685,8 @@ def main(argv=None) -> int:
                 raise RuntimeError(
                     f"--chaos names {ev['component']!r}, which this "
                     f"topology does not run (valid: {sorted(valid)})")
-        if any(ev["component"] == "storeserver" for ev in chaos_events) \
-                and not args.store_data_dir:
+        if any(ev["component"] == "storeserver" and "signal" in ev
+               for ev in chaos_events) and not args.store_data_dir:
             raise RuntimeError(
                 "--chaos kills kube-store but --store-data-dir is "
                 "unset: the cluster state would not survive the kill")
@@ -1585,9 +1773,16 @@ def main(argv=None) -> int:
             except Exception as e:
                 parity = {"error": f"probe failed: {e}"}
             # isolated bind cost on the quiet server (comparable to r07's
-            # commit-derived figure, measured on post-feed waves)
+            # commit-derived figure, measured on post-feed waves). Sized
+            # under the backlog governor when one is armed: the probe's
+            # per-round create burst must fit the ceiling or the
+            # governor (correctly) sheds the probe itself
             try:
-                bind_probe = bind_cost_probe(client, api, args.nodes)
+                cap = args.fairshed_backlog or 1 << 30
+                bind_probe = bind_cost_probe(
+                    client, api, args.nodes,
+                    k=min(512, max(1, cap // 2)),
+                    per_pod_n=min(256, max(1, cap // 2)))
             except Exception as e:
                 bind_probe = {"error": f"probe failed: {e}"}
 
@@ -1698,7 +1893,13 @@ def main(argv=None) -> int:
                 targets,
                 rules=default_churn_rules(
                     binds_floor=args.binds_floor,
-                    rss_ceil_bytes=args.rss_ceiling_gb * (1 << 30)),
+                    rss_ceil_bytes=args.rss_ceiling_gb * (1 << 30),
+                    # the admitted-e2e ceiling only makes sense when the
+                    # backlog governor bounds the pending queue; an
+                    # ungoverned contract run legitimately backlogs past
+                    # it (r11: 37 s) and must keep its alarms-[] claim
+                    admitted_e2e_ceil_s=(
+                        10.0 if args.fairshed_backlog else None)),
                 period_s=args.flightrec_poll).start()
 
         # Bind counting rides a WATCH, not list polling: a full
@@ -2057,6 +2258,11 @@ def main(argv=None) -> int:
                            "respawns mid-run"
                            + (" (kube-store on DurableStore)"
                               if args.store_data_dir else ""))
+        if args.overload:
+            sched_desc += (" | OVERLOAD: fairshed flow admission, "
+                           f"workload backlog governor at "
+                           f"{args.fairshed_backlog}, feeders riding "
+                           "429 + Retry-After")
         budget = cpu_budget()
         budget["feeders"] = round(sum(s.get("cpu_s", 0.0) for s in stats), 2)
         record = {
@@ -2192,6 +2398,42 @@ def main(argv=None) -> int:
                   file=sys.stderr, flush=True)
         except Exception as e:
             record["unschedulable"] = {"error": f"scrape failed: {e}"}
+        if args.overload or args.fairshed_backlog:
+            # overload shape marker (perfgate isolates +overload) + the
+            # kube-fairshed evidence: sheds required and DISCLOSED, the
+            # system flow proven starvation-free (shed count 0), and
+            # the clients' Retry-After-driven retries counted
+            record["overload"] = {
+                "rate_target_per_s": args.rate,
+                "backlog_limit": args.fairshed_backlog,
+            }
+            try:
+                fsec = _scrape_fairshed(master)
+            except Exception as e:
+                fsec = {"error": f"scrape failed: {e}"}
+            if "error" not in fsec:
+                fsec["retried_429"] = sum(
+                    int(s.get("retried_429", 0)) for s in stats
+                    if isinstance(s, dict))
+                lower_shed = sum(
+                    sum(d["shed"].values())
+                    for f, d in fsec["flows"].items() if f != "system")
+                record["overload"]["sheds_ok"] = (
+                    lower_shed > 0 and fsec["system_shed"] == 0)
+                print(f"[churn-mp] fairshed: {fsec['shed_total']} shed "
+                      f"({lower_shed} in lower bands, system "
+                      f"{fsec['system_shed']} — must be 0), "
+                      f"{fsec['admitted_total']} admitted, feeders "
+                      f"retried {fsec['retried_429']} 429s, backlog "
+                      f"depth {fsec['backlog_depth']} "
+                      f"(limit {args.fairshed_backlog})",
+                      file=sys.stderr, flush=True)
+                if args.overload and not record["overload"]["sheds_ok"]:
+                    print("[churn-mp] WARNING: overload run but lower-"
+                          "band sheds are zero (or system shed "
+                          "nonzero) — the governor never engaged",
+                          file=sys.stderr, flush=True)
+            record["fairshed"] = fsec
         if args.lag_storm:
             # marks the record as an induced-storm shape: perfgate's
             # shape key keeps it out of the clean trajectory's baselines
@@ -2223,7 +2465,7 @@ def main(argv=None) -> int:
                       file=sys.stderr, flush=True)
         _chaos_record_sections(record)
         flush_flightrec(record)
-        missing = validate_record(record, round_no=14)
+        missing = validate_record(record, round_no=15)
         if missing:
             print(f"[churn-mp] WARNING: record missing contract fields: "
                   f"{missing}", file=sys.stderr, flush=True)

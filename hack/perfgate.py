@@ -85,6 +85,11 @@ def shape_key(rec: dict) -> str:
         # kube-chaos runs kill and respawn components mid-run: their
         # sustained rate measures recovery, not the clean control plane
         suffix += "+chaos"
+    if rec.get("overload"):
+        # kube-fairshed overload runs offer ≥ 2x sustained capacity ON
+        # PURPOSE and shed the excess: their sustained rate measures
+        # the admission governor, not the clean control plane
+        suffix += "+overload"
     return cfg + suffix
 
 

@@ -286,7 +286,9 @@ class SLORule:
 def default_churn_rules(binds_floor: float = 50.0,
                         solve_p50_ceil_s: float = 2.0,
                         queue_ceil: float = 48.0,
-                        rss_ceil_bytes: float = 8 << 30) -> List[SLORule]:
+                        rss_ceil_bytes: float = 8 << 30,
+                        admitted_e2e_ceil_s: Optional[float] = None
+                        ) -> List[SLORule]:
     """The churn-contract SLO set the r11+ records are judged against:
     a clean run must end with zero alarm transitions.
 
@@ -295,7 +297,7 @@ def default_churn_rules(binds_floor: float = 50.0,
     conservatively reports that bound when the rank overflows the
     envelope, so a threshold above it could never fire — silent exactly
     when the regression is largest."""
-    return [
+    rules = [
         # the headline: work must keep flowing while load is offered
         SLORule("sustained_binds_floor", "scheduler_wave_pods_total",
                 reduce="rate", op="floor", threshold=binds_floor,
@@ -393,7 +395,34 @@ def default_churn_rules(binds_floor: float = 50.0,
                 reduce="p95", op="ceil", threshold=45.0,
                 window_s=120.0, for_s=0.0, scope="sum",
                 active_only=True),
+        # kube-fairshed (docs/design/apiserver-hotpath.md): the
+        # starvation-freedom invariant, live — system-flow requests
+        # (scheduler binds, reflector list/watch, healthz) are
+        # structurally isolated from lower bands, so ANY system shed
+        # is an isolation bug. Not active_only: a system shed during
+        # warmup or teardown is just as much a bug.
+        SLORule("system_flow_shed_zero", ("fairshed_system_shed_total",),
+                reduce="last", op="ceil", threshold=0.0, scope="sum"),
     ]
+    if admitted_e2e_ceil_s is not None:
+        # the overload contract's headline, armed ONLY when the fairshed
+        # backlog governor is (hack/churn_mp passes 10.0 with
+        # --fairshed-backlog/--overload): pods the control plane ADMITS
+        # must ride through promptly — the governor bounds the
+        # created-but-unbound queue, so the admitted-pod e2e p50 stays
+        # under this ceiling (the unprotected r11 baseline sat at 37 s,
+        # which an UNgoverned clean contract run legitimately does:
+        # adding this rule unconditionally would fire on every existing
+        # clean heavy shape and break their alarms-[] contract).
+        # Threshold must sit on a finite bucket of POD_E2E_BUCKETS
+        # (10 s) well below the 120 s top, so an overflow conservatively
+        # fires instead of reading 'no data'.
+        rules.append(SLORule(
+            "admitted_e2e_ceiling", "pod_e2e_scheduling_seconds",
+            reduce="p50", op="ceil", threshold=admitted_e2e_ceil_s,
+            window_s=60.0, for_s=10.0, service="scheduler",
+            scope="sum", active_only=True))
+    return rules
 
 
 class SLOWatchdog:

@@ -114,9 +114,16 @@ def new_internal_error(message: str) -> StatusError:
     return _status(500, api.ReasonInternalError, message)
 
 
-def new_too_many_requests(message: str = "rate limit exceeded") -> StatusError:
-    """ref: handlers.go RateLimit — the read-only port's 429."""
-    return _status(429, api.ReasonTooManyRequests, message)
+def new_too_many_requests(message: str = "rate limit exceeded",
+                          retry_after_s: int = 0) -> StatusError:
+    """ref: handlers.go RateLimit — the read-only port's 429, grown a
+    ``retry_after_s`` hint (kube-fairshed): the same number the
+    Retry-After header carries also rides ``details.retryAfterSeconds``
+    so JSON clients that never see response headers (error bodies
+    decoded through from_status) can still honor it."""
+    details = api.StatusDetails(retry_after_seconds=int(retry_after_s)) \
+        if retry_after_s else None
+    return _status(429, api.ReasonTooManyRequests, message, details)
 
 
 def new_expired(message: str) -> StatusError:

@@ -40,7 +40,9 @@ from kubernetes_tpu import version as version_pkg
 from kubernetes_tpu import watch as watchpkg
 from kubernetes_tpu.api import errors
 from kubernetes_tpu.api import types as api
+from kubernetes_tpu.apiserver import fairshed as fairshed_mod
 from kubernetes_tpu.auth import AuthRequest
+from kubernetes_tpu.util import chaos
 from kubernetes_tpu.util import metrics as metrics_pkg
 from kubernetes_tpu.util import tracing
 
@@ -297,9 +299,11 @@ class _Handler(BaseHTTPRequestHandler):
                 apisrv.default_version)
         elif rl is not None and not rl.can_accept():
             code = 429
-            self._send_status_error(errors.new_too_many_requests(),
-                                    apisrv.default_version,
-                                    extra_headers=(("Retry-After", "1"),))
+            hint = apisrv.retry_after_hint()
+            self._send_status_error(
+                errors.new_too_many_requests(retry_after_s=hint),
+                apisrv.default_version,
+                extra_headers=(("Retry-After", str(hint)),))
         else:
             code = 501
             self.send_error(code, "Unsupported method ('OPTIONS')")
@@ -373,6 +377,7 @@ class _Handler(BaseHTTPRequestHandler):
         parts = [p for p in parsed.path.split("/") if p]
         self._cors_check()   # stamps headers on the response if allowed
         code = 200
+        self._fs_ticket = None   # per-request (keep-alive reuses self)
         verb_label = method.lower()
         self._metric_resource = (parts + ["", "", ""])[2]
         # Always drain the body up front: unread bytes would desync the
@@ -397,11 +402,48 @@ class _Handler(BaseHTTPRequestHandler):
             rl = apisrv.rate_limiter
             if rl is not None and not rl.can_accept():
                 code = 429
-                self._send_status_error(errors.new_too_many_requests(),
-                                        self._version_of(parts),
-                                        extra_headers=(("Retry-After", "1"),))
+                hint = apisrv.retry_after_hint()
+                self._send_status_error(
+                    errors.new_too_many_requests(retry_after_s=hint),
+                    self._version_of(parts),
+                    extra_headers=(("Retry-After", str(hint)),))
                 return
+            # kube-fairshed flow-classified admission (docs/design/
+            # apiserver-hotpath.md): classify by path/user-agent, take
+            # (or wait for) an inflight slot in the request's OWN flow,
+            # shed with 429 + a measured-drain Retry-After when the
+            # flow's queue or the workload backlog governor says no.
+            # System traffic never waits on lower bands — isolation is
+            # per-flow by construction.
+            fs = apisrv.fairshed
+            flow = ""
+            if fs is not None:
+                flow = fairshed_mod.classify(
+                    method, parts, self.headers.get("User-Agent"))
+                _head, res, sub = fairshed_mod.route_info(parts)
+                try:
+                    self._fs_ticket = fs.admit(
+                        flow, pod_create=(method == "POST"
+                                          and res == "pods" and not sub))
+                except fairshed_mod.Shed as e:
+                    code = 429
+                    hint = max(1, int(-(-e.retry_after_s // 1)))
+                    self._send_status_error(
+                        errors.new_too_many_requests(
+                            f"{e.flow} flow over capacity "
+                            f"({e.reason}); retry in {hint}s",
+                            retry_after_s=hint),
+                        self._version_of(parts),
+                        extra_headers=(("Retry-After", str(hint)),))
+                    return
             user = self._authenticate(apisrv)
+            # kube-chaos gray-latency twins: the harness's
+            # component@T:delay=MS schedule pauses a live process; these
+            # seams inject the same stall in-process so tier-1 proves
+            # flow isolation under slowness without process churn
+            chaos.delay_if_armed("apiserver.dispatch")
+            if flow:
+                chaos.delay_if_armed("apiserver.dispatch." + flow)
             if self._trace_ctx is not None:
                 with tracing.span("http." + verb_label,
                                   parent=self._trace_ctx,
@@ -424,6 +466,9 @@ class _Handler(BaseHTTPRequestHandler):
             except Exception:
                 pass
         finally:
+            ticket = self._fs_ticket
+            if ticket is not None:
+                ticket.release()   # idempotent: watches released early
             apisrv.metric_requests.inc(verb_label, self._metric_resource,
                                        self.client_address[0], str(code))
             elapsed = time.monotonic() - started
@@ -622,6 +667,17 @@ class _Handler(BaseHTTPRequestHandler):
             subresource=subresource, label_selector=label_sel,
             field_selector=field_sel, user=user)
         code = 201 if verb == "create" else 200
+        fs = apisrv.fairshed
+        if fs is not None and resource == "pods":
+            # workload backlog governor ledger: pods entering the
+            # pending set, pods bound (the per-pod binding subresource;
+            # the batch endpoint counts its own), pods leaving
+            if verb == "create" and not subresource:
+                fs.note_pod_created()
+            elif verb == "create" and subresource == "binding":
+                fs.note_pods_bound(1)
+            elif verb == "delete" and not subresource:
+                fs.note_pod_deleted()
         if out is None:
             ok = api.Status(status=api.StatusSuccess, code=code)
             self._send_json(code, apisrv.scheme.encode(ok, version))
@@ -660,6 +716,9 @@ class _Handler(BaseHTTPRequestHandler):
             # of its CAS event is a byte copy for every watcher
             on_bound=lambda pod: apisrv.seed_frame(pod, version))
         payload = apisrv.scheme.encode(out, version)
+        if apisrv.fairshed is not None:
+            bound = sum(1 for item in out.items if not item.error)
+            apisrv.fairshed.note_pods_bound(bound)
         apisrv.metric_batch_bind_size.observe(len(body.items))
         apisrv.metric_batch_bind_seconds.observe(time.monotonic() - started)
         self._send_json(200, payload)
@@ -780,6 +839,13 @@ class _Handler(BaseHTTPRequestHandler):
             # frame-observation spans onto the same trace
             self.send_header(tracing.HEADER, tracing.wire(self._trace_ctx))
         self.end_headers()
+        # fairshed: the admission slot covered the watch SETUP; the
+        # long-lived stream itself must not pin an inflight slot (the
+        # scheduler's reflectors live for the whole run — they would
+        # permanently exhaust the system budget)
+        ticket = getattr(self, "_fs_ticket", None)
+        if ticket is not None:
+            ticket.release()
         try:
             lagged = False
             while not lagged:
@@ -823,6 +889,11 @@ class _Handler(BaseHTTPRequestHandler):
         if getattr(self, "_trace_ctx", None) is not None:
             self.send_header(tracing.HEADER, tracing.wire(self._trace_ctx))
         self.end_headers()
+        # fairshed: release the admission slot at stream start, like the
+        # chunked variant — a long-lived stream never pins inflight
+        ticket = getattr(self, "_fs_ticket", None)
+        if ticket is not None:
+            ticket.release()
 
         # one writer lock: PONGs from the reader thread and event frames
         # from this thread interleave bytes otherwise (sendall is not
@@ -937,8 +1008,13 @@ class APIServer:
                  node_locator=None, kubelet_port: int = 10250,
                  reuse_port: bool = False, cors_allowed_origins=(),
                  read_only: bool = False, rate_limiter=None,
-                 watch_lag_limit: int = 65536):
+                 watch_lag_limit: int = 65536, fairshed=None):
         self.master = master
+        # kube-fairshed flow-classified admission (apiserver/fairshed.py;
+        # None disables — zero cost on the request path). The binary
+        # enables it by default; the overload harness adds the workload
+        # backlog governor on top.
+        self.fairshed = fairshed
         # per-HTTP-watcher queue bound: past it, modify events coalesce and
         # anything uncoalescible drops the watcher to resync (410 ERROR
         # frame + end-of-stream; the client re-lists). 0/None disables.
@@ -1081,6 +1157,18 @@ class APIServer:
         self._httpd.server_close()
         if self._thread:
             self._thread.join(timeout=5)
+
+    def retry_after_hint(self) -> int:
+        """Whole-seconds Retry-After for the token-bucket 429 sites
+        (read-only port): the limiter's own measured refill delay,
+        clamped to [1, 30] — the hardcoded '1' these sites used to ship
+        told a dry-bucket client to hammer a throttled port once per
+        second forever."""
+        rl = self.rate_limiter
+        s = 1.0
+        if rl is not None and hasattr(rl, "retry_after_s"):
+            s = rl.retry_after_s()
+        return max(1, min(30, int(-(-s // 1))))
 
     def is_resource(self, name: str) -> bool:
         try:

@@ -82,13 +82,23 @@ class HTTPTransport:
                  auth: Optional[tuple] = None, timeout: float = 30.0,
                  ca_cert: str = "", client_cert: str = "", client_key: str = "",
                  insecure_skip_tls_verify: bool = False,
-                 connect_retry_s: float = 15.0):
+                 connect_retry_s: float = 15.0,
+                 throttle_retry_s: float = 20.0,
+                 user_agent: str = ""):
         # restart transparency (docs/design/ha.md): a refused/failed
         # CONNECT — an apiserver worker mid-respawn — retries with
         # capped exponential backoff + jitter for up to connect_retry_s
         # before surfacing. Nothing was sent, so the retry can never
         # double-execute. 0 disables (fail-fast probes).
         self.connect_retry_s = connect_retry_s
+        # kube-fairshed: a 429 means the server REFUSED the request
+        # before executing it, so retrying is always safe (any method).
+        # The transport honors the server's Retry-After for up to
+        # throttle_retry_s before surfacing the StatusError (which
+        # still carries details.retryAfterSeconds for the caller).
+        # 0 disables (fail-fast).
+        self.throttle_retry_s = throttle_retry_s
+        self.throttled_retries = 0   # disclosed by harness/tests
         self.base_url = base_url.rstrip("/")
         self.scheme = scheme or default_scheme
         self.version = version or test_version_override \
@@ -109,6 +119,12 @@ class HTTPTransport:
         self._tl = threading.local()   # per-thread kept-alive connection
         self._event_cache = _EventDecodeCache()
         self._headers: Dict[str, str] = {"Content-Type": "application/json"}
+        if user_agent:
+            # fairshed classifies by user-agent: control-plane
+            # components (kube-scheduler, kubelet, ...) identify
+            # themselves so their reflector/bind traffic rides the
+            # system flow instead of competing with workload writes
+            self._headers["User-Agent"] = user_agent
         if auth is not None:
             if auth[0] == "basic":
                 raw = base64.b64encode(f"{auth[1]}:{auth[2]}".encode()).decode()
@@ -259,45 +275,70 @@ class HTTPTransport:
             w = tracing.wire()
             if w:
                 headers[tracing.HEADER] = w
-        deadline = time.monotonic() + self.connect_retry_s
-        connect_backoff = Backoff(base=0.05, cap=1.0)
-        for attempt in (0, 1):
-            while True:
+        throttle_deadline = None   # armed on the first 429
+        throttle_backoff = None
+        while True:
+            deadline = time.monotonic() + self.connect_retry_s
+            connect_backoff = Backoff(base=0.05, cap=1.0)
+            for attempt in (0, 1):
+                while True:
+                    try:
+                        conn = self._conn()
+                        break
+                    except (ConnectionError, TimeoutError):
+                        # TRANSIENT connect failure (refused/reset/timeout —
+                        # an apiserver worker mid-respawn): no bytes out, so
+                        # retrying is always safe. Permanent failures (DNS
+                        # gaierror, TLS cert verification) fall through and
+                        # surface immediately — backing off on those would
+                        # turn a typo'd --master into a silent 15 s stall.
+                        if self.connect_retry_s <= 0 or \
+                                time.monotonic() + connect_backoff.peek() \
+                                >= deadline:
+                            raise
+                        connect_backoff.sleep_next()
+                sent = False
                 try:
-                    conn = self._conn()
+                    conn.request(method, path, body=body, headers=headers)
+                    sent = True
+                    resp = conn.getresponse()
+                    raw = resp.read()
+                    status = resp.status
+                    retry_after = resp.getheader("Retry-After")
+                    if resp.will_close:
+                        self._drop_conn()
                     break
-                except (ConnectionError, TimeoutError):
-                    # TRANSIENT connect failure (refused/reset/timeout —
-                    # an apiserver worker mid-respawn): no bytes out, so
-                    # retrying is always safe. Permanent failures (DNS
-                    # gaierror, TLS cert verification) fall through and
-                    # surface immediately — backing off on those would
-                    # turn a typo'd --master into a silent 15 s stall.
-                    if self.connect_retry_s <= 0 or \
-                            time.monotonic() + connect_backoff.peek() \
-                            >= deadline:
-                        raise
-                    connect_backoff.sleep_next()
-            sent = False
-            try:
-                conn.request(method, path, body=body, headers=headers)
-                sent = True
-                resp = conn.getresponse()
-                raw = resp.read()
-                status = resp.status
-                if resp.will_close:
+                except (http.client.HTTPException, ConnectionError, OSError):
                     self._drop_conn()
-                break
-            except (http.client.HTTPException, ConnectionError, OSError):
-                self._drop_conn()
-                # Once a non-idempotent request has gone out in full, the
-                # server may have executed it even though the response never
-                # arrived — a blind re-send would duplicate the create/delete
-                # (spurious 409/404). Surface the connection error instead,
-                # exactly as Go refuses to retry non-replayable requests
-                # (net/http transport.go shouldRetryRequest/isReplayable).
-                if attempt or (sent and not idempotent):
-                    raise
+                    # Once a non-idempotent request has gone out in full, the
+                    # server may have executed it even though the response
+                    # never arrived — a blind re-send would duplicate the
+                    # create/delete (spurious 409/404). Surface the
+                    # connection error instead, exactly as Go refuses to
+                    # retry non-replayable requests (net/http transport.go
+                    # shouldRetryRequest/isReplayable).
+                    if attempt or (sent and not idempotent):
+                        raise
+            if status == 429 and self.throttle_retry_s > 0:
+                # kube-fairshed shed: the server REFUSED this request
+                # before doing any work, so a resend can never
+                # double-execute — honor its measured Retry-After
+                # (falling back to jittered exponential backoff) within
+                # the throttle window, then surface the 429.
+                now = time.monotonic()
+                if throttle_deadline is None:
+                    throttle_deadline = now + self.throttle_retry_s
+                    throttle_backoff = Backoff(base=0.5, cap=5.0)
+                try:
+                    hint = float(retry_after) if retry_after else 0.0
+                except ValueError:
+                    hint = 0.0
+                delay = hint if hint > 0 else throttle_backoff.next()
+                if now + delay < throttle_deadline:
+                    self.throttled_retries += 1
+                    time.sleep(delay)
+                    continue
+            break
         if status >= 400:
             self._raise_status_error(raw, status)
         return status, raw

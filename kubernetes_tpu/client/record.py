@@ -110,6 +110,18 @@ class AsyncEventRecorder:
     control loop keeps running and old events are shed, never the loop
     blocked (events are best-effort diagnostics, not state)."""
 
+    # Priority-aware shedding (kube-fairshed): when the queue is full
+    # or the --event-qps bucket runs dry, SUCCESS chatter sheds before
+    # diagnostics — the r13 record disclosed 46,878 drops chosen
+    # blindly, and every one could have been a FailedScheduling. These
+    # reasons are the routine per-pod success events (the scheduler's
+    # Scheduled, the kubelet's image/container lifecycle ticks); a
+    # reason NOT listed here (FailedScheduling, preemption/chaos
+    # evidence, kill reasons) is high priority and is only ever dropped
+    # when no low-priority victim exists.
+    LOW_PRIORITY_REASONS = frozenset(
+        {"Scheduled", "Pulled", "Created", "Started"})
+
     def __init__(self, recorder: EventRecorder, max_queue: int = 4096,
                  qps: float = 0.0, burst: int = 100):
         self.recorder = recorder
@@ -126,6 +138,10 @@ class AsyncEventRecorder:
         self._qps = qps
         self._tokens = float(burst)
         self._burst = float(burst)
+        # priority reserve: the token headroom low-priority events may
+        # not touch (so the last tokens always go to diagnostics).
+        # burst=1 keeps no reserve — a bucket that small cannot spare one.
+        self._reserve = min(1.0, max(0.0, float(burst) - 1.0))
         self._last = time.monotonic()
         # `dropped` stays as the legacy attribute (rate-limit drops
         # only, as before); the registered counter family is the
@@ -138,30 +154,65 @@ class AsyncEventRecorder:
                                         name="event-recorder")
         self._worker.start()
 
-    def _admit(self) -> bool:
+    def _admit(self, low_priority: bool) -> bool:
+        """Token-bucket admission with a priority reserve: low-priority
+        events need ``1 + reserve`` tokens, high-priority need 1 — so
+        as the bucket drains, Scheduled chatter sheds FIRST while the
+        remaining tokens stay available for diagnostics. A dry bucket
+        still caps everything (the --event-qps contract holds for a
+        pure-diagnostics storm too)."""
         if self._qps <= 0:
             return True
         now = time.monotonic()
         self._tokens = min(self._burst,
                            self._tokens + (now - self._last) * self._qps)
         self._last = now
-        if self._tokens < 1.0:
+        need = 1.0 + (self._reserve if low_priority else 0.0)
+        if self._tokens < need:
             self.dropped += 1
-            self._mx.dropped.inc("rate_limited")
+            # a low-priority event turned away while the reserve kept
+            # tokens for diagnostics is a PRIORITY shed; a drop that
+            # would have hit any reason is plain rate limiting
+            self._mx.dropped.inc("shed_low_priority"
+                                 if low_priority and self._tokens >= 1.0
+                                 else "rate_limited")
             return False
         self._tokens -= 1.0
         return True
 
     def eventf(self, obj: Any, reason: str, message_fmt: str, *args) -> None:
+        low = reason in self.LOW_PRIORITY_REASONS
         with self._cond:
-            if self._stopped or not self._admit():
+            if self._stopped or not self._admit(low):
                 return
-            if self._q.maxlen is not None and \
-                    len(self._q) == self._q.maxlen:
-                # deque(maxlen) sheds the OLDEST entry on append — count
-                # the loss the storm is about to cause
-                self._mx.dropped.inc("queue_full")
-            self._q.append((obj, reason, message_fmt, args))
+            q = self._q
+            if q.maxlen is not None and len(q) == q.maxlen:
+                # priority-aware shedding at the bound: drop Scheduled
+                # before FailedScheduling. If the OLDEST entry is
+                # already low priority, this is the legacy drop-oldest
+                # (reason queue_full); priority only earns its bucket
+                # when it changes the outcome — evicting a deeper low
+                # to protect queued diagnostics, or refusing a
+                # low-priority arrival so queued diagnostics survive.
+                if q[0][1] in self.LOW_PRIORITY_REASONS:
+                    q.popleft()
+                    self._mx.dropped.inc("queue_full")
+                else:
+                    victim = next((i for i in range(len(q))
+                                   if q[i][1] in self.LOW_PRIORITY_REASONS),
+                                  None)
+                    if victim is not None:
+                        del q[victim]
+                        self._mx.dropped.inc("shed_low_priority")
+                    elif low:
+                        # queue is all diagnostics: the arriving
+                        # success event is the one that sheds
+                        self._mx.dropped.inc("shed_low_priority")
+                        return
+                    else:
+                        q.popleft()
+                        self._mx.dropped.inc("queue_full")
+            q.append((obj, reason, message_fmt, args))
             self._cond.notify()
 
     def _run(self) -> None:

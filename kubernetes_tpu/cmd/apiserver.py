@@ -72,6 +72,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "watcher lagging past it is dropped to resync "
                         "(410 ERROR frame; the client re-lists). "
                         "0 disables.")
+    p.add_argument("--fairshed", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="kube-fairshed flow-classified admission "
+                        "(docs/design/apiserver-hotpath.md): every "
+                        "request rides an isolated per-flow inflight "
+                        "budget (system / workload / best-effort) and "
+                        "excess sheds with 429 + a measured-drain "
+                        "Retry-After. Default budgets are generous "
+                        "enough to be invisible below overload; "
+                        "--no-fairshed disables the layer entirely.")
+    p.add_argument("--fairshed-backlog", "--fairshed_backlog", type=int,
+                   default=0,
+                   help="workload backlog governor: shed pod creates "
+                        "once created-but-unbound pods exceed this, "
+                        "with Retry-After derived from the measured "
+                        "bind drain rate — bounds the invisible e2e "
+                        "backlog queue under overload. 0 disables. "
+                        "Exact accounting needs one worker serving "
+                        "both creates and binds (see the design doc).")
     p.add_argument("--trace", action="store_true",
                    help="kube-trace: record handler/store spans for "
                         "requests carrying an X-KTPU-Trace header (a "
@@ -138,12 +157,17 @@ def build_server(opts, ready_event: Optional[threading.Event] = None):
     ))
     cors = [o for o in
             getattr(opts, "cors_allowed_origins", "").split(",") if o]
+    fs = None
+    if getattr(opts, "fairshed", True):
+        from kubernetes_tpu.apiserver.fairshed import FairShed
+        fs = FairShed(backlog_limit=getattr(opts, "fairshed_backlog", 0))
     srv = APIServer(master, host=opts.address, port=opts.port,
                     authenticator=authenticator,
                     kubelet_port=opts.kubelet_port,
                     reuse_port=getattr(opts, "reuse_port", False),
                     cors_allowed_origins=cors,
-                    watch_lag_limit=getattr(opts, "watch_lag_limit", 65536))
+                    watch_lag_limit=getattr(opts, "watch_lag_limit", 65536),
+                    fairshed=fs)
     ro_port = getattr(opts, "read_only_port", 0)
     if ro_port:
         # the kubernetes-ro companion (ref: cmd server.go:267-276):

@@ -43,7 +43,7 @@ def build_manager(opts):
     if opts.machines and opts.cloud_provider:
         raise ValueError("--machines and --cloud-provider are mutually "
                          "exclusive (static list vs cloud discovery)")
-    client = Client(HTTPTransport(opts.master))
+    client = Client(HTTPTransport(opts.master, user_agent="kube-controller-manager"))
     static_nodes = [
         api.Node(metadata=api.ObjectMeta(name=name),
                  spec=api.NodeSpec(capacity={

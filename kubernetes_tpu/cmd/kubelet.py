@@ -62,7 +62,7 @@ def build_kubelet(opts):
     capabilities.setup(getattr(opts, "allow_privileged", False))
 
     hostname = opts.hostname_override or socket.gethostname()
-    client = Client(HTTPTransport(opts.api_servers))
+    client = Client(HTTPTransport(opts.api_servers, user_agent="kubelet"))
     # async like the scheduler (and the reference's StartRecording
     # goroutine, event.go:53): the sync loop was posting events
     # SYNCHRONOUSLY, stalling pod lifecycle on an apiserver round-trip

@@ -29,7 +29,7 @@ def build_proxy(opts):
     from kubernetes_tpu.proxy.proxier import Proxier
     from kubernetes_tpu.util.iptables import ExecIPTables, FakeIPTables
 
-    client = Client(HTTPTransport(opts.master))
+    client = Client(HTTPTransport(opts.master, user_agent="kube-proxy"))
     ipt = ExecIPTables() if opts.real_iptables else FakeIPTables()
     proxier = Proxier(listen_ip=opts.bind_address, iptables=ipt)
     svc_cfg = ServiceConfig(client, [proxier.on_update])

@@ -225,7 +225,10 @@ def build_scheduler(opts):
     from kubernetes_tpu.scheduler import plugins as schedplugins
     from kubernetes_tpu.scheduler.driver import ConfigFactory, Scheduler
 
-    client = Client(HTTPTransport(opts.master))
+    # the user-agent is the fairshed credential: scheduler traffic
+    # (reflector list/watch + the wave commit leg) rides the apiserver's
+    # system flow, structurally isolated from workload create floods
+    client = Client(HTTPTransport(opts.master, user_agent="kube-scheduler"))
     # async like the reference's StartRecording goroutine (event.go:53):
     # recording must never stall scheduleOne/wave loops on an API write
     recorder = AsyncEventRecorder(

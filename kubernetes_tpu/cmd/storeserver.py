@@ -41,6 +41,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--compact-every", "--compact_every", type=int,
                    default=10_000,
                    help="snapshot + truncate the WAL every N records")
+    p.add_argument("--max-inflight", "--max_inflight", type=int, default=0,
+                   help="kube-fairshed overload valve: shed ops past "
+                        "this many concurrent dispatches with a "
+                        "retryable ErrTooManyRequests + measured-drain "
+                        "retry_after hint (RemoteStore honors it "
+                        "transparently). 0 disables.")
     p.add_argument("--metrics-port", "--metrics_port", type=int, default=0,
                    help="serve /metrics, /healthz (recovery disclosure) "
                         "and /debug/vars on this port (0 disables)")
@@ -93,7 +99,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         _serve_debug(opts.metrics_port, service="storeserver",
                      health=health)
-    srv = StoreServer(store, host=opts.address, port=opts.port)
+    srv = StoreServer(store, host=opts.address, port=opts.port,
+                      max_inflight=opts.max_inflight)
     # the "listening" line FIRST — harness readiness checks key on it;
     # the recovery disclosure follows (and stays on /healthz forever)
     print(f"kube-store listening on {srv.address}", flush=True)

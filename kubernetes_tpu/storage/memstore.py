@@ -35,7 +35,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 from kubernetes_tpu import watch as watchpkg
 
 __all__ = ["MemStore", "KV", "StoreEvent", "StoreError", "ErrKeyExists",
-           "ErrKeyNotFound", "ErrCASConflict", "ErrIndexOutdated", "ErrInjected"]
+           "ErrKeyNotFound", "ErrCASConflict", "ErrIndexOutdated",
+           "ErrInjected", "ErrTooManyRequests"]
 
 
 class StoreError(Exception):
@@ -60,6 +61,18 @@ class ErrIndexOutdated(StoreError):
 
 class ErrInjected(StoreError):
     """Raised by scripted error injection in tests."""
+
+
+class ErrTooManyRequests(StoreError):
+    """The store server SHED this op before executing it (kube-fairshed:
+    StoreServer max_inflight overload valve). ``retry_after_s`` is the
+    server's measured-drain hint; a resend can never double-apply —
+    nothing ran. RemoteStore honors the hint transparently."""
+
+    def __init__(self, message: str = "store overloaded",
+                 retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
 
 
 @dataclass

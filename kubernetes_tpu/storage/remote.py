@@ -28,6 +28,7 @@ import socket
 import struct
 import threading
 import time
+from collections import deque
 from typing import List, Optional, Tuple
 
 from kubernetes_tpu import watch as watchpkg
@@ -39,6 +40,7 @@ from kubernetes_tpu.storage.memstore import (
     ErrIndexOutdated,
     ErrKeyExists,
     ErrKeyNotFound,
+    ErrTooManyRequests,
     MemStore,
     StoreError,
     StoreEvent,
@@ -51,6 +53,7 @@ _ERRORS = {
     "ErrKeyNotFound": ErrKeyNotFound,
     "ErrCASConflict": ErrCASConflict,
     "ErrIndexOutdated": ErrIndexOutdated,
+    "ErrTooManyRequests": ErrTooManyRequests,
     "StoreError": StoreError,
 }
 
@@ -97,11 +100,22 @@ def _kv_in(d: Optional[dict]) -> Optional[KV]:
 
 
 def _err_out(e: Exception) -> dict:
-    return {"err": type(e).__name__, "msg": str(e)}
+    out = {"err": type(e).__name__, "msg": str(e)}
+    ra = getattr(e, "retry_after_s", None)
+    if ra is not None:
+        # the throttle hint travels the wire so RemoteStore can honor
+        # the server's measured drain, not guess
+        out["retry_after"] = ra
+    return out
 
 
 def _raise_err(d: dict) -> None:
-    raise _ERRORS.get(d.get("err", ""), StoreError)(d.get("msg", ""))
+    cls = _ERRORS.get(d.get("err", ""), StoreError)
+    if cls is ErrTooManyRequests:
+        raise ErrTooManyRequests(d.get("msg", ""),
+                                 retry_after_s=float(
+                                     d.get("retry_after", 1.0) or 1.0))
+    raise cls(d.get("msg", ""))
 
 
 # -- server ------------------------------------------------------------------
@@ -112,8 +126,17 @@ class StoreServer:
 
     def __init__(self, store: Optional[MemStore] = None,
                  host: str = "127.0.0.1", port: int = 0,
-                 reuse_port: bool = False):
+                 reuse_port: bool = False, max_inflight: int = 0):
         self.store = store if store is not None else MemStore()
+        # kube-fairshed overload valve (0 disables): ops past
+        # max_inflight concurrent dispatches are SHED with
+        # ErrTooManyRequests + a measured-drain retry_after hint
+        # instead of queueing unboundedly on the store lock — the store
+        # analog of the apiserver's 429 + Retry-After
+        self.max_inflight = int(max_inflight)
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self._op_done: "deque" = deque(maxlen=512)  # completion stamps
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         if reuse_port and hasattr(socket, "SO_REUSEPORT"):
@@ -204,7 +227,18 @@ class StoreServer:
                     return  # the connection is consumed by the stream
                 try:
                     chaos.error_if_armed("store.serve.error")
-                    resp = self._dispatch(op, req)
+                    if not self._admit():
+                        resp = _err_out(ErrTooManyRequests(
+                            "store over max-inflight",
+                            retry_after_s=self._throttle_hint()))
+                    else:
+                        try:
+                            # seam INSIDE the admitted slot: tests hold
+                            # a slot occupied for an exact duration
+                            chaos.delay_if_armed("store.serve.busy")
+                            resp = self._dispatch(op, req)
+                        finally:
+                            self._op_complete()
                 except StoreError as e:
                     resp = _err_out(e)
                 _send_frame(conn, resp)
@@ -217,6 +251,34 @@ class StoreServer:
                 conn.close()
             except OSError:
                 pass
+
+    def _admit(self) -> bool:
+        if not self.max_inflight:
+            return True
+        with self._inflight_lock:
+            if self._inflight >= self.max_inflight:
+                return False
+            self._inflight += 1
+            return True
+
+    def _op_complete(self) -> None:
+        if not self.max_inflight:
+            return
+        with self._inflight_lock:
+            self._inflight = max(0, self._inflight - 1)
+            self._op_done.append(time.monotonic())
+
+    def _throttle_hint(self) -> float:
+        """Retry-after from the measured op completion rate — time for
+        one inflight's worth of ops to drain, clamped [0.05, 5] s."""
+        with self._inflight_lock:
+            done = list(self._op_done)
+        now = time.monotonic()
+        recent = [t for t in done if t > now - 5.0]
+        if len(recent) < 2:
+            return 0.2
+        rate = len(recent) / max(1e-3, now - recent[0])
+        return min(5.0, max(0.05, self.max_inflight / rate))
 
     def _dispatch(self, op: str, req: dict) -> dict:
         s = self.store
@@ -334,6 +396,7 @@ class RemoteStore:
         self._call_timeout_s = call_timeout_s
         self._reconnect_window_s = reconnect_window_s
         self._local = threading.local()
+        self.throttled = 0   # ErrTooManyRequests answers ridden out
 
     # -- plumbing ----------------------------------------------------------
     def _connect(self) -> socket.socket:
@@ -382,6 +445,7 @@ class RemoteStore:
     def _call(self, req: dict, idempotent: bool = False):
         deadline = time.monotonic() + self._reconnect_window_s
         retry_backoff = Backoff(base=0.02, cap=0.5)
+        throttle_backoff = Backoff(base=0.05, cap=1.0)
         while True:
             sock = getattr(self._local, "sock", None)
             if sock is not None and self._stale(sock):
@@ -426,6 +490,19 @@ class RemoteStore:
                                  + (f"failed mid-call: {recv_err}"
                                     if recv_err else "closed mid-call"))
             if "err" in resp:
+                if resp.get("err") == "ErrTooManyRequests":
+                    # kube-fairshed: the server SHED this op before
+                    # executing it, so a resend can never double-apply
+                    # (reads AND writes) — honor its measured
+                    # retry_after hint (capped exponential + jitter
+                    # when the server sent none) inside the same window
+                    # every other transient shares, then surface
+                    hint = float(resp.get("retry_after", 0) or 0) \
+                        or throttle_backoff.next()
+                    if time.monotonic() + hint < deadline:
+                        self.throttled += 1
+                        time.sleep(hint)
+                        continue
                 _raise_err(resp)
             return resp["ok"]
 

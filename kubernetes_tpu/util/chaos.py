@@ -29,6 +29,7 @@ failing chaos test replays bit-identically.
 
 from __future__ import annotations
 
+import re
 import threading
 import time
 from typing import Dict, Optional
@@ -36,7 +37,24 @@ from typing import Dict, Optional
 __all__ = ["SimulatedCrash", "inject_crash", "inject_error",
            "inject_delay", "inject_flag", "crash_if_armed",
            "error_if_armed", "delay_if_armed", "take_flag", "armed",
-           "clear"]
+           "clear", "parse_duration"]
+
+
+_DURATION_RE = re.compile(r"^\s*(\d+(?:\.\d+)?)\s*(us|ms|s|m)?\s*$")
+_DURATION_UNITS = {"us": 1e-6, "ms": 1e-3, "s": 1.0, "m": 60.0, None: 1.0}
+
+
+def parse_duration(text: str) -> float:
+    """``'250ms'`` -> 0.25, ``'1.5s'`` -> 1.5, ``'2m'`` -> 120.0; a bare
+    number means seconds. The latency half of the chaos grammar
+    (``apiserver@120s:delay=250ms`` — hack/churn_mp.parse_chaos) and
+    the in-process delay seams share this vocabulary so a live
+    gray-slowness schedule and its tier-1 twin read identically."""
+    m = _DURATION_RE.match(text or "")
+    if m is None:
+        raise ValueError(f"bad duration {text!r}: expected "
+                         "NUMBER[us|ms|s|m]")
+    return float(m.group(1)) * _DURATION_UNITS[m.group(2)]
 
 
 class SimulatedCrash(Exception):

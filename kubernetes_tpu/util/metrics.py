@@ -23,6 +23,7 @@ __all__ = ["Counter", "Gauge", "Histogram", "Registry", "default_registry",
            "EventRecorderMetrics", "event_recorder_metrics",
            "StoreWalMetrics", "store_wal_metrics",
            "ChaosMetrics", "chaos_metrics",
+           "FairshedMetrics", "fairshed_metrics",
            "FlightRecorder", "flightrec_arm", "flightrec_disarm",
            "flightrec_armed", "flightrec_watch", "flightrec_vars",
            "flightrec_sample_now", "flightrec"]
@@ -644,6 +645,62 @@ def chaos_metrics() -> ChaosMetrics:
     if ChaosMetrics._singleton is None:
         ChaosMetrics._singleton = ChaosMetrics()
     return ChaosMetrics._singleton
+
+
+class FairshedMetrics:
+    """kube-fairshed instrumentation (apiserver/fairshed.py): per-flow
+    admission, shedding, queue wait, and the workload backlog governor.
+    Registered HERE so the metrics-sync vet rule binds the churn
+    harness's ``fairshed`` record scrape and the
+    ``system_flow_shed_zero`` SLO rule to the registry universe.
+
+    ``fairshed_system_shed_total`` is an invariant counter: system-flow
+    requests are structurally isolated from lower bands, so any
+    non-zero value is an isolation bug — the overload record contract
+    requires it to read 0."""
+
+    _singleton = None
+
+    def __init__(self, registry: Optional[Registry] = None):
+        reg = registry or default_registry()
+        self.admitted = reg.counter(
+            "request_admitted_total",
+            "Requests admitted through fairshed, by flow", ("flow",))
+        self.shed = reg.counter(
+            "request_shed_total",
+            "Requests answered 429 by fairshed, by flow and reason "
+            "(queue_full / timeout / backlog)", ("flow", "reason"))
+        self.queue_wait = reg.histogram(
+            "request_queue_wait_seconds",
+            "Admission queue wait per admitted request (0 = an "
+            "inflight slot was free)", ("flow",),
+            buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                     1.0, 2.5, 5.0))
+        self.retry_after = reg.histogram(
+            "request_retry_after_seconds",
+            "Retry-After hints handed to shed requests (drain-rate "
+            "derived, clamped 1-30 s)", ("flow",),
+            buckets=(1.0, 2.0, 5.0, 10.0, 30.0))
+        self.inflight = reg.gauge(
+            "request_inflight",
+            "Concurrent dispatches holding a fairshed slot", ("flow",))
+        self.queued = reg.gauge(
+            "request_queue_depth",
+            "Waiters parked for an inflight slot", ("flow",))
+        self.system_shed = reg.counter(
+            "fairshed_system_shed_total",
+            "System-flow requests shed — MUST stay 0 (structural "
+            "isolation invariant; the system_flow_shed_zero SLO rule)")
+        self.backlog = reg.gauge(
+            "fairshed_backlog_depth",
+            "Workload backlog governor: pods created minus pods bound "
+            "as seen by this worker (sheds creates past the limit)")
+
+
+def fairshed_metrics() -> FairshedMetrics:
+    if FairshedMetrics._singleton is None:
+        FairshedMetrics._singleton = FairshedMetrics()
+    return FairshedMetrics._singleton
 
 
 # -- kube-flightrec: continuous in-process metric time-series ---------------

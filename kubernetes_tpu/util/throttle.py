@@ -41,6 +41,21 @@ class TokenBucketRateLimiter:
                 return True
             return False
 
+    def retry_after_s(self) -> float:
+        """Seconds until the next token becomes available, WITHOUT
+        consuming one — the measured Retry-After hint for a 429 from
+        this limiter (kube-fairshed replaced the hardcoded '1' sites
+        with this; the hint is derived from the bucket's actual refill
+        math, not a constant)."""
+        with self._lock:
+            now = self._clock()
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._last) * self.qps)
+            self._last = now
+            if self._tokens >= 1.0:
+                return 0.0
+            return (1.0 - self._tokens) / self.qps
+
     def stop(self) -> None:
         """No background resources; kept for interface parity
         (throttle.go Stop)."""

@@ -1,0 +1,1010 @@
+"""kube-fairshed: flow-classified priority & fairness admission.
+
+Covers the tentpole and its satellites (docs/design/apiserver-hotpath.md):
+flow classification by path/user-agent, per-flow inflight/queue/deadline
+admission with measured-drain Retry-After, the system-flow
+starvation-freedom invariant (proven with the deterministic util/chaos
+seams — no live multi-process stack), the workload backlog governor,
+client-side Retry-After honoring (HTTPTransport, RemoteStore, the
+pipelined replay feeders' 429 backoff-and-resume), priority-aware event
+shedding, the chaos grammar's latency injection, the
+system_flow_shed_zero / admitted_e2e_ceiling SLO rules, the overload
+record contract, and perfgate's +overload shape isolation.
+"""
+
+import importlib.util
+import json
+import os
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api import errors
+from kubernetes_tpu.apiserver import fairshed
+from kubernetes_tpu.apiserver.http import APIServer
+from kubernetes_tpu.apiserver.master import Master, MasterConfig
+from kubernetes_tpu.util import chaos
+from kubernetes_tpu.util import metrics as metrics_pkg
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_churn_mp():
+    spec = importlib.util.spec_from_file_location(
+        "churn_mp", os.path.join(_REPO, "hack", "churn_mp.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _clear_chaos():
+    chaos.clear()
+    yield
+    chaos.clear()
+
+
+def mk_pod_body(name):
+    return json.dumps({
+        "kind": "Pod", "apiVersion": "v1",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"containers": [{"name": "c", "image": "img"}]}}).encode()
+
+
+# -- classification ----------------------------------------------------------
+
+
+class TestClassify:
+    def test_flow_table(self):
+        c = fairshed.classify
+        # observability heads survive overload no matter who asks
+        assert c("GET", ["healthz"], None) == fairshed.SYSTEM
+        assert c("GET", ["metrics"], "anything") == fairshed.SYSTEM
+        assert c("GET", ["debug", "vars"], None) == fairshed.SYSTEM
+        # the bind path is system regardless of credential
+        assert c("POST", ["api", "v1", "namespaces", "d",
+                          "bindings:batch"], None) == fairshed.SYSTEM
+        assert c("POST", ["api", "v1", "namespaces", "d", "pods", "p",
+                          "binding"], None) == fairshed.SYSTEM
+        # component user-agents are system (reflector list/watch + writes)
+        assert c("GET", ["api", "v1", "pods"],
+                 "kube-scheduler/ktpu") == fairshed.SYSTEM
+        assert c("PUT", ["api", "v1", "namespaces", "d", "pods", "p"],
+                 "kubelet/ktpu") == fairshed.SYSTEM
+        # events are best-effort diagnostics no matter who posts
+        assert c("POST", ["api", "v1", "namespaces", "d", "events"],
+                 "kube-scheduler/ktpu") == fairshed.BEST_EFFORT
+        # anonymous writes are workload (the feeders)
+        assert c("POST", ["api", "v1", "namespaces", "d", "pods"],
+                 None) == fairshed.WORKLOAD
+        assert c("DELETE", ["api", "v1", "namespaces", "d", "pods", "p"],
+                 "") == fairshed.WORKLOAD
+        # anonymous reads/watches are best-effort (observers, kubectl)
+        assert c("GET", ["api", "v1", "pods"], None) == fairshed.BEST_EFFORT
+        assert c("GET", ["api", "v1", "watch", "pods"],
+                 "kubectl/1") == fairshed.BEST_EFFORT
+
+    def test_route_info_normalizes_like_the_dispatcher(self):
+        head, res, sub = fairshed.route_info(
+            ["api", "v1", "watch", "namespaces", "d", "pods"])
+        assert (head, res, sub) == ("api", "pods", "")
+        head, res, sub = fairshed.route_info(
+            ["api", "v1", "namespaces", "d", "pods", "p", "binding"])
+        assert (res, sub) == ("pods", "binding")
+        assert fairshed.route_info(["healthz", "ping"])[0] == "healthz"
+
+
+# -- FairShed admission core -------------------------------------------------
+
+
+class TestFairShed:
+    def _shed(self, **kw):
+        flows = {
+            fairshed.WORKLOAD: fairshed.FlowConfig(2, 2, 0.05),
+            fairshed.SYSTEM: fairshed.FlowConfig(2, 4, 0.05),
+            fairshed.BEST_EFFORT: fairshed.FlowConfig(1, 1, 0.05),
+        }
+        return fairshed.FairShed(flows=flows, **kw)
+
+    def test_admit_and_release_within_budget(self):
+        fs = self._shed()
+        t1 = fs.admit(fairshed.WORKLOAD)
+        t2 = fs.admit(fairshed.WORKLOAD)
+        assert fs.snapshot()["workload"]["inflight"] == 2
+        t1.release()
+        t1.release()   # idempotent
+        assert fs.snapshot()["workload"]["inflight"] == 1
+        t2.release()
+        assert fs.snapshot()["workload"]["inflight"] == 0
+
+    def test_queue_full_sheds_with_reason(self):
+        fs = self._shed()
+        tickets = [fs.admit(fairshed.WORKLOAD) for _ in range(2)]
+        # park 2 waiters (the queue bound) from side threads
+        results = []
+
+        def waiter():
+            try:
+                results.append(fs.admit(fairshed.WORKLOAD))
+            except fairshed.Shed as e:
+                results.append(e)
+        ws = [threading.Thread(target=waiter, daemon=True)
+              for _ in range(2)]
+        for w in ws:
+            w.start()
+        time.sleep(0.02)   # both parked
+        with pytest.raises(fairshed.Shed) as ei:
+            fs.admit(fairshed.WORKLOAD)
+        assert ei.value.reason == "queue_full"
+        assert ei.value.retry_after_s >= 1.0
+        for t in tickets:
+            t.release()
+        for w in ws:
+            w.join(timeout=2)
+        # the two parked waiters got the handed-over slots
+        assert sum(1 for r in results
+                   if not isinstance(r, Exception)) == 2
+
+    def test_queue_deadline_sheds_timeout(self):
+        fs = self._shed()
+        held = [fs.admit(fairshed.BEST_EFFORT)]
+        t0 = time.monotonic()
+        with pytest.raises(fairshed.Shed) as ei:
+            fs.admit(fairshed.BEST_EFFORT)
+        assert ei.value.reason == "timeout"
+        assert 0.03 <= time.monotonic() - t0 < 1.0
+        held[0].release()
+        # queue drained: the next admit goes straight through
+        fs.admit(fairshed.BEST_EFFORT).release()
+
+    def test_system_never_queues_behind_lower_bands(self):
+        """Starvation-freedom: workload saturated (inflight full AND
+        queue full) must not delay system admission at all."""
+        fs = self._shed()
+        held = [fs.admit(fairshed.WORKLOAD) for _ in range(2)]
+        parked = []
+
+        def park():
+            try:
+                parked.append(fs.admit(fairshed.WORKLOAD))
+            except fairshed.Shed as e:
+                parked.append(e)
+        ws = [threading.Thread(target=park, daemon=True) for _ in range(2)]
+        for w in ws:
+            w.start()
+        time.sleep(0.02)
+        t0 = time.monotonic()
+        for _ in range(10):
+            fs.admit(fairshed.SYSTEM).release()
+        assert time.monotonic() - t0 < 0.05   # no cross-band wait
+        mx = metrics_pkg.fairshed_metrics()
+        assert mx.system_shed.total() == 0
+        for t in held:
+            t.release()
+        for w in ws:
+            w.join(timeout=2)
+
+    def test_drain_rate_and_retry_after_hint(self):
+        now = [100.0]
+        fs = fairshed.FairShed(clock=lambda: now[0])
+        # 20 completions over 2 s -> ~10/s measured drain
+        for i in range(20):
+            now[0] = 100.0 + i * 0.1
+            fs.admit(fairshed.WORKLOAD).release()
+        rate = fs.drain_rate(fairshed.WORKLOAD)
+        assert 8.0 < rate < 13.0
+        # hint = pending/rate, clamped to >= 1
+        assert fs._hint(30, rate) == pytest.approx(30 / rate, rel=0.01)
+        assert fs._hint(1, rate) == 1.0          # min clamp
+        assert fs._hint(10_000, rate) == 30.0    # max clamp
+        assert fs._hint(5, 0.0) == 2.0           # cold fallback
+
+    def test_backlog_governor_sheds_and_recovers(self):
+        now = [0.0]
+        fs = fairshed.FairShed(backlog_limit=3, clock=lambda: now[0])
+        for _ in range(3):
+            fs.note_pod_created()
+        with pytest.raises(fairshed.Shed) as ei:
+            fs.admit(fairshed.WORKLOAD, pod_create=True)
+        assert ei.value.reason == "backlog"
+        # non-create workload traffic is NOT governed by the backlog
+        fs.admit(fairshed.WORKLOAD).release()
+        # binds drain the ledger: creates admit again, and the hint was
+        # derived from the measured bind rate on the next shed
+        for i in range(2):
+            now[0] = 1.0 + i
+            fs.note_pods_bound(1)
+        assert fs.backlog == 1
+        fs.admit(fairshed.WORKLOAD, pod_create=True).release()
+        fs.note_pod_created()
+        fs.note_pod_created()
+        now[0] = 3.0
+        with pytest.raises(fairshed.Shed) as ei:
+            fs.admit(fairshed.WORKLOAD, pod_create=True)
+        assert ei.value.reason == "backlog"
+        assert 1.0 <= ei.value.retry_after_s <= 30.0
+
+    def test_pod_delete_never_underflows_the_ledger(self):
+        fs = fairshed.FairShed(backlog_limit=10)
+        fs.note_pod_created()
+        fs.note_pods_bound(1)
+        for _ in range(5):
+            fs.note_pod_deleted()
+        assert fs.backlog == 0
+
+
+# -- HTTP wiring + in-process starvation-freedom twin ------------------------
+
+
+class TestFairshedHTTP:
+    def _server(self, **fs_kw):
+        flows = {
+            fairshed.WORKLOAD: fairshed.FlowConfig(1, 0, 0.05),
+            fairshed.SYSTEM: fairshed.FlowConfig(8, 16, 1.0),
+            fairshed.BEST_EFFORT: fairshed.FlowConfig(2, 2, 0.2),
+        }
+        fs = fairshed.FairShed(flows=flows, **fs_kw)
+        return APIServer(Master(MasterConfig()), fairshed=fs).start(), fs
+
+    def test_workload_shed_carries_retry_after_header_and_details(self):
+        srv, fs = self._server()
+        try:
+            # hold the single workload slot via the chaos seam — the
+            # deterministic in-process twin of a slow lower band
+            chaos.inject_delay("apiserver.dispatch.workload", 0.4)
+            results = {}
+
+            def occupy():
+                req = urllib.request.Request(
+                    srv.base_url + "/api/v1/namespaces/default/pods",
+                    data=mk_pod_body("occ"), method="POST",
+                    headers={"Content-Type": "application/json"})
+                results["occ"] = urllib.request.urlopen(req, timeout=5)
+            t = threading.Thread(target=occupy, daemon=True)
+            t.start()
+            time.sleep(0.1)   # the occupier holds the slot inside the seam
+            req = urllib.request.Request(
+                srv.base_url + "/api/v1/namespaces/default/pods",
+                data=mk_pod_body("shed"), method="POST",
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=5)
+            assert ei.value.code == 429
+            hdr = int(ei.value.headers["Retry-After"])
+            assert hdr >= 1
+            body = json.loads(ei.value.read())
+            assert body["reason"] == "TooManyRequests"
+            # the same hint rides the Status details for JSON clients
+            assert body["details"]["retryAfterSeconds"] == hdr
+            t.join(timeout=5)
+            assert results["occ"].status == 201
+        finally:
+            srv.stop()
+
+    def test_system_flow_sails_while_workload_jammed(self):
+        srv, fs = self._server()
+        try:
+            chaos.inject_delay("apiserver.dispatch.workload", 0.5)
+            t = threading.Thread(target=lambda: urllib.request.urlopen(
+                urllib.request.Request(
+                    srv.base_url + "/api/v1/namespaces/default/pods",
+                    data=mk_pod_body("jam"), method="POST",
+                    headers={"Content-Type": "application/json"}),
+                timeout=5), daemon=True)
+            t.start()
+            time.sleep(0.1)
+            t0 = time.monotonic()
+            # healthz (system head) + a scheduler-credentialed list both
+            # ride the isolated system band: no queueing behind the jam
+            assert urllib.request.urlopen(
+                srv.base_url + "/healthz/ping", timeout=5).status == 200
+            req = urllib.request.Request(
+                srv.base_url + "/api/v1/pods",
+                headers={"User-Agent": "kube-scheduler/ktpu"})
+            assert urllib.request.urlopen(req, timeout=5).status == 200
+            assert time.monotonic() - t0 < 0.4
+            assert metrics_pkg.fairshed_metrics().system_shed.total() == 0
+            t.join(timeout=5)
+        finally:
+            srv.stop()
+
+    def test_watch_releases_slot_at_stream_start(self):
+        srv, fs = self._server()
+        try:
+            # two long-lived best-effort watches on a 2-slot budget ...
+            socks = []
+            for _ in range(2):
+                s = socket.create_connection(
+                    ("127.0.0.1", srv.port), timeout=5)
+                s.sendall(b"GET /api/v1/pods?watch=1 HTTP/1.1\r\n"
+                          b"Host: a\r\n\r\n")
+                socks.append(s)
+            time.sleep(0.2)
+            # ... must not pin inflight: a plain best-effort read still
+            # admits because the stream released its slot at setup
+            assert urllib.request.urlopen(
+                srv.base_url + "/api/v1/pods", timeout=5).status == 200
+            assert fs.snapshot()["best-effort"]["inflight"] == 0
+            for s in socks:
+                s.close()
+        finally:
+            srv.stop()
+
+    def test_backlog_governor_end_to_end(self):
+        srv, fs = self._server(backlog_limit=2)
+        try:
+            for i in range(2):
+                req = urllib.request.Request(
+                    srv.base_url + "/api/v1/namespaces/default/pods",
+                    data=mk_pod_body(f"bg{i}"), method="POST",
+                    headers={"Content-Type": "application/json"})
+                assert urllib.request.urlopen(req, timeout=5).status == 201
+            req = urllib.request.Request(
+                srv.base_url + "/api/v1/namespaces/default/pods",
+                data=mk_pod_body("bg-shed"), method="POST",
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=5)
+            assert ei.value.code == 429
+            # bind one through the per-pod binding subresource: the
+            # ledger drains and the governor re-admits
+            node_body = json.dumps({
+                "kind": "Node", "apiVersion": "v1",
+                "metadata": {"name": "n1"}}).encode()
+            urllib.request.urlopen(urllib.request.Request(
+                srv.base_url + "/api/v1/nodes", data=node_body,
+                method="POST",
+                headers={"Content-Type": "application/json"}), timeout=5)
+            bind_body = json.dumps({
+                "kind": "Binding", "apiVersion": "v1",
+                "metadata": {"name": "bg0", "namespace": "default"},
+                "podName": "bg0", "host": "n1"}).encode()
+            urllib.request.urlopen(urllib.request.Request(
+                srv.base_url + "/api/v1/namespaces/default/pods/bg0/"
+                "binding", data=bind_body, method="POST",
+                headers={"Content-Type": "application/json"}), timeout=5)
+            assert fs.backlog == 1
+            req = urllib.request.Request(
+                srv.base_url + "/api/v1/namespaces/default/pods",
+                data=mk_pod_body("bg-ok"), method="POST",
+                headers={"Content-Type": "application/json"})
+            assert urllib.request.urlopen(req, timeout=5).status == 201
+        finally:
+            srv.stop()
+
+    def test_gray_latency_seam_is_the_schedule_twin(self):
+        """component@T:delay=250ms pauses a live process; the
+        apiserver.dispatch seam injects the same stall in-process."""
+        srv, fs = self._server()
+        try:
+            chaos.inject_delay("apiserver.dispatch", 0.15)
+            t0 = time.monotonic()
+            urllib.request.urlopen(srv.base_url + "/api/v1/pods",
+                                   timeout=5)
+            assert time.monotonic() - t0 >= 0.15
+        finally:
+            srv.stop()
+
+
+# -- the replaced Retry-After "1" sites --------------------------------------
+
+
+class TestRateLimiterHints:
+    def test_token_bucket_retry_after_is_measured(self):
+        from kubernetes_tpu.util.throttle import TokenBucketRateLimiter
+        now = [0.0]
+        rl = TokenBucketRateLimiter(qps=2.0, burst=1,
+                                    clock=lambda: now[0])
+        assert rl.retry_after_s() == 0.0
+        assert rl.can_accept()
+        # bucket dry: half a second until the next token at 2 qps
+        assert rl.retry_after_s() == pytest.approx(0.5)
+        now[0] = 0.25
+        assert rl.retry_after_s() == pytest.approx(0.25)
+
+    def test_read_only_port_429_hint_not_constant_one(self):
+        from kubernetes_tpu.util.throttle import TokenBucketRateLimiter
+        rl = TokenBucketRateLimiter(qps=0.01, burst=1)
+        srv = APIServer(Master(MasterConfig()), read_only=True,
+                        rate_limiter=rl).start()
+        try:
+            assert urllib.request.urlopen(
+                srv.base_url + "/healthz/ping", timeout=5).status == 200
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(srv.base_url + "/healthz/ping",
+                                       timeout=5)
+            assert ei.value.code == 429
+            hdr = int(ei.value.headers["Retry-After"])
+            # ~100 s until the next token, clamped at the 30 s lid —
+            # the old hardcoded "1" told clients to hammer every second
+            assert hdr == 30
+            body = json.loads(ei.value.read())
+            assert body["details"]["retryAfterSeconds"] == hdr
+        finally:
+            srv.stop()
+
+    def test_429_status_round_trips_hint_in_details(self):
+        e = errors.new_too_many_requests(retry_after_s=7)
+        from kubernetes_tpu.api.latest import scheme
+        wire = scheme.encode(e.status, "v1")
+        back = scheme.decode(wire, default_version="v1")
+        assert back.details.retry_after_seconds == 7
+        assert errors.from_status(back).code == 429
+
+
+# -- client-side honoring ----------------------------------------------------
+
+
+class _Shed429Server:
+    """Minimal HTTP/1.1 stub: answers 429 + Retry-After for the first
+    ``shed_n`` requests, then 200/201. Keep-alive, pipelining-safe."""
+
+    def __init__(self, shed_n=1, retry_after="0", status=201):
+        self.shed_n = shed_n
+        self.retry_after = retry_after
+        self.status = status
+        self.requests = 0
+        self._lock = threading.Lock()
+        self._sock = socket.socket()
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(8)
+        self.port = self._sock.getsockname()[1]
+        self._stop = False
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        buf = b""
+        try:
+            while True:
+                while b"\r\n\r\n" not in buf:
+                    chunk = conn.recv(65536)
+                    if not chunk:
+                        return
+                    buf += chunk
+                head, _, buf = buf.partition(b"\r\n\r\n")
+                clen = 0
+                for line in head.split(b"\r\n"):
+                    if line.lower().startswith(b"content-length:"):
+                        clen = int(line.split(b":")[1])
+                while len(buf) < clen:
+                    chunk = conn.recv(65536)
+                    if not chunk:
+                        return
+                    buf += chunk
+                buf = buf[clen:]
+                with self._lock:
+                    self.requests += 1
+                    shed = self.requests <= self.shed_n
+                if shed:
+                    body = (b'{"kind": "Status", "status": "Failure", '
+                            b'"reason": "TooManyRequests", "code": 429}')
+                    conn.sendall(
+                        b"HTTP/1.1 429 Too Many Requests\r\n"
+                        b"Retry-After: " + self.retry_after.encode() +
+                        b"\r\nContent-Type: application/json\r\n"
+                        b"Content-Length: " + str(len(body)).encode() +
+                        b"\r\n\r\n" + body)
+                else:
+                    body = b'{"kind": "Status", "status": "Success"}'
+                    conn.sendall(
+                        b"HTTP/1.1 %d OK\r\n"
+                        b"Content-Type: application/json\r\n"
+                        b"Content-Length: %d\r\n\r\n"
+                        % (self.status, len(body)) + body)
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._stop = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class TestHTTPTransportHonorsRetryAfter:
+    def test_429_is_retried_within_window_any_method(self):
+        from kubernetes_tpu.client.http import HTTPTransport
+        srv = _Shed429Server(shed_n=2, retry_after="0")
+        try:
+            tr = HTTPTransport(f"http://127.0.0.1:{srv.port}",
+                               throttle_retry_s=10.0)
+            # a POST: safe to resend because a 429 executed nothing
+            status, raw = tr._open(
+                f"http://127.0.0.1:{srv.port}/api/v1/namespaces/d/pods",
+                "POST", b"{}")
+            assert status == 201
+            assert tr.throttled_retries == 2
+            assert srv.requests == 3
+        finally:
+            srv.stop()
+
+    def test_fail_fast_when_window_disabled(self):
+        from kubernetes_tpu.client.http import HTTPTransport
+        srv = _Shed429Server(shed_n=99)
+        try:
+            tr = HTTPTransport(f"http://127.0.0.1:{srv.port}",
+                               throttle_retry_s=0.0)
+            with pytest.raises(errors.StatusError) as ei:
+                tr._open(f"http://127.0.0.1:{srv.port}/x", "GET")
+            assert ei.value.code == 429
+            assert srv.requests == 1
+        finally:
+            srv.stop()
+
+
+class TestRemoteStoreHonorsThrottle:
+    def test_injected_throttle_error_is_ridden_out(self):
+        from kubernetes_tpu.storage.memstore import (ErrTooManyRequests,
+                                                     MemStore)
+        from kubernetes_tpu.storage.remote import RemoteStore, StoreServer
+        srv = StoreServer(MemStore()).start()
+        try:
+            chaos.inject_error("store.serve.error",
+                               ErrTooManyRequests("busy",
+                                                  retry_after_s=0.02))
+            cli = RemoteStore(srv.address)
+            kv = cli.create("/k", "v")   # shed once, retried, applied once
+            assert kv.key == "/k"
+            assert cli.throttled == 1
+            assert cli.get("/k").value == "v"
+        finally:
+            srv.stop()
+
+    def test_max_inflight_valve_sheds_and_client_recovers(self):
+        from kubernetes_tpu.storage.memstore import MemStore
+        from kubernetes_tpu.storage.remote import RemoteStore, StoreServer
+        srv = StoreServer(MemStore(), max_inflight=1).start()
+        try:
+            # hold the single slot inside the admitted-region seam
+            chaos.inject_delay("store.serve.busy", 0.4)
+            slow = RemoteStore(srv.address)
+            t = threading.Thread(target=lambda: slow.set("/slow", "1"),
+                                 daemon=True)
+            t.start()
+            time.sleep(0.1)
+            fast = RemoteStore(srv.address)
+            kv = fast.set("/fast", "2")   # shed, honored hint, applied
+            assert kv.value == "2"
+            assert fast.throttled >= 1
+            t.join(timeout=5)
+            assert slow.get("/slow").value == "1"
+        finally:
+            srv.stop()
+
+
+# -- feeder 429 semantics ----------------------------------------------------
+
+
+class _FeederStubServer:
+    """Pipelined HTTP stub for the replay feeders: 201 per NEW pod name,
+    409 on a repeat (the already-applied resend), and a scripted 429
+    burst mid-stream (``shed_at`` <= request ordinal < shed_at+shed_n).
+    """
+
+    def __init__(self, shed_at=10, shed_n=1):
+        self.shed_at = shed_at
+        self.shed_n = shed_n
+        self.seen = set()
+        self.count = 0
+        self._lock = threading.Lock()
+        self._sock = socket.socket()
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(8)
+        self.port = self._sock.getsockname()[1]
+        self._stop = False
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        buf = b""
+        try:
+            while True:
+                while b"\r\n\r\n" not in buf:
+                    chunk = conn.recv(65536)
+                    if not chunk:
+                        return
+                    buf += chunk
+                head, _, buf = buf.partition(b"\r\n\r\n")
+                clen = 0
+                for line in head.split(b"\r\n"):
+                    if line.lower().startswith(b"content-length:"):
+                        clen = int(line.split(b":")[1])
+                while len(buf) < clen:
+                    chunk = conn.recv(65536)
+                    if not chunk:
+                        return
+                    buf += chunk
+                body, buf = buf[:clen], buf[clen:]
+                name = json.loads(body)["metadata"]["name"]
+                with self._lock:
+                    self.count += 1
+                    if self.shed_at <= self.count - 1 \
+                            < self.shed_at + self.shed_n:
+                        out = (b"HTTP/1.1 429 Too Many Requests\r\n"
+                               b"Retry-After: 0\r\n"
+                               b"Content-Length: 0\r\n\r\n")
+                    elif name in self.seen:
+                        out = (b"HTTP/1.1 409 Conflict\r\n"
+                               b"Content-Length: 0\r\n\r\n")
+                    else:
+                        self.seen.add(name)
+                        out = (b"HTTP/1.1 201 Created\r\n"
+                               b"Content-Length: 0\r\n\r\n")
+                conn.sendall(out)
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._stop = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class TestFeeder429Semantics:
+    def test_midstream_throttle_storm_resumes_from_acked_prefix(self,
+                                                                capsys):
+        """A 429 burst mid-stream is backoff-and-resume, never poison:
+        all pods delivered, the 429s counted, the already-applied
+        resend tail tolerated as 409s (only in recovery)."""
+        churn_mp = _load_churn_mp()
+        srv = _FeederStubServer(shed_at=10, shed_n=2)
+        try:
+            rc = churn_mp.feed("t429", 40, 5000.0,
+                               f"http://127.0.0.1:{srv.port}", depth=8)
+            assert rc == 0
+            stats = json.loads(capsys.readouterr().out.strip()
+                               .splitlines()[-1])
+            assert stats["created"] == 40
+            assert stats["retried_429"] >= 1
+            assert stats["reconnects"] >= 1
+            assert len(srv.seen) == 40   # every pod applied exactly once
+        finally:
+            srv.stop()
+
+    def test_first_pass_4xx_still_aborts(self, capsys):
+        """429 became retry; a first-pass 400/403 must stay fatal."""
+        churn_mp = _load_churn_mp()
+
+        class _Bad(_FeederStubServer):
+            def _serve(self, conn):
+                try:
+                    conn.recv(65536)
+                    conn.sendall(b"HTTP/1.1 403 Forbidden\r\n"
+                                 b"Content-Length: 0\r\n\r\n")
+                finally:
+                    conn.close()
+        srv = _Bad()
+        try:
+            rc = churn_mp.feed("tbad", 5, 1000.0,
+                               f"http://127.0.0.1:{srv.port}", depth=2)
+            assert rc == 1
+            out = json.loads(capsys.readouterr().out.strip()
+                             .splitlines()[-1])
+            assert "error" in out
+        finally:
+            srv.stop()
+
+
+# -- priority-aware event shedding -------------------------------------------
+
+
+class TestEventPriorityShedding:
+    def _recorder(self, gate=None, **kw):
+        """``gate``: an Event the worker blocks on BEFORE posting — it
+        must be wired before AsyncEventRecorder starts its worker, or
+        the worker can pop the first event ungated (a real race the
+        --race rounds caught)."""
+        from kubernetes_tpu.client.client import Client, InProcessTransport
+        from kubernetes_tpu.client.record import (AsyncEventRecorder,
+                                                  EventRecorder)
+        m = Master()
+        client = Client(InProcessTransport(m))
+        rec = EventRecorder(client, api.EventSource(component="test"))
+        if gate is not None:
+            orig = rec.eventf
+            rec.eventf = \
+                lambda *a, **kws: (gate.wait(10.0), orig(*a, **kws))[1]
+        return client, AsyncEventRecorder(rec, **kw)
+
+    def _pod(self, name):
+        return api.Pod(metadata=api.ObjectMeta(
+            name=name, namespace="default", uid=f"uid-{name}"))
+
+    @staticmethod
+    def _park_worker(arec, pod, reason="FailedScheduling"):
+        """Enqueue one primer event and wait until the worker has
+        POPPED it and parked on the gate — from here on, enqueued
+        events stay in the queue (deterministic occupancy under the
+        --race scheduler too)."""
+        arec.eventf(pod, reason, "primer")
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with arec._cond:
+                if arec._in_flight and not arec._q:
+                    return
+            time.sleep(0.001)
+        raise AssertionError("worker never parked on the gate")
+
+    def test_queue_full_drops_scheduled_before_failedscheduling(self):
+        mx = metrics_pkg.event_recorder_metrics()
+        shed0 = mx.dropped.value("shed_low_priority")
+        gate = threading.Event()
+        client, arec = self._recorder(gate=gate, max_queue=4)
+        try:
+            self._park_worker(arec, self._pod("primer"))
+            # one diagnostic first, then a Scheduled flood past the bound
+            arec.eventf(self._pod("diag"), "FailedScheduling", "no fit")
+            for i in range(10):
+                arec.eventf(self._pod(f"ok{i}"), "Scheduled", "placed")
+            # flood sheds Scheduled (the oldest queued low), never the
+            # older FailedScheduling parked at the head
+            gate.set()
+            assert arec.flush(timeout=10.0)
+            reasons = {e.reason for e in
+                       client.events("default").list().items}
+            assert "FailedScheduling" in reasons
+            assert mx.dropped.value("shed_low_priority") - shed0 >= 1
+        finally:
+            gate.set()
+            arec.stop()
+
+    def test_all_diagnostics_queue_sheds_incoming_low(self):
+        mx = metrics_pkg.event_recorder_metrics()
+        shed0 = mx.dropped.value("shed_low_priority")
+        gate = threading.Event()
+        client, arec = self._recorder(gate=gate, max_queue=3)
+        try:
+            self._park_worker(arec, self._pod("primer"))
+            for i in range(3):
+                arec.eventf(self._pod(f"d{i}"), "FailedScheduling", "x")
+            # queue all-diagnostic and full: the arriving success event
+            # sheds, the diagnostics survive
+            arec.eventf(self._pod("late"), "Scheduled", "placed")
+            gate.set()
+            assert arec.flush(timeout=10.0)
+            evs = client.events("default").list().items
+            assert sorted(e.reason for e in evs) == \
+                ["FailedScheduling"] * 4   # primer + the 3 queued
+            assert mx.dropped.value("shed_low_priority") - shed0 == 1
+        finally:
+            gate.set()
+            arec.stop()
+
+    def test_rate_limit_reserve_sheds_low_keeps_high(self):
+        """As the --event-qps bucket drains, Scheduled sheds first and
+        the reserved last token still admits a FailedScheduling."""
+        client, arec = self._recorder(qps=0.0001, burst=2)
+        try:
+            # burst 2, reserve 1: the first Scheduled takes tokens 2->1,
+            # the second is refused by the reserve (tokens >= 1 kept
+            # for diagnostics), the FailedScheduling takes the last one
+            arec.eventf(self._pod("s1"), "Scheduled", "placed")
+            arec.eventf(self._pod("s2"), "Scheduled", "placed")
+            arec.eventf(self._pod("f1"), "FailedScheduling", "no fit")
+            assert arec.flush(timeout=5.0)
+            reasons = sorted(e.reason for e in
+                             client.events("default").list().items)
+            assert reasons == ["FailedScheduling", "Scheduled"]
+        finally:
+            arec.stop()
+
+    def test_homogeneous_low_traffic_keeps_legacy_accounting(self):
+        """An all-Scheduled storm behaves exactly as before the
+        priority layer: drop-oldest, counted queue_full."""
+        mx = metrics_pkg.event_recorder_metrics()
+        qf0 = mx.dropped.value("queue_full")
+        client, arec = self._recorder(max_queue=4)
+        gate = threading.Event()
+        orig = arec.recorder.eventf
+        arec.recorder.eventf = \
+            lambda *a, **kw: (gate.wait(10.0), orig(*a, **kw))[1]
+        try:
+            for i in range(20):
+                arec.eventf(self._pod(f"h{i}"), "Scheduled", "placed")
+            gate.set()
+            assert arec.flush(timeout=10.0)
+            assert mx.dropped.value("queue_full") - qf0 >= 1
+        finally:
+            gate.set()
+            arec.stop()
+
+
+# -- chaos grammar: latency injection ----------------------------------------
+
+
+class TestChaosLatencyGrammar:
+    def test_parse_duration_units(self):
+        assert chaos.parse_duration("250ms") == pytest.approx(0.25)
+        assert chaos.parse_duration("1.5s") == pytest.approx(1.5)
+        assert chaos.parse_duration("2m") == pytest.approx(120.0)
+        assert chaos.parse_duration("3") == pytest.approx(3.0)
+        assert chaos.parse_duration("500us") == pytest.approx(5e-4)
+        with pytest.raises(ValueError):
+            chaos.parse_duration("soon")
+        with pytest.raises(ValueError):
+            chaos.parse_duration("")
+
+    def test_parse_chaos_mixes_kills_and_delays(self):
+        churn_mp = _load_churn_mp()
+        evs = churn_mp.parse_chaos(
+            "apiserver@120s:delay=250ms,solverd@60s:SIGKILL,"
+            "kube-store@90s:delay=1.5s")
+        assert [e["t_s"] for e in evs] == [60.0, 90.0, 120.0]
+        assert evs[0]["signal"] == "SIGKILL" and "delay_s" not in evs[0]
+        assert evs[1] == {"component": "storeserver", "t_s": 90.0,
+                          "delay_s": 1.5}
+        assert evs[2]["delay_s"] == pytest.approx(0.25)
+        assert "signal" not in evs[2]
+        with pytest.raises(ValueError):
+            churn_mp.parse_chaos("apiserver@5s:delay=soon")
+
+    def test_kill_grammar_unchanged(self):
+        churn_mp = _load_churn_mp()
+        evs = churn_mp.parse_chaos("scheduler@10")
+        assert evs == [{"component": "scheduler0", "t_s": 10.0,
+                        "signal": "SIGKILL"}]
+
+
+# -- SLO rules ---------------------------------------------------------------
+
+
+def _ns(s: float) -> int:
+    return int(s * 1e9)
+
+
+class TestFairshedSLORules:
+    def _rule(self, name):
+        from kubernetes_tpu.addons.monitoring import default_churn_rules
+        return next(r for r in default_churn_rules(admitted_e2e_ceil_s=10.0)
+                    if r.name == name)
+
+    def test_admitted_e2e_ceiling_gated_to_governed_runs(self):
+        """An UNgoverned clean contract run legitimately backlogs to
+        37 s e2e p50 (r11): the ceiling only joins the rule set when
+        the harness arms the backlog governor, or every existing clean
+        heavy shape would lose its alarms-[] claim."""
+        from kubernetes_tpu.addons.monitoring import default_churn_rules
+        assert not any(r.name == "admitted_e2e_ceiling"
+                       for r in default_churn_rules())
+        assert any(r.name == "admitted_e2e_ceiling"
+                   for r in default_churn_rules(admitted_e2e_ceil_s=10.0))
+        # the invariant rule is NOT gated: system isolation is
+        # unconditional
+        assert any(r.name == "system_flow_shed_zero"
+                   for r in default_churn_rules())
+
+    def test_system_flow_shed_zero_fires_and_resolves(self):
+        from kubernetes_tpu.addons.monitoring import SLOWatchdog
+        rule = self._rule("system_flow_shed_zero")
+        assert rule.op == "ceil" and rule.threshold == 0.0
+        assert not rule.active_only   # a warmup shed is just as much a bug
+        dog = SLOWatchdog([rule])
+        tr = dog.observe(rule, 1.0, _ns(5), active=False)
+        assert tr is not None and tr["state"] == "firing"
+        # counters never decrease live; resolve still must work (a
+        # respawned apiserver restarts the counter at 0)
+        tr = dog.observe(rule, 0.0, _ns(10), active=False)
+        assert tr is not None and tr["state"] == "resolved"
+
+    def test_admitted_e2e_ceiling_fires_and_resolves(self):
+        from kubernetes_tpu.addons.monitoring import SLOWatchdog
+        rule = self._rule("admitted_e2e_ceiling")
+        assert rule.active_only and rule.reduce == "p50"
+        # threshold must sit on/below a finite bucket of the e2e
+        # histogram or an overflowed p50 could never fire
+        assert rule.threshold <= max(metrics_pkg.POD_E2E_BUCKETS)
+        assert rule.threshold in metrics_pkg.POD_E2E_BUCKETS
+        dog = SLOWatchdog([rule])
+        assert dog.observe(rule, 37.0, _ns(5), active=False) is None
+        assert dog.observe(rule, 37.0, _ns(6), active=True) is None
+        tr = dog.observe(rule, 37.0, _ns(17), active=True)  # for_s=10
+        assert tr is not None and tr["state"] == "firing"
+        tr = dog.observe(rule, 6.0, _ns(30), active=True)
+        assert tr is not None and tr["state"] == "resolved"
+
+    def test_system_shed_rides_the_aggregated_timeline(self):
+        from kubernetes_tpu.addons.monitoring import FlightAggregator
+        agg = FlightAggregator(
+            [], rules=[self._rule("system_flow_shed_zero")])
+
+        def shard(t_s, total):
+            return {"pid": 9, "service": "apiserver", "period_s": 1.0,
+                    "series": {"fairshed_system_shed_total": {
+                        "type": "counter",
+                        "samples": [[_ns(t_s), total]]}}}
+        for t in range(5):
+            agg.ingest(shard(t, 0.0))
+        agg.evaluate(_ns(4))
+        assert agg.watchdog.firing() == []
+        agg.ingest(shard(5, 2.0))
+        agg.evaluate(_ns(5))
+        assert agg.watchdog.firing() == ["system_flow_shed_zero"]
+
+
+# -- record contract + perfgate ----------------------------------------------
+
+
+def _overload_fairshed_section():
+    return {
+        "flows": {"workload": {"admitted": 100, "shed":
+                               {"backlog": 20}},
+                  "system": {"admitted": 50, "shed": {}},
+                  "best-effort": {"admitted": 5, "shed":
+                                  {"queue_full": 1}}},
+        "admitted_total": 155, "shed_total": 21, "system_shed": 0,
+        "backlog_depth": 12, "queue_wait_p95_s": {"workload": 0.01},
+        "retried_429": 20,
+    }
+
+
+class TestOverloadRecordContract:
+    def test_overload_record_requires_fairshed_section(self):
+        churn_mp = _load_churn_mp()
+        rec = {"error": "n/a"}
+        assert churn_mp.validate_record(rec) == []   # error records exempt
+        rec = {k: 1 for k in churn_mp.RECORD_FIELDS}
+        rec["cpu_budget_s"] = {}
+        rec["overload"] = {"rate_target_per_s": 1000.0,
+                           "backlog_limit": 2500}
+        missing = churn_mp.validate_record(rec, round_no=7)
+        assert "fairshed" in missing
+        rec["fairshed"] = _overload_fairshed_section()
+        assert churn_mp.validate_record(rec, round_no=7) == []
+
+    def test_overload_record_rejects_nonzero_system_shed(self):
+        churn_mp = _load_churn_mp()
+        rec = {k: 1 for k in churn_mp.RECORD_FIELDS}
+        rec["cpu_budget_s"] = {}
+        rec["overload"] = {"rate_target_per_s": 1000.0}
+        rec["fairshed"] = dict(_overload_fairshed_section(),
+                               system_shed=3)
+        missing = churn_mp.validate_record(rec, round_no=7)
+        assert "fairshed.system_shed:nonzero" in missing
+
+    def test_non_overload_records_unaffected(self):
+        churn_mp = _load_churn_mp()
+        rec = {k: 1 for k in churn_mp.RECORD_FIELDS}
+        rec["cpu_budget_s"] = {}
+        assert churn_mp.validate_record(rec, round_no=7) == []
+
+    def test_perfgate_overload_shape_isolated(self):
+        spec = importlib.util.spec_from_file_location(
+            "perfgate", os.path.join(_REPO, "hack", "perfgate.py"))
+        perfgate = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(perfgate)
+        clean = {"config": "churn multi-process: 50000 pods"}
+        over = dict(clean, overload={"rate_target_per_s": 1000.0})
+        assert perfgate.shape_key(over) == \
+            perfgate.shape_key(clean) + "+overload"
+        assert perfgate.shape_key(over) != perfgate.shape_key(clean)

@@ -570,10 +570,15 @@ class TestReadOnlyAndRateLimit:
             with pytest.raises(urllib.error.HTTPError) as ei:
                 urllib.request.urlopen(srv.base_url + "/healthz", timeout=5)
             assert ei.value.code == 429
-            assert ei.value.headers["Retry-After"] == "1"
+            # kube-fairshed: the hint is MEASURED from the bucket's
+            # refill math (clamped 1-30), no longer the constant "1" —
+            # and the same number rides the Status details
+            hdr = int(ei.value.headers["Retry-After"])
+            assert 1 <= hdr <= 30
             body = json.loads(ei.value.read())
             # one Status-encoding path for every error (scheme-encoded)
             assert body["reason"] == "TooManyRequests", body
+            assert body["details"]["retryAfterSeconds"] == hdr
         finally:
             srv.stop()
 
