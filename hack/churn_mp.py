@@ -776,6 +776,20 @@ UNSCHEDULABLE_FIELDS = ("pods", "reasons", "explain_invocations",
 # lower bands shed nothing proves the governor never engaged.
 FAIRSHED_FIELDS = ("flows", "admitted_total", "shed_total", "system_shed",
                    "backlog_depth", "queue_wait_p95_s", "retried_429")
+# kube-defrag evidence, required whenever a record claims a
+# fragment-storm run (a ``fragmentation`` section present): the
+# harness-measured score before/after the defrag window, migrations
+# committed vs lost to commit guards (409/404), nodes drained
+# (cordoned) vs emptied (voluntary consolidation), the cordon-drain
+# contract (every cordoned node fully emptied), the no-half-moves
+# proof (zero unbound pods after the window — an evict without its
+# bind would strand one), and the MUST-BE-ZERO score-regression
+# invariant counter.
+FRAGMENTATION_FIELDS = ("score_before", "score_after", "waves",
+                        "migrations_committed", "migrations_409",
+                        "nodes_drained", "nodes_emptied", "cordoned",
+                        "cordoned_drained_ok", "unbound_after",
+                        "score_regressions")
 
 
 def validate_record(rec: dict, round_no: int = 8) -> list:
@@ -864,6 +878,27 @@ def validate_record(rec: dict, round_no: int = 8) -> list:
                 # CONTRACT: an overload record with system sheds is
                 # non-conformant, not merely unflattering
                 missing.append("fairshed.system_shed:nonzero")
+    if rec.get("fragmentation") is not None:
+        fr = rec["fragmentation"]
+        if not isinstance(fr, dict):
+            missing.append("fragmentation")
+        elif "error" not in fr:
+            missing += [f"fragmentation.{k}" for k in FRAGMENTATION_FIELDS
+                        if k not in fr]
+            # the invariants are part of the record CONTRACT: a
+            # fragment-storm record whose score regressed, whose
+            # cordoned set did not drain, or which left a pod evicted
+            # but unbound is non-conformant, not merely unflattering
+            if fr.get("score_regressions", 0) != 0:
+                missing.append("fragmentation.score_regressions:nonzero")
+            if "cordoned_drained_ok" in fr and \
+                    not fr["cordoned_drained_ok"]:
+                missing.append("fragmentation.cordoned_drained_ok:false")
+            if fr.get("unbound_after", 0) != 0:
+                missing.append("fragmentation.unbound_after:nonzero")
+            if "score_before" in fr and "score_after" in fr and \
+                    fr["score_after"] >= fr["score_before"]:
+                missing.append("fragmentation.score:not-improved")
     if rec.get("chaos") is not None:
         ch = rec["chaos"]
         if not isinstance(ch, dict):
@@ -1093,6 +1128,87 @@ def _scrape_preemption(ports) -> dict:
     out["bind_p95_s"] = round(
         _hist_quantile(buckets, count, 0.95), 4) if count else None
     return out
+
+
+def _scrape_defrag(port: int) -> dict:
+    """kube-defrag evidence from the descheduler's --metrics-port: wave
+    and migration counters, the drain/empty node counts, the declined
+    histogram, and the MUST-BE-ZERO score-regression invariant — the
+    fragment-storm record's ``fragmentation`` section core (the harness
+    adds its own independently computed score_before/score_after)."""
+    raw = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+    out = {"waves": 0, "migrations_committed": 0, "migrations_409": 0,
+           "nodes_drained": 0, "nodes_emptied": 0, "score_regressions": 0,
+           "declined": {}}
+    for key, field in (("defrag_waves_total", "waves"),
+                       ("defrag_migrations_total", "migrations_committed"),
+                       ("defrag_conflicts_total", "migrations_409"),
+                       ("defrag_nodes_drained_total", "nodes_drained"),
+                       ("defrag_nodes_emptied_total", "nodes_emptied"),
+                       ("defrag_score_regressions_total",
+                        "score_regressions")):
+        for line in raw.splitlines():
+            if line.startswith(key + " "):
+                out[field] += int(float(line.rsplit(None, 1)[1]))
+    for line in raw.splitlines():
+        if line.startswith('defrag_declined_total{reason="'):
+            reason = line.split('reason="', 1)[1].split('"', 1)[0]
+            out["declined"][reason] = \
+                out["declined"].get(reason, 0) \
+                + int(float(line.rsplit(None, 1)[1]))
+    return out
+
+
+def _frag_score(client, api) -> dict:
+    """Harness-side fragmentation score: the pure-python twin of
+    models/defrag.fragmentation_score computed from a LIST of truth —
+    sum over non-empty nodes of free-permille across the core dims
+    (cpu milli-units, memory bytes), lower = better packed. Independent
+    of the descheduler's own gauge, so the record's before/after claim
+    does not rest on the subsystem it is judging. Also returns the
+    resident pod count per node (the drain check) and the unbound pod
+    count (the no-half-moves check: an evict whose bind never applied
+    would strand a pod here)."""
+    nodes = client.nodes().list().items
+    pods = client.pods(api.NamespaceAll).list().items
+    used: dict = {}
+    resident: dict = {}
+    unbound = 0
+    for p in pods:
+        if p.status.phase in (api.PodSucceeded, api.PodFailed):
+            continue
+        host = p.status.host or p.spec.host
+        if not host:
+            unbound += 1
+            continue
+        cpu = mem = 0
+        for c in p.spec.containers:
+            for name, q in c.resources.limits.items():
+                if name == api.ResourceCPU:
+                    cpu += q.milli_value()
+                elif name == api.ResourceMemory:
+                    mem += int(q.value)
+        u = used.setdefault(host, [0, 0])
+        u[0] += cpu
+        u[1] += mem
+        resident[host] = resident.get(host, 0) + 1
+    score = 0
+    for n in nodes:
+        name = n.metadata.name
+        if not resident.get(name):
+            continue
+        u = used.get(name, [0, 0])
+        for i, res in enumerate((api.ResourceCPU, api.ResourceMemory)):
+            q = (n.spec.capacity or {}).get(res)
+            if q is None:
+                continue
+            cap = q.milli_value() if res == api.ResourceCPU \
+                else int(q.value)
+            if cap <= 0:
+                continue
+            score += max(cap - u[i], 0) * 1000 // cap
+    return {"score": int(score), "resident": resident, "unbound": unbound}
 
 
 def _scrape_unschedulable(ports) -> dict:
@@ -1325,6 +1441,30 @@ def main(argv=None) -> int:
     ap.add_argument("--storm-fill-per-node", type=int, default=8,
                     help="template pods per node at exact capacity in "
                     "--priority-storm mode")
+    ap.add_argument("--fragment-storm", action="store_true",
+                    help="kube-defrag scenario: the bursty feed leaves "
+                    "the template pods smeared thin across every node "
+                    "(the fragmented steady state); once all pods are "
+                    "bound the harness cordons --storm-cordon nodes and "
+                    "a kube-descheduler child (spawned alongside the "
+                    "schedulers, declining waves while the feed's "
+                    "unbound pods exist) consolidates: cordoned nodes "
+                    "drain, sparse nodes empty, the fragmentation score "
+                    "measurably drops. The record gains a fragmentation "
+                    "section (score before/after, migrations committed/"
+                    "409'd, nodes drained/emptied, 0 half-moves) and "
+                    "perfgate isolates the +fragmentstorm shape")
+    ap.add_argument("--storm-cordon", type=int, default=8,
+                    help="nodes cordoned (spec.unschedulable) after the "
+                    "feed in --fragment-storm mode; all must fully "
+                    "drain via mandatory migrations")
+    ap.add_argument("--defrag-window", type=float, default=120.0,
+                    help="max seconds to wait for the defrag waves to "
+                    "drain the cordoned set and go quiescent in "
+                    "--fragment-storm mode")
+    ap.add_argument("--defrag-max-moves", type=int, default=50,
+                    help="kube-descheduler --max-moves (voluntary "
+                    "migrations per wave) in --fragment-storm mode")
     ap.add_argument("--overload", action="store_true",
                     help="kube-fairshed overload scenario: offer --rate "
                     "(set it ≥ 2x the sustained capacity) into a "
@@ -1850,6 +1990,30 @@ def main(argv=None) -> int:
                                     f"{sched_metrics_ports[w]}"
                                     f"/healthz/ping"))
 
+        desched_metrics_port = 0
+        if args.fragment_storm:
+            # the descheduler rides along from boot: it declines every
+            # wave while the feed's unbound pods exist (pending_work —
+            # the scheduler owns the churn budget), then consolidates
+            # once the cluster is quiescent. period/qps are tight here
+            # because the harness WAITS on the waves; production
+            # defaults are far lazier.
+            desched_metrics_port = args.port + 9 + args.schedulers
+            # qps 0.5 x max-moves 50 bounds sustained migrations at
+            # 25/s — half the defrag_migration_storm SLO ceiling, so a
+            # conformant run proves the pacing, not just the drain
+            dcmd = [PY, "-m", "kubernetes_tpu.cmd.descheduler",
+                    "--master", master, "--period", "0.5",
+                    "--qps", "0.5", "--burst", "1",
+                    "--max-moves", str(args.defrag_max_moves),
+                    "--metrics-port", str(desched_metrics_port)]
+            if args.flightrec:
+                dcmd += ["--flightrec"]
+            spawn("descheduler", *dcmd,
+                  ready=_http_ready(f"http://127.0.0.1:"
+                                    f"{desched_metrics_port}"
+                                    f"/healthz/ping"))
+
         # every child is registered: the supervisor watches from here
         threading.Thread(target=_supervise, daemon=True,
                          name="chaos-supervisor").start()
@@ -1879,6 +2043,13 @@ def main(argv=None) -> int:
                 targets.append({"name": "storeserver",
                                 "url": f"http://127.0.0.1:"
                                        f"{store_metrics_port}"})
+            if desched_metrics_port:
+                # the defrag_* family rides the timeline so the
+                # defrag_migration_storm / monotone-score SLO rules
+                # judge the waves live
+                targets.append({"name": "descheduler",
+                                "url": f"http://127.0.0.1:"
+                                       f"{desched_metrics_port}"})
             # the harness itself is a target: the supervisor's
             # component_restarts_total / component_recovery_seconds live
             # in THIS process's registry, and the SLO rules judging the
@@ -2225,6 +2396,55 @@ def main(argv=None) -> int:
             flight_agg.set_active(False)
         offered = sum(s["created"] for s in stats) / feed_s
         sustained = args.pods / total_s if ok else 0.0
+        frag = None
+        if args.fragment_storm:
+            # the defrag window opens AFTER the offered-load clock
+            # closes: the feed left the template pods smeared across
+            # every node; cordon the most-loaded nodes and wait for the
+            # descheduler's waves (declining with pending_work until
+            # now) to drain them and consolidate the sparse remainder
+            frag = {"cordoned": args.storm_cordon}
+            try:
+                before = _frag_score(client, api)
+                frag["score_before"] = before["score"]
+                # cordon the most-resident nodes: the drain has to move
+                # real pods, not tick a box on already-empty nodes
+                ranked = sorted(before["resident"].items(),
+                                key=lambda kv: (-kv[1], kv[0]))
+                cordon = [name for name, _ in
+                          ranked[:args.storm_cordon]]
+                rc = client.resource("nodes", "")
+                for name in cordon:
+                    node = rc.get(name)
+                    node.spec.unschedulable = True
+                    rc.update(node)
+                print(f"[churn-mp] fragment-storm: score "
+                      f"{before['score']}, cordoned {len(cordon)} "
+                      f"nodes, waiting on defrag waves "
+                      f"(window {args.defrag_window:.0f}s)",
+                      file=sys.stderr, flush=True)
+                frag_deadline = time.monotonic() + args.defrag_window
+                drained = False
+                while time.monotonic() < frag_deadline:
+                    time.sleep(2.0)
+                    try:
+                        mid = _scrape_defrag(desched_metrics_port)
+                    except Exception:
+                        continue
+                    if mid["nodes_drained"] >= len(cordon):
+                        drained = True
+                        break
+                # counters first, then truth: a wave committing between
+                # the two scrapes makes the LISTed score slightly BETTER
+                # than the counters claim, never worse
+                frag.update(_scrape_defrag(desched_metrics_port))
+                after = _frag_score(client, api)
+                frag["score_after"] = after["score"]
+                frag["unbound_after"] = after["unbound"]
+                frag["cordoned_drained_ok"] = drained and all(
+                    after["resident"].get(n, 0) == 0 for n in cordon)
+            except Exception as e:
+                frag["error"] = f"fragment-storm window failed: {e}"
         # per-wave encode/solve stats from the scheduler's /metrics —
         # the incremental-encoder cost under churn, measured in the live
         # topology (ref: the MapPodsToMachines rebuild being designed
@@ -2253,6 +2473,10 @@ def main(argv=None) -> int:
         if args.priority_storm:
             sched_desc += (" | PRIORITY STORM: cluster pre-filled to "
                            "capacity, storm binds via atomic evict+bind")
+        if args.fragment_storm:
+            sched_desc += (" | FRAGMENT STORM: post-feed cordon + "
+                           "kube-descheduler consolidation waves "
+                           "(atomic evict-here + bind-there migrations)")
         if args.chaos:
             sched_desc += (" | CHAOS: scheduled SIGKILLs + supervised "
                            "respawns mid-run"
@@ -2463,9 +2687,25 @@ def main(argv=None) -> int:
                       f"evictions (must be 0); preempt-to-bind "
                       f"p50/p95 = {pr['bind_p50_s']}/{pr['bind_p95_s']} s",
                       file=sys.stderr, flush=True)
+        if args.fragment_storm:
+            # fragment-storm shape marker (perfgate isolates
+            # +fragmentstorm) + the kube-defrag evidence assembled in
+            # the post-feed window above
+            record["fragmentation"] = frag
+            if frag and "error" not in frag:
+                print(f"[churn-mp] fragmentation: score "
+                      f"{frag['score_before']} -> {frag['score_after']} "
+                      f"over {frag['waves']} waves, "
+                      f"{frag['migrations_committed']} migrations "
+                      f"committed ({frag['migrations_409']} lost to "
+                      f"commit guards), {frag['nodes_drained']} nodes "
+                      f"drained / {frag['nodes_emptied']} emptied, "
+                      f"cordon drained: {frag['cordoned_drained_ok']}, "
+                      f"unbound after: {frag['unbound_after']} "
+                      f"(must be 0)", file=sys.stderr, flush=True)
         _chaos_record_sections(record)
         flush_flightrec(record)
-        missing = validate_record(record, round_no=15)
+        missing = validate_record(record, round_no=16)
         if missing:
             print(f"[churn-mp] WARNING: record missing contract fields: "
                   f"{missing}", file=sys.stderr, flush=True)

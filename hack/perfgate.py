@@ -90,6 +90,12 @@ def shape_key(rec: dict) -> str:
         # PURPOSE and shed the excess: their sustained rate measures
         # the admission governor, not the clean control plane
         suffix += "+overload"
+    if rec.get("fragmentation"):
+        # kube-defrag fragment-storm runs spend a post-feed window on
+        # descheduler consolidation waves: their end-to-end figures
+        # include deliberate rescheduling churn the clean series
+        # never pays
+        suffix += "+fragmentstorm"
     return cfg + suffix
 
 

@@ -52,7 +52,8 @@ if [[ "$RACE" == 1 ]]; then
             tests/test_tracing.py tests/test_flightrec.py
             tests/test_vet.py tests/test_preempt.py
             tests/test_explain.py tests/test_record.py
-            tests/test_chaos.py tests/test_fairshed.py)
+            tests/test_chaos.py tests/test_fairshed.py
+            tests/test_defrag.py)
     rc=0
     for ((i = 1; i <= ROUNDS; i++)); do
         echo "=== race round ${i}/${ROUNDS} (switchinterval=1e-6) ==="

@@ -403,6 +403,26 @@ def default_churn_rules(binds_floor: float = 50.0,
         # warmup or teardown is just as much a bug.
         SLORule("system_flow_shed_zero", ("fairshed_system_shed_total",),
                 reduce="last", op="ceil", threshold=0.0, scope="sum"),
+        # kube-defrag (descheduler/controller.py): migrations are
+        # background maintenance, so their sustained rate must stay far
+        # below the scheduler's bind throughput — a descheduler churning
+        # pods faster than this is fighting the scheduler for CAS wins
+        # (a migration storm), not consolidating. The ceiling is rate-
+        # shaped so a legitimate burst (one drain wave) passes and only
+        # sustained churn fires; not active_only, because the
+        # descheduler by design runs when the scheduler is idle.
+        SLORule("defrag_migration_storm", "defrag_migrations_total",
+                reduce="rate", op="ceil", threshold=50.0,
+                window_s=20.0, for_s=10.0, service="descheduler",
+                scope="sum"),
+        # the monotone invariant: the acceptance gate structurally drops
+        # any voluntary move set that does not strictly improve the
+        # fragmentation score, so a wave scoring worse than its
+        # mandatory-only outcome can never happen — the counter is an
+        # == 0 invariant like preemption_higher_evictions_zero
+        SLORule("fragmentation_score_monotone_under_defrag",
+                ("defrag_score_regressions_total",),
+                reduce="last", op="ceil", threshold=0.0, scope="sum"),
     ]
     if admitted_e2e_ceil_s is not None:
         # the overload contract's headline, armed ONLY when the fairshed

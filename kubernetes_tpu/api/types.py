@@ -655,6 +655,14 @@ class Binding:
     # item fails 409 and nothing is applied. Each ref names a pod in the
     # binding's namespace; uid guards against name reuse.
     victims: List[ObjectReference] = field(default_factory=list)
+    # kube-defrag: when set, this is a MIGRATION bind — the pod is
+    # expected to be bound to from_host already and is atomically moved
+    # (evict-here + bind-there) to ``host``. pod_uid guards against the
+    # pod being deleted/recreated between the descheduler's proposal and
+    # the commit; any mismatch fails the item 409 with nothing applied.
+    # The scheduler never sets these, so the hot bind path is untouched.
+    from_host: str = ""
+    pod_uid: str = ""
     kind: str = "Binding"
 
 

@@ -233,6 +233,10 @@ def _build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--once", action="store_true",
                     help="serve one request then exit (tests)")
 
+    for verb in ("cordon", "uncordon", "drain"):
+        sp = sub.add_parser(verb, exit_on_error=False)
+        sp.add_argument("node")
+
     sub.add_parser("version", exit_on_error=False)
     sub.add_parser("api-versions", exit_on_error=False)
     sub.add_parser("cluster-info", aliases=["clusterinfo"], exit_on_error=False)
@@ -501,6 +505,28 @@ def _cmd_rolling_update(f: Factory, ns: str, opts) -> int:
     return 0
 
 
+def _cmd_cordon(f: Factory, opts, on: bool) -> int:
+    """ref: kubectl cordon/uncordon/drain — flips ``spec.unschedulable``.
+
+    ``drain`` is cordon plus hand-off: pods are not evicted inline (there
+    is no synchronous eviction API here); the descheduler treats every
+    movable pod on a cordoned node as a mandatory migration candidate and
+    empties the node on its next wave.
+    """
+    rc = f.client.resource("nodes", "")
+    node = rc.get(opts.node)
+    already = bool(node.spec.unschedulable) == on
+    if not already:
+        node.spec.unschedulable = on
+        rc.update(node)
+    verb = "cordoned" if on else "uncordoned"
+    f.out.write(f"node/{opts.node} {'already ' if already else ''}{verb}\n")
+    if opts.command == "drain":
+        f.out.write(f"node/{opts.node} draining "
+                    f"(pods migrate on the next descheduler wave)\n")
+    return 0
+
+
 def _cmd_exec(f: Factory, ns: str, opts) -> int:
     """ref: cmd/exec.go — `exec -p POD -c CONTAINER CMD...` or
     `exec POD -- CMD...`."""
@@ -745,6 +771,8 @@ def run_kubectl(argv: List[str], factory: Factory) -> int:
             return _cmd_stop(f, ns, opts)
         if opts.command in ("rolling-update", "rollingupdate"):
             return _cmd_rolling_update(f, ns, opts)
+        if opts.command in ("cordon", "uncordon", "drain"):
+            return _cmd_cordon(f, opts, on=opts.command != "uncordon")
         if opts.command == "version":
             f.out.write(f"Client Version: {versionpkg.get()}\n")
             return 0

@@ -134,6 +134,7 @@ class NodeDescriber:
         out = io.StringIO()
         out.write(f"Name:\t{node.metadata.name}\n")
         out.write(f"Labels:\t{_join_labels(node.metadata.labels)}\n")
+        out.write(f"Unschedulable:\t{'true' if node.spec.unschedulable else 'false'}\n")
         out.write("Conditions:\n")
         for c in node.status.conditions:
             out.write(f"  {c.type}\t{c.status}\t{c.reason}\n")

@@ -90,7 +90,11 @@ def _endpoints_rows(ep: api.Endpoints):
 def _node_status(node: api.Node) -> str:
     conds = [c for c in node.status.conditions if c.status == api.ConditionTrue]
     names = [c.type for c in conds]
-    return ",".join(names) if names else "Unknown"
+    status = ",".join(names) if names else "Unknown"
+    if node.spec.unschedulable:
+        # cordoned (ref: printers.go appends SchedulingDisabled)
+        status += ",SchedulingDisabled"
+    return status
 
 
 def _node_rows(node: api.Node):

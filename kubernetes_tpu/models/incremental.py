@@ -149,6 +149,7 @@ class IncrementalEncoder:
     @staticmethod
     def _node_fp(n: api.Node) -> Tuple:
         return (n.metadata.name,
+                bool(n.spec.unschedulable),
                 tuple(sorted((n.metadata.labels or {}).items())),
                 tuple(sorted((k, str(v.value)) for k, v in
                              (n.spec.capacity or {}).items())))
@@ -206,8 +207,13 @@ class IncrementalEncoder:
                 if lbls.get(k) == v:
                     self._node_sel[i, col] = True
 
-        # policy planes (all node-derived)
+        # policy planes (all node-derived); cordon folds in first,
+        # unconditionally (spec.unschedulable is in the fingerprint, so
+        # a cordon/uncordon triggers the rebuild that lands here)
         self._extra_ok = np.ones(N, bool)
+        for i, n in enumerate(nodes):
+            if n.spec.unschedulable:
+                self._extra_ok[i] = False
         for i, lbls in enumerate(self._node_labels):
             for labels, presence in self.policy.label_presence:
                 if any((l in lbls) != presence for l in labels):

@@ -32,7 +32,8 @@ from kubernetes_tpu.scheduler.listers import (
     FakeServiceLister,
 )
 
-__all__ = ["solve_serial", "preempt_serial", "explain_serial"]
+__all__ = ["solve_serial", "preempt_serial", "explain_serial",
+           "defrag_serial"]
 
 
 def solve_serial(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
@@ -379,7 +380,10 @@ def explain_serial(nodes: Sequence[api.Node],
     caps = {n.metadata.name: _preds.capacity_values(n.spec.capacity)
             for n in nodes}
     labels = {n.metadata.name: dict(n.metadata.labels or {}) for n in nodes}
-    extra_ok = {name: True for name in node_order}
+    # cordon folds into extra_ok unconditionally, like the planes do: a
+    # cordoned node's eliminations attribute to REASON_LABEL (the
+    # extra_ok bucket — documented coarseness, docs/design/descheduler.md)
+    extra_ok = {n.metadata.name: not n.spec.unschedulable for n in nodes}
     for name in node_order:
         for lbls, presence in pol.label_presence:
             if any((l in labels[name]) != presence for l in lbls):
@@ -491,3 +495,255 @@ def explain_serial(nodes: Sequence[api.Node],
         ports[host] |= pod_ports_of(pod)
         pds[host] |= pod_pds_of(pod)
     return decisions, diags
+
+
+# ---------------------------------------------------------------------------
+# kube-defrag serial oracle
+# ---------------------------------------------------------------------------
+
+def defrag_serial(nodes: Sequence[api.Node],
+                  existing_pods: Sequence[api.Pod],
+                  services: Sequence[api.Service] = (),
+                  cfg=None,
+                  provider: str = schedplugins.DEFAULT_PROVIDER,
+                  policy: Optional[schedplugins.Policy] = None):
+    """Serial twin of models/defrag (select_candidates + plan_defrag) —
+    the whole consolidation rule walked pod-by-pod over the object
+    graph, nothing dense. Returns ``(moves, score_before,
+    score_mandatory, score_after)`` with ``moves`` a list of
+    models.defrag.Move; the planes path must match all four bit-for-bit
+    (tests/test_defrag.py fixtures + fuzz over both encoders).
+
+    The rule (models/defrag.py module docstring is the definition):
+    mandatory cordon-drain candidates first (node order, then
+    (priority, uid)), voluntary candidates from fully-movable
+    emptiest-first source nodes within the budget; per candidate the
+    tightest feasible non-source target wins (free-permille after
+    placement, FNV-1a tie-break in node order); a committed move frees
+    the source's resources but conservatively retains its ports/PDs
+    (the preemption carry); voluntary groups are all-or-nothing per
+    source; the voluntary set is dropped wholesale unless it strictly
+    improves the score over the mandatory-only outcome."""
+    from kubernetes_tpu.models.defrag import (
+        DO_NOT_DISRUPT_ANNOTATION,
+        DefragConfig,
+        Move,
+    )
+    from kubernetes_tpu.models.gang import gang_key
+    from kubernetes_tpu.models.policy import batch_policy_from
+
+    cfg = cfg or DefragConfig()
+    pol = batch_policy_from(provider, policy)
+    node_order = [n.metadata.name for n in nodes]
+    node_of = {n.metadata.name: n for n in nodes}
+    caps = {nm: _preds.capacity_values(node_of[nm].spec.capacity)
+            for nm in node_order}
+    labels = {nm: dict(node_of[nm].metadata.labels or {})
+              for nm in node_order}
+    cordoned = {nm for nm in node_order if node_of[nm].spec.unschedulable}
+    extra_ok = {nm: nm not in cordoned for nm in node_order}
+    for nm in node_order:
+        for lbls, presence in pol.label_presence:
+            if any((l in labels[nm]) != presence for l in lbls):
+                extra_ok[nm] = False
+                break
+
+    by_host: Dict[str, List[api.Pod]] = {}
+    for p in existing_pods:
+        if p.status.host in caps:
+            by_host.setdefault(p.status.host, []).append(p)
+
+    # wave-start greedy state, existing-list order (the shared
+    # pre-exceeded rule), plus ports/PDs and resident counts
+    used: Dict[str, Dict[str, int]] = {nm: {} for nm in node_order}
+    exceeded: Dict[str, bool] = {nm: False for nm in node_order}
+    ports: Dict[str, set] = {nm: set() for nm in node_order}
+    pds: Dict[str, set] = {nm: set() for nm in node_order}
+    cnt: Dict[str, int] = {nm: 0 for nm in node_order}
+    for p in existing_pods:
+        host = p.status.host
+        if host not in caps:
+            continue
+        cnt[host] += 1
+        cap = caps[host]
+        u = used[host]
+        req = _req_vec(p)
+        if all(_preds.dim_fits(k, cap.get(k, 0),
+                               cap.get(k, 0) - u.get(k, 0), v)
+               for k, v in req.items()):
+            for k, v in req.items():
+                u[k] = u.get(k, 0) + v
+        else:
+            exceeded[host] = True
+        for c in p.spec.containers:
+            for cp in c.ports:
+                if cp.host_port:
+                    ports[host].add(cp.host_port)
+        for v in p.spec.volumes:
+            if v.source.gce_persistent_disk is not None:
+                pds[host].add(v.source.gce_persistent_disk.pd_name)
+
+    def movable(p: api.Pod) -> bool:
+        if p.metadata.namespace in cfg.protected_namespaces:
+            return False
+        if gang_key(p) is not None:
+            return False
+        if api.pod_priority(p) >= cfg.priority_ceiling:
+            return False
+        ann = p.metadata.annotations or {}
+        if ann.get(DO_NOT_DISRUPT_ANNOTATION, "false") != "false":
+            return False
+        return p.spec.host == p.status.host
+
+    def order_key(p: api.Pod):
+        return (api.pod_priority(p), p.metadata.uid)
+
+    def score() -> int:
+        total = 0
+        for nm in node_order:
+            if cnt[nm] <= 0:
+                continue
+            cap = caps[nm]
+            u = used[nm]
+            for name in (api.ResourceCPU, api.ResourceMemory):
+                c = cap.get(name, 0)
+                if c > 0:
+                    total += max(c - u.get(name, 0), 0) * 1000 // c
+        return total
+
+    # -- candidate selection (defrag.select_candidates twin) ---------------
+    mandatory: List[api.Pod] = []
+    for nm in node_order:
+        if nm not in cordoned or exceeded[nm]:
+            continue
+        for p in sorted(by_host.get(nm, ()), key=order_key):
+            if movable(p):
+                mandatory.append(p)
+    budget = max(0, cfg.max_moves - len(mandatory))
+    ranked = []
+    for i, nm in enumerate(node_order):
+        resident = by_host.get(nm, ())
+        if nm in cordoned or not resident or exceeded[nm]:
+            continue
+        if not all(movable(p) for p in resident):
+            continue
+        permille = 0
+        cap = caps[nm]
+        total: Dict[str, int] = {}
+        for p in resident:
+            for k, v in _req_vec(p).items():
+                total[k] = total.get(k, 0) + v
+        for name in (api.ResourceCPU, api.ResourceMemory):
+            c = cap.get(name, 0)
+            if c > 0:
+                permille += total.get(name, 0) * 1000 // c
+        if permille >= cfg.source_max_permille:
+            continue
+        ranked.append((permille, i, nm, sorted(resident, key=order_key)))
+    ranked.sort(key=lambda t: (t[0], t[1]))
+    n_targets = sum(1 for nm in node_order
+                    if nm not in cordoned and not exceeded[nm])
+    groups: List[Tuple[str, List[api.Pod]]] = []
+    sources: set = set()
+    for _permille, _i, nm, resident in ranked:
+        # target-floor twin: never consume the last schedulable
+        # non-source node
+        if n_targets - len(sources) < 2:
+            break
+        if len(resident) > budget:
+            break
+        budget -= len(resident)
+        sources.add(nm)
+        groups.append((nm, resident))
+
+    # -- the wave ----------------------------------------------------------
+    def try_place(p: api.Pod, voluntary: bool) -> Optional[str]:
+        src = p.status.host
+        req = _req_vec(p)
+        p_ports = {cp.host_port for c in p.spec.containers
+                   for cp in c.ports if cp.host_port}
+        p_pds = {v.source.gce_persistent_disk.pd_name
+                 for v in p.spec.volumes
+                 if v.source.gce_persistent_disk is not None}
+        feasible: List[Tuple[str, int]] = []
+        for nm in node_order:
+            if nm == src or nm in sources or exceeded[nm] \
+                    or not extra_ok[nm]:
+                continue
+            if voluntary and cnt[nm] <= 0:
+                continue
+            cap = caps[nm]
+            u = used[nm]
+            if not all(_preds.dim_fits(k, cap.get(k, 0),
+                                       cap.get(k, 0) - u.get(k, 0), v)
+                       for k, v in req.items()):
+                continue
+            if p_ports & ports[nm] or p_pds & pds[nm]:
+                continue
+            if p.spec.node_selector and \
+                    any(labels[nm].get(k) != v
+                        for k, v in p.spec.node_selector.items()):
+                continue
+            fit = 0
+            for name in (api.ResourceCPU, api.ResourceMemory):
+                c = cap.get(name, 0)
+                if c > 0:
+                    fit += max(c - u.get(name, 0) - req.get(name, 0), 0) \
+                        * 1000 // c
+            feasible.append((nm, fit))
+        if not feasible:
+            return None
+        best = min(f for _nm, f in feasible)
+        tied = [nm for nm, f in feasible if f == best]
+        t = tied[fnv1a64(pod_tie_break_key(p)) % len(tied)]
+        # commit: resources leave the source, ports/PDs conservatively
+        # retained there; the target gains everything
+        u_src = used[src]
+        for k, v in req.items():
+            u_src[k] = u_src.get(k, 0) - v
+        u_t = used[t]
+        for k, v in req.items():
+            u_t[k] = u_t.get(k, 0) + v
+        ports[t] |= p_ports
+        pds[t] |= p_pds
+        cnt[src] -= 1
+        cnt[t] += 1
+        return t
+
+    score_before = score()
+    moves: List[Move] = []
+    for p in mandatory:
+        t = try_place(p, voluntary=False)
+        if t is not None:
+            moves.append(Move(p.metadata.uid, p.metadata.name,
+                              p.metadata.namespace, p.status.host, t, True))
+    score_mandatory = score()
+
+    vol_moves: List[Move] = []
+    for nm, resident in groups:
+        mark = (copy.deepcopy(used), {k: set(v) for k, v in ports.items()},
+                {k: set(v) for k, v in pds.items()}, dict(cnt))
+        placed: List[Move] = []
+        ok = True
+        for p in resident:
+            t = try_place(p, voluntary=True)
+            if t is None:
+                ok = False
+                break
+            placed.append(Move(p.metadata.uid, p.metadata.name,
+                               p.metadata.namespace, p.status.host, t,
+                               False))
+        if ok:
+            vol_moves.extend(placed)
+        else:
+            used.clear(); used.update(mark[0])
+            ports.clear(); ports.update(mark[1])
+            pds.clear(); pds.update(mark[2])
+            cnt.clear(); cnt.update(mark[3])
+    score_after = score()
+    if vol_moves and score_after >= score_mandatory:
+        # the acceptance gate: no strict improvement -> the voluntary
+        # set is dropped wholesale (mandatory drain moves stay)
+        vol_moves = []
+        score_after = score_mandatory
+    return moves + vol_moves, score_before, score_mandatory, score_after

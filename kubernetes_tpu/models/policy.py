@@ -37,7 +37,7 @@ class UnsupportedPolicy(Exception):
 
 
 _KNOWN_PREDICATES = {"PodFitsPorts", "PodFitsResources", "NoDiskConflict",
-                     "MatchNodeSelector", "HostName"}
+                     "MatchNodeSelector", "HostName", "Schedulable"}
 _KNOWN_PRIORITIES = {"LeastRequestedPriority", "ServiceSpreadingPriority",
                      "EqualPriority"}
 
@@ -149,6 +149,8 @@ def batch_policy_from(provider: Optional[str] = None,
             flags["use_selector"] = True
         elif p.name == "HostName":
             flags["use_host"] = True
+        elif p.name == "Schedulable":
+            pass  # structural: the planes fold cordon unconditionally
         else:
             raise UnsupportedPolicy(
                 f"policy predicate {p.name!r} not modeled by the batch solver")

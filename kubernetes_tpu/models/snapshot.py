@@ -531,8 +531,14 @@ def encode_snapshot(nodes: Sequence[api.Node], existing_pods: Sequence[api.Pod],
                         anchor_unknown[g] = True
 
     # -- policy: NodeLabelPresence -> node_extra_ok ------------------------
+    # cordon folds in first, unconditionally: spec.unschedulable is
+    # structural (the serial twin is the always-on Schedulable
+    # predicate), not part of the policy vocabulary
     extra_ok = (node_extra_ok.copy() if node_extra_ok is not None
                 else np.ones(N, bool))
+    for i, n in enumerate(nodes):
+        if n.spec.unschedulable:
+            extra_ok[i] = False
     if policy.label_presence:
         for i, n in enumerate(nodes):
             lbls = n.metadata.labels or {}

@@ -100,6 +100,30 @@ class BindingREST:
             return pod
         return assign
 
+    @staticmethod
+    def _migrate_fn(name: str, host: str, from_host: str, pod_uid: str):
+        """kube-defrag: the migration bind — evict-here + bind-there as one
+        atomic host swap on the pod object. Guards: the pod must still be
+        on ``from_host`` (a concurrent scheduler/preemption bind loses the
+        race 409) and, when given, still carry ``pod_uid`` (deletion +
+        name-reuse between proposal and commit 409s instead of moving a
+        stranger). Either the swap commits whole or nothing is applied."""
+        def migrate(pod: api.Pod) -> api.Pod:
+            if pod_uid and pod.metadata.uid != pod_uid:
+                raise errors.new_conflict(
+                    "Pod", name,
+                    f"pod {name} uid changed since the defrag proposal "
+                    f"(re-solve required)")
+            if pod.spec.host != from_host:
+                raise errors.new_conflict(
+                    "Pod", name,
+                    f"pod {name} is on host {pod.spec.host!r}, not "
+                    f"{from_host!r} (re-solve required)")
+            pod.spec.host = host
+            pod.status.host = host
+            return pod
+        return migrate
+
     def create(self, ctx: Context, binding: api.Binding) -> api.Status:
         if isinstance(binding, api.BindingList):
             return self.create_many(ctx, binding)
@@ -108,7 +132,7 @@ class BindingREST:
             raise errors.new_bad_request("binding must name a pod")
         if not binding.host:
             raise errors.new_bad_request("binding must name a host")
-        if binding.victims:
+        if binding.victims or binding.from_host:
             # the single-binding form of the evict+bind item: one-element
             # batch, same all-or-nothing transaction
             res = self.create_many(ctx.with_namespace(
@@ -164,17 +188,22 @@ class BindingREST:
                     f"match request namespace {ctx.namespace!r}")
                 results[i].code = 403
                 continue
-            if b.victims:
+            if b.victims or b.from_host:
                 if any(not v.name for v in b.victims):
                     results[i].error = "every victim must name a pod"
                     results[i].code = 400
                     continue
                 # victims may live in other namespaces (the node is a
                 # shared resource); Master.bind_batch authorized DELETE
-                # against every victim namespace the wave touches
+                # against every victim namespace the wave touches.
+                # kube-defrag migrations (from_host set) ride the same
+                # transactional lane: the guarded host swap and any victim
+                # deletes commit whole or 409 with nothing applied.
+                fn = (self._migrate_fn(name, b.host, b.from_host, b.pod_uid)
+                      if b.from_host else self._assign_fn(name, b.host))
                 evict_items.append((
                     self.pods.key(ctx, name),
-                    self._assign_fn(name, b.host),
+                    fn,
                     [(self.pods.key(
                         ctx.with_namespace(v.namespace or ctx.namespace),
                         v.name), v.uid)

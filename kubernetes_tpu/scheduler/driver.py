@@ -263,9 +263,15 @@ class Scheduler:
 def filter_schedulable_nodes(nodes: api.NodeList) -> api.NodeList:
     """ref: factory.go:203-238 pollMinions — keep nodes whose Schedulable
     condition isn't false and that are Ready (or Reachable, or carry no
-    conditions at all)."""
+    conditions at all). Cordoned nodes (``spec.unschedulable``, kubectl
+    cordon) are dropped here too — the scheduler's own Schedulable
+    predicate and the dense ``node_extra_ok`` fold are the belt to this
+    poller's suspenders (a cordon landing mid-poll-period must not win a
+    race into a wave)."""
     out = []
     for node in nodes.items:
+        if node.spec.unschedulable:
+            continue
         conds = {c.type: c for c in node.status.conditions}
         sched = conds.get(api.NodeSchedulable)
         if sched is not None and sched.status != api.ConditionTrue:

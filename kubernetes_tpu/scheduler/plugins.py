@@ -102,6 +102,9 @@ register_fit_predicate(
     "MatchNodeSelector",
     lambda args: preds.NodeSelector(args.node_info).pod_selector_matches)
 register_fit_predicate("HostName", lambda args: preds.pod_fits_host)
+register_fit_predicate(
+    "Schedulable",
+    lambda args: preds.Schedulable(args.node_info).pod_is_schedulable)
 
 register_priority(
     "LeastRequestedPriority",
@@ -118,7 +121,7 @@ register_priority(
 register_algorithm_provider(
     DEFAULT_PROVIDER,
     predicate_keys=["PodFitsPorts", "PodFitsResources", "NoDiskConflict",
-                    "MatchNodeSelector", "HostName"],
+                    "MatchNodeSelector", "HostName", "Schedulable"],
     priority_keys=["LeastRequestedPriority", "ServiceSpreadingPriority",
                    "EqualPriority"],
 )
@@ -198,6 +201,11 @@ def predicates_from_policy(policy: Policy, args: PluginFactoryArgs
                 p.label_presence["presence"]).check_node_label_presence
         else:
             out.update(get_predicates([p.name], args))
+    # cordon is structural, not policy vocabulary: every configuration
+    # refuses unschedulable nodes, exactly as the dense planes fold
+    # spec.unschedulable into node_extra_ok unconditionally
+    out.setdefault("Schedulable",
+                   preds.Schedulable(args.node_info).pod_is_schedulable)
     return out
 
 

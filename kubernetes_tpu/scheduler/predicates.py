@@ -160,6 +160,22 @@ def pod_fits_host(pod: api.Pod, existing_pods: List[api.Pod], node: str) -> bool
     return pod.spec.host == node
 
 
+class Schedulable:
+    """kubectl cordon's scheduler-side half: a node with
+    ``spec.unschedulable`` set admits no new pods (ref: 1.1-era
+    factory.go pollMinions skipping Spec.Unschedulable). Structural, not
+    policy vocabulary — the dense path folds the same gate into
+    ``node_extra_ok`` unconditionally, so plugins.predicates_from_policy
+    always includes this predicate regardless of the policy file."""
+
+    def __init__(self, node_info):
+        self.info = node_info
+
+    def pod_is_schedulable(self, pod: api.Pod, existing_pods: List[api.Pod],
+                           node: str) -> bool:
+        return not self.info.get_node_info(node).spec.unschedulable
+
+
 class NodeLabelChecker:
     """ref: predicates.go:194-229 CheckNodeLabelPresence (policy-only)."""
 

@@ -1,0 +1,685 @@
+"""kube-defrag — the descheduler subsystem on the dense preemption
+machinery (docs/design/descheduler.md).
+
+The contract under test:
+
+- the dense wave (full AND incremental encoder) is bit-identical to the
+  oracle.defrag_serial twin on moves and every score (pinned + fuzz);
+- movable-pod selection never touches system-namespace, gang, above-
+  priority-ceiling, do-not-disrupt, or dirty-bound pods (cordon-drain
+  surfaces them as undrainable instead);
+- migrations commit through the Binding migration lane atomically:
+  evict-here + bind-there as one host swap, per-item 409/404 leaves
+  exactly that pod un-moved (no half-moved pods);
+- the controller is polite: token-bucket rate limited, declines while
+  the scheduler has pending work, and strictly monotone on the
+  fragmentation score (the acceptance gate);
+- kubectl cordon/uncordon/drain + spec.unschedulable ride every layer:
+  serializers, field selectors, the Schedulable predicate, the dense
+  node_extra_ok fold, get/describe output;
+- the SLO rules, churn-record schema, and perfgate shape key that make
+  a --fragment-storm run falsifiable.
+"""
+
+import importlib.util
+import io
+import os
+import random
+
+import pytest
+
+from kubernetes_tpu.addons.monitoring import SLOWatchdog, default_churn_rules
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.latest import scheme
+from kubernetes_tpu.api.quantity import Quantity
+from kubernetes_tpu.apiserver.master import Master
+from kubernetes_tpu.client.client import Client, InProcessTransport
+from kubernetes_tpu.descheduler import Descheduler, DeschedulerConfig
+from kubernetes_tpu.descheduler.controller import WaveReport
+from kubernetes_tpu.kubectl.cmd import Factory, run_kubectl
+from kubernetes_tpu.models.batch_solver import decisions_to_names, solve
+from kubernetes_tpu.models.defrag import (
+    DO_NOT_DISRUPT_ANNOTATION,
+    DefragConfig,
+    Move,
+    defrag_wave,
+    is_movable,
+    select_candidates,
+)
+from kubernetes_tpu.models.gang import GANG_NAME_ANNOTATION
+from kubernetes_tpu.models.incremental import IncrementalEncoder
+from kubernetes_tpu.models.oracle import defrag_serial
+from kubernetes_tpu.models.snapshot import encode_snapshot
+from kubernetes_tpu.registry.generic import Context
+from kubernetes_tpu.scheduler import plugins
+from kubernetes_tpu.scheduler import predicates as preds
+from kubernetes_tpu.scheduler.driver import filter_schedulable_nodes
+from kubernetes_tpu.util.metrics import DefragMetrics, Registry
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def mknode(i, cpu="4", mem="8Gi", unsched=False):
+    return api.Node(
+        metadata=api.ObjectMeta(name=f"n{i:03d}"),
+        spec=api.NodeSpec(capacity={"cpu": Quantity(cpu),
+                                    "memory": Quantity(mem)},
+                          unschedulable=unsched))
+
+
+def mkpod(name, mcpu=500, host="", prio=0, ns="default", ann=None,
+          port=0, dirty=False):
+    """A bound pod with a CLEAN binding (spec.host == status.host) unless
+    ``dirty`` — defrag only ever moves clean bindings."""
+    ports = [api.ContainerPort(container_port=80, host_port=port)] \
+        if port else []
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace=ns, uid=f"uid-{name}",
+                                annotations=ann),
+        spec=api.PodSpec(
+            containers=[api.Container(
+                name="c", image="i", ports=ports,
+                resources=api.ResourceRequirements(limits={
+                    "cpu": Quantity(f"{mcpu}m"),
+                    "memory": Quantity("64Mi")}))],
+            priority=prio,
+            host="" if dirty else host),
+        status=api.PodStatus(host=host))
+
+
+def wave_all(nodes, pods, cfg=None):
+    """Run the wave through BOTH dense encoders and the serial oracle;
+    assert bit-identity on moves and every score, return the dense one."""
+    plan, cand, moves = defrag_wave(nodes, pods, cfg=cfg)
+    plan_i, cand_i, moves_i = defrag_wave(nodes, pods, cfg=cfg,
+                                          encoder=IncrementalEncoder())
+    o_moves, o_sb, o_sm, o_sa = defrag_serial(nodes, pods, cfg=cfg)
+    assert moves == moves_i == o_moves
+    assert (plan.score_before, plan.score_mandatory, plan.score_after) == \
+        (plan_i.score_before, plan_i.score_mandatory, plan_i.score_after) == \
+        (o_sb, o_sm, o_sa)
+    assert [p.metadata.uid for p in cand.pods] == \
+        [p.metadata.uid for p in cand_i.pods]
+    return plan, cand, moves
+
+
+# ---------------------------------------------------------------------------
+# movable-pod selection
+# ---------------------------------------------------------------------------
+
+class TestCandidateSelection:
+    def test_exclusions(self):
+        cfg = DefragConfig()
+        assert is_movable(mkpod("ok", host="n000"), cfg)
+        assert not is_movable(
+            mkpod("sys", host="n000", ns="kube-system"), cfg)
+        assert not is_movable(
+            mkpod("gang", host="n000",
+                  ann={GANG_NAME_ANNOTATION: "g1"}), cfg)
+        assert not is_movable(
+            mkpod("vip", host="n000",
+                  prio=api.HighestUserDefinablePriority + 1), cfg)
+        assert not is_movable(
+            mkpod("dnd", host="n000",
+                  ann={DO_NOT_DISRUPT_ANNOTATION: "true"}), cfg)
+        # the annotation opt-out is explicit: "false" means movable
+        assert is_movable(
+            mkpod("dnd-off", host="n000",
+                  ann={DO_NOT_DISRUPT_ANNOTATION: "false"}), cfg)
+
+    def test_dirty_binding_is_undrainable_not_a_candidate(self):
+        nodes = [mknode(0, unsched=True), mknode(1)]
+        pod = mkpod("inflight", host="n000", dirty=True)
+        cand = select_candidates(nodes, [pod])
+        assert not cand.pods
+        assert [p.metadata.name for p in cand.undrainable] == ["inflight"]
+
+    def test_source_max_permille_excludes_busy_nodes(self):
+        # 800/1000 cpu permille >= the 700 default: not a source
+        nodes = [mknode(0, cpu="1"), mknode(1, cpu="1")]
+        busy = [mkpod(f"b{i}", mcpu=400, host="n000") for i in range(2)]
+        quiet = [mkpod("q0", mcpu=100, host="n001")]
+        cand = select_candidates(nodes, busy + quiet)
+        assert list(cand.source_idx) == [1]
+        assert [p.metadata.name for p in cand.pods] == ["q0"]
+
+    def test_voluntary_budget_takes_whole_nodes_only(self):
+        # budget 3: n000 (2 pods, emptier) fits whole; n001 (3 pods)
+        # would overflow the remaining 1 -> break, nothing partial
+        nodes = [mknode(0), mknode(1), mknode(2)]
+        pods = [mkpod(f"a{i}", mcpu=100, host="n000") for i in range(2)] + \
+               [mkpod(f"b{i}", mcpu=200, host="n001") for i in range(3)]
+        cand = select_candidates(nodes, pods,
+                                 DefragConfig(max_moves=3))
+        assert list(cand.source_idx) == [0]
+        assert len(cand.pods) == 2
+
+
+# ---------------------------------------------------------------------------
+# pinned waves, bit-identical across both encoders and the oracle
+# ---------------------------------------------------------------------------
+
+class TestPinnedWaves:
+    def test_empty_cluster_is_a_noop(self):
+        plan, cand, moves = wave_all([mknode(i) for i in range(3)], [])
+        assert not moves and not cand.pods
+        assert plan.score_before == plan.score_after == 0
+
+    def test_packed_cluster_is_a_noop(self):
+        nodes = [mknode(0, cpu="1"), mknode(1, cpu="1")]
+        pods = [mkpod(f"p{i}", mcpu=400, host=f"n{i % 2:03d}")
+                for i in range(4)]
+        plan, cand, moves = wave_all(nodes, pods)
+        assert not moves
+        assert plan.score_after == plan.score_before
+        assert not plan.voluntary_dropped
+
+    def test_single_consolidation_empties_the_sparse_node(self):
+        # n000: one movable pod. n001: pinned by a do-not-disrupt pod,
+        # so it is a target, never a source. n002 stays empty (voluntary
+        # waves never re-open empty nodes).
+        nodes = [mknode(0), mknode(1), mknode(2)]
+        pods = [mkpod("lone", host="n000")] + \
+               [mkpod(f"t{i}", host="n001") for i in range(3)] + \
+               [mkpod("pin", host="n001",
+                      ann={DO_NOT_DISRUPT_ANNOTATION: "true"})]
+        plan, cand, moves = wave_all(nodes, pods)
+        assert [(m.name, m.source, m.target, m.mandatory)
+                for m in moves] == [("lone", "n000", "n001", False)]
+        assert plan.score_after < plan.score_before
+        assert not plan.voluntary_dropped
+
+    def test_cordon_drain_ignores_the_move_budget(self):
+        nodes = [mknode(0, unsched=True), mknode(1)]
+        pods = [mkpod("a", host="n000"), mkpod("b", host="n000"),
+                mkpod("pin", host="n001",
+                      ann={DO_NOT_DISRUPT_ANNOTATION: "true"})]
+        plan, cand, moves = wave_all(nodes, pods,
+                                     DefragConfig(max_moves=0))
+        assert sorted(m.name for m in moves) == ["a", "b"]
+        assert all(m.mandatory and m.target == "n001" for m in moves)
+        assert not cand.undrainable
+
+    def test_cordoned_exclusions_surface_as_undrainable(self):
+        nodes = [mknode(0, unsched=True), mknode(1)]
+        pods = [mkpod("gang", host="n000",
+                      ann={GANG_NAME_ANNOTATION: "g"}),
+                mkpod("dnd", host="n000",
+                      ann={DO_NOT_DISRUPT_ANNOTATION: "true"}),
+                mkpod("vip", host="n000",
+                      prio=api.HighestUserDefinablePriority + 1),
+                mkpod("sys", host="n000", ns="kube-system"),
+                mkpod("ok", host="n000")]
+        plan, cand, moves = wave_all(nodes, pods)
+        assert [m.name for m in moves] == ["ok"]
+        assert sorted(p.metadata.name for p in cand.undrainable) == \
+            ["dnd", "gang", "sys", "vip"]
+
+    def test_all_sources_wave_keeps_a_target(self):
+        # every schedulable node qualifies as a voluntary source (equal,
+        # single-pod, far under source_max_permille); selection must
+        # leave at least one of them unselected or the wave deadlocks
+        # into a silent no-op (sources are excluded as targets)
+        nodes = [mknode(i) for i in range(4)]
+        pods = [mkpod(f"p{i}", host=f"n{i:03d}") for i in range(4)]
+        plan, cand, moves = wave_all(nodes, pods)
+        assert len(set(cand.source_idx)) < len(nodes)
+        assert moves
+        assert plan.score_after < plan.score_before
+
+    def test_drain_survives_all_eligible_sources(self):
+        # cordoned node plus N equal single-pod nodes, every one of
+        # which qualifies as a voluntary source — the drain must still
+        # find a target
+        nodes = [mknode(0, unsched=True)] + \
+                [mknode(i) for i in range(1, 5)]
+        pods = [mkpod("drainme", host="n000")] + \
+               [mkpod(f"p{i}", host=f"n{i:03d}") for i in range(1, 5)]
+        plan, cand, moves = wave_all(nodes, pods)
+        mand = [m for m in moves if m.mandatory]
+        assert [m.name for m in mand] == ["drainme"]
+        assert mand[0].target != "n000"
+
+    def test_fuzz_bit_identity_and_invariants(self):
+        rng = random.Random(171717)
+        cfg = DefragConfig()
+        for trial in range(12):
+            n = rng.randrange(4, 10)
+            nodes = [mknode(i, cpu=rng.choice(["1", "2", "4"]),
+                            unsched=rng.random() < 0.2) for i in range(n)]
+            pods = []
+            for j in range(rng.randrange(0, 25)):
+                ann = None
+                r = rng.random()
+                if r < 0.1:
+                    ann = {GANG_NAME_ANNOTATION: "g1"}
+                elif r < 0.2:
+                    ann = {DO_NOT_DISRUPT_ANNOTATION:
+                           rng.choice(["true", "false"])}
+                pods.append(mkpod(
+                    f"p{j}", mcpu=rng.choice([100, 250, 500]),
+                    host=rng.choice(nodes).metadata.name,
+                    prio=rng.choice(
+                        [0, 10, api.HighestUserDefinablePriority + 5]),
+                    ns="kube-system" if rng.random() < 0.1 else "default",
+                    ann=ann, port=rng.choice([0, 0, 0, 8080]),
+                    dirty=rng.random() < 0.1))
+            plan, cand, moves = wave_all(nodes, pods)
+            by_uid = {p.metadata.uid: p for p in pods}
+            cordoned = {x.metadata.name for x in nodes
+                        if x.spec.unschedulable}
+            for mv in moves:
+                p = by_uid[mv.uid]
+                assert is_movable(p, cfg), (trial, mv)
+                assert p.spec.host == p.status.host == mv.source
+                assert mv.source != mv.target
+                assert mv.target not in cordoned, (trial, mv)
+                assert mv.mandatory == (mv.source in cordoned)
+            # the acceptance gate: accepted voluntary sets strictly
+            # improve on the mandatory-only outcome, never regress it
+            assert plan.score_after <= plan.score_mandatory, trial
+
+
+# ---------------------------------------------------------------------------
+# spec.unschedulable across the scheduler layers (the cordon satellite)
+# ---------------------------------------------------------------------------
+
+class _Info:
+    def __init__(self, nodes):
+        self._nodes = {n.metadata.name: n for n in nodes}
+
+    def get_node_info(self, name):
+        return self._nodes[name]
+
+
+class TestUnschedulable:
+    def test_driver_filters_unschedulable_nodes(self):
+        lst = api.NodeList(items=[mknode(0, unsched=True), mknode(1)])
+        out = filter_schedulable_nodes(lst)
+        assert [n.metadata.name for n in out.items] == ["n001"]
+
+    def test_schedulable_predicate(self):
+        nodes = [mknode(0, unsched=True), mknode(1)]
+        sched = preds.Schedulable(_Info(nodes))
+        assert not sched.pod_is_schedulable(mkpod("p"), [], "n000")
+        assert sched.pod_is_schedulable(mkpod("p"), [], "n001")
+
+    def test_predicate_is_structural_not_policy_vocabulary(self):
+        args = plugins.PluginFactoryArgs(node_info=_Info([mknode(0)]))
+        out = plugins.predicates_from_policy(
+            plugins.Policy(predicates=[], priorities=[]), args)
+        assert "Schedulable" in out
+        assert "Schedulable" in \
+            plugins.get_algorithm_provider(
+                plugins.DEFAULT_PROVIDER)["predicates"]
+
+    def test_dense_solve_never_places_on_cordoned(self):
+        # the cordoned node is EMPTY (the better fit); both encoders
+        # must still fold spec.unschedulable into node_extra_ok
+        nodes = [mknode(0, unsched=True), mknode(1)]
+        existing = [mkpod("e0", host="n001"), mkpod("e1", host="n001")]
+        pending = [mkpod("want", host="")]
+        for snap in (encode_snapshot(nodes, existing, pending),
+                     IncrementalEncoder().encode(nodes, existing,
+                                                 pending)):
+            chosen, _scores = solve(snap)
+            assert decisions_to_names(snap, chosen) == ["n001"]
+
+    @pytest.mark.parametrize("version", ["v1", "v1beta1", "v1beta2"])
+    def test_unschedulable_round_trips(self, version):
+        node = mknode(0, unsched=True)
+        back = scheme.decode(scheme.encode(node, version))
+        assert back.spec.unschedulable is True
+        assert scheme.decode(
+            scheme.encode(mknode(1), version)).spec.unschedulable is False
+
+    def test_node_field_selector_on_unschedulable(self):
+        client = Client(InProcessTransport(Master()))
+        client.nodes().create(mknode(0, unsched=True))
+        client.nodes().create(mknode(1))
+        got = client.nodes().list(
+            field_selector="spec.unschedulable=true").items
+        assert [n.metadata.name for n in got] == ["n000"]
+        got = client.nodes().list(
+            field_selector="spec.unschedulable=false").items
+        assert [n.metadata.name for n in got] == ["n001"]
+
+
+# ---------------------------------------------------------------------------
+# kubectl cordon / uncordon / drain
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def cluster():
+    master = Master()
+    client = Client(InProcessTransport(master))
+    out, err = io.StringIO(), io.StringIO()
+    factory = Factory(client, out=out, err=err)
+    return master, client, factory, out, err
+
+
+def kubectl(factory, *argv):
+    return run_kubectl(list(argv), factory)
+
+
+class TestKubectlCordon:
+    def test_cordon_sets_unschedulable_and_is_idempotent(self, cluster):
+        _, client, factory, out, _ = cluster
+        client.nodes().create(mknode(1))
+        assert kubectl(factory, "cordon", "n001") == 0
+        assert "node/n001 cordoned" in out.getvalue()
+        assert client.nodes().get("n001").spec.unschedulable is True
+        assert kubectl(factory, "cordon", "n001") == 0
+        assert "already cordoned" in out.getvalue()
+
+    def test_uncordon_clears_the_flag(self, cluster):
+        _, client, factory, out, _ = cluster
+        client.nodes().create(mknode(1, unsched=True))
+        assert kubectl(factory, "uncordon", "n001") == 0
+        assert "node/n001 uncordoned" in out.getvalue()
+        assert client.nodes().get("n001").spec.unschedulable is False
+
+    def test_drain_cordons_and_announces_the_migration(self, cluster):
+        _, client, factory, out, _ = cluster
+        client.nodes().create(mknode(1))
+        assert kubectl(factory, "drain", "n001") == 0
+        assert client.nodes().get("n001").spec.unschedulable is True
+        assert "node/n001 draining" in out.getvalue()
+
+    def test_get_nodes_shows_scheduling_disabled(self, cluster):
+        _, client, factory, out, _ = cluster
+        client.nodes().create(mknode(0, unsched=True))
+        client.nodes().create(mknode(1))
+        assert kubectl(factory, "get", "nodes") == 0
+        lines = out.getvalue().splitlines()
+        assert any("n000" in ln and "SchedulingDisabled" in ln
+                   for ln in lines)
+        assert not any("n001" in ln and "SchedulingDisabled" in ln
+                       for ln in lines)
+
+    def test_describe_node_shows_unschedulable(self, cluster):
+        _, client, factory, out, _ = cluster
+        client.nodes().create(mknode(0, unsched=True))
+        assert kubectl(factory, "describe", "nodes", "n000") == 0
+        assert "Unschedulable:\ttrue" in out.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# the migration binding lane (atomic evict-here + bind-there)
+# ---------------------------------------------------------------------------
+
+class TestMigrationBindings:
+    def _master(self):
+        m = Master()
+        return m, Context(namespace="default")
+
+    def _bound(self, m, name, host):
+        pod = api.Pod(metadata=api.ObjectMeta(name=name,
+                                              namespace="default"),
+                      spec=api.PodSpec(containers=[
+                          api.Container(name="c", image="i")]))
+        m.dispatch("create", "pods", namespace="default", body=pod)
+        m.bindings.create(Context(namespace="default"), api.Binding(
+            metadata=api.ObjectMeta(name=name, namespace="default"),
+            pod_name=name, host=host))
+        return m.pods.get(Context(namespace="default"), name)
+
+    def _migration(self, name, uid, src, dst):
+        return api.Binding(
+            metadata=api.ObjectMeta(name=name, namespace="default"),
+            pod_name=name, host=dst, from_host=src, pod_uid=uid)
+
+    def test_clean_migration_swaps_host_atomically(self):
+        m, ctx = self._master()
+        p = self._bound(m, "mover", "n1")
+        res = m.bind_batch("default", api.BindingList(items=[
+            self._migration("mover", p.metadata.uid, "n1", "n2")]))
+        assert not res.items[0].error
+        got = m.pods.get(ctx, "mover")
+        assert got.spec.host == got.status.host == "n2"
+
+    def test_cas_loss_to_concurrent_bind_is_409_nothing_applied(self):
+        # the scheduler re-bound the pod between proposal and commit:
+        # from_host is stale, the migration must lose and change nothing
+        m, ctx = self._master()
+        p = self._bound(m, "mover", "n9")
+        res = m.bind_batch("default", api.BindingList(items=[
+            self._migration("mover", p.metadata.uid, "n1", "n2")]))
+        assert res.items[0].code == 409
+        assert m.pods.get(ctx, "mover").spec.host == "n9"
+
+    def test_uid_change_is_409_nothing_applied(self):
+        m, ctx = self._master()
+        self._bound(m, "mover", "n1")
+        res = m.bind_batch("default", api.BindingList(items=[
+            self._migration("mover", "stale-uid", "n1", "n2")]))
+        assert res.items[0].code == 409
+        assert m.pods.get(ctx, "mover").spec.host == "n1"
+
+    def test_deleted_pod_is_an_error_nothing_applied(self):
+        m, _ctx = self._master()
+        p = self._bound(m, "gone", "n1")
+        m.dispatch("delete", "pods", namespace="default", name="gone")
+        res = m.bind_batch("default", api.BindingList(items=[
+            self._migration("gone", p.metadata.uid, "n1", "n2")]))
+        assert res.items[0].error
+        assert res.items[0].code in (404, 409)
+
+    def test_mixed_batch_has_per_item_semantics(self):
+        m, ctx = self._master()
+        ok = self._bound(m, "ok", "n1")
+        self._bound(m, "stale", "n9")
+        res = m.bind_batch("default", api.BindingList(items=[
+            self._migration("ok", ok.metadata.uid, "n1", "n2"),
+            self._migration("stale", "wrong-uid", "n9", "n2")]))
+        assert not res.items[0].error
+        assert res.items[1].code == 409
+        assert m.pods.get(ctx, "ok").spec.host == "n2"
+        assert m.pods.get(ctx, "stale").spec.host == "n9"
+
+
+# ---------------------------------------------------------------------------
+# the descheduler controller
+# ---------------------------------------------------------------------------
+
+def _controller(master, **cfg_kw):
+    client = Client(InProcessTransport(master))
+    return client, Descheduler(
+        client, DeschedulerConfig(**cfg_kw),
+        metrics=DefragMetrics(Registry()))
+
+
+def _bound_pod(client, master, name, host, mcpu=500, ann=None):
+    client.pods("default").create(api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace="default",
+                                annotations=ann),
+        spec=api.PodSpec(containers=[api.Container(
+            name="c", image="i",
+            resources=api.ResourceRequirements(limits={
+                "cpu": Quantity(f"{mcpu}m"),
+                "memory": Quantity("64Mi")}))])))
+    master.bindings.create(Context(namespace="default"), api.Binding(
+        metadata=api.ObjectMeta(name=name, namespace="default"),
+        pod_name=name, host=host))
+
+
+class TestDescheduler:
+    def test_declines_while_scheduler_has_pending_work(self):
+        m = Master()
+        client, d = _controller(m)
+        client.nodes().create(mknode(0))
+        client.pods("default").create(api.Pod(
+            metadata=api.ObjectMeta(name="unbound", namespace="default"),
+            spec=api.PodSpec(containers=[
+                api.Container(name="c", image="i")])))
+        rep = d.run_once(force=True)
+        assert rep.declined == "pending_work"
+        assert d.metrics.declined.value("pending_work") == 1
+
+    def test_token_bucket_declines_the_second_wave(self):
+        m = Master()
+        _client, d = _controller(m, qps=0.001, burst=1)
+        assert d.run_once().declined == ""
+        assert d.run_once().declined == "rate_limited"
+        # force (cmd --one-shot, tests) skips the bucket
+        assert d.run_once(force=True).declined == ""
+
+    def test_cordon_drain_end_to_end(self):
+        m = Master()
+        client, d = _controller(m)
+        client.nodes().create(mknode(0, unsched=True))
+        client.nodes().create(mknode(1))
+        _bound_pod(client, m, "a", "n000")
+        _bound_pod(client, m, "b", "n000")
+        # pin n001 so it is a drain target, not itself a voluntary source
+        _bound_pod(client, m, "keep", "n001",
+                   ann={DO_NOT_DISRUPT_ANNOTATION: "true"})
+        rep = d.run_once(force=True)
+        assert rep.declined == "" and not rep.error
+        assert rep.proposed == rep.committed == 2
+        assert rep.conflicts == 0
+        assert rep.nodes_drained == ["n000"]
+        for name in ("a", "b"):
+            got = client.pods("default").get(name)
+            assert got.spec.host == got.status.host == "n001"
+        assert d.metrics.migrations.total() == 2
+        assert d.metrics.nodes_drained.total() == 1
+        assert d.metrics.fragmentation_score.value() == rep.score_after
+        assert d.metrics.score_regressions.total() == 0
+        assert rep.score_after <= rep.score_mandatory
+
+    def test_packed_cluster_proposes_nothing(self):
+        m = Master()
+        client, d = _controller(m)
+        client.nodes().create(mknode(0, cpu="1"))
+        client.nodes().create(mknode(1, cpu="1"))
+        for i in range(2):
+            _bound_pod(client, m, f"p{i}", f"n{i:03d}", mcpu=800)
+        rep = d.run_once(force=True)
+        assert rep.declined == "" and rep.proposed == 0
+        assert rep.score_after == rep.score_before
+
+    def test_conflict_is_counted_and_the_next_wave_reproposes(self):
+        m = Master()
+        client, d = _controller(m)
+        client.nodes().create(mknode(0, unsched=True))
+        client.nodes().create(mknode(1))
+        _bound_pod(client, m, "a", "n000")
+        # a stale proposal (wrong uid) loses its commit guard: counted
+        # as a conflict, NOT applied
+        rep = WaveReport()
+        committed = d._commit(
+            [Move("stale-uid", "a", "default", "n000", "n001", True)], rep)
+        assert not committed and rep.conflicts == 1
+        got = client.pods("default").get("a")
+        assert got.spec.host == "n000"
+        # the next wave re-LISTs truth and re-proposes the move
+        rep2 = d.run_once(force=True)
+        assert rep2.committed == 1 and rep2.nodes_drained == ["n000"]
+        assert client.pods("default").get("a").spec.host == "n001"
+
+
+# ---------------------------------------------------------------------------
+# SLO rules, record schema, perfgate shape
+# ---------------------------------------------------------------------------
+
+def _ns(s):
+    return int(s * 1e9)
+
+
+def _rule(name):
+    return next(r for r in default_churn_rules() if r.name == name)
+
+
+class TestDefragSLORules:
+    def test_rules_are_in_the_churn_contract(self):
+        names = {r.name for r in default_churn_rules()}
+        assert "defrag_migration_storm" in names
+        assert "fragmentation_score_monotone_under_defrag" in names
+
+    def test_migration_storm_fires_after_debounce_then_resolves(self):
+        r = _rule("defrag_migration_storm")
+        assert r.service == "descheduler" and r.reduce == "rate"
+        w = SLOWatchdog([r])
+        assert w.observe(r, 100.0, _ns(0)) is None       # pending
+        tr = w.observe(r, 100.0, _ns(r.for_s + 1))
+        assert tr and tr["state"] == "firing"
+        tr = w.observe(r, 1.0, _ns(r.for_s + 2))
+        assert tr and tr["state"] == "resolved"
+        assert not w.firing()
+
+    def test_monotone_rule_is_a_zero_invariant(self):
+        r = _rule("fragmentation_score_monotone_under_defrag")
+        assert r.threshold == 0.0 and r.for_s == 0.0
+        w = SLOWatchdog([r])
+        assert w.observe(r, 0.0, _ns(0)) is None         # invariant holds
+        assert w.observe(r, None, _ns(1)) is None        # no data: no-op
+        tr = w.observe(r, 1.0, _ns(2))
+        assert tr and tr["state"] == "firing"
+
+
+def _load_hack(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_REPO, "hack", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestRecordContract:
+    def _frag(self, **over):
+        frag = {"score_before": 100, "score_after": 90, "waves": 2,
+                "migrations_committed": 5, "migrations_409": 0,
+                "nodes_drained": 3, "nodes_emptied": 1, "cordoned": 3,
+                "cordoned_drained_ok": True, "unbound_after": 0,
+                "score_regressions": 0}
+        frag.update(over)
+        return frag
+
+    def _frag_missing(self, churn_mp, frag):
+        miss = churn_mp.validate_record({"fragmentation": frag},
+                                        round_no=16)
+        return [x for x in miss if x.startswith("fragmentation")]
+
+    def test_fragmentation_gate(self):
+        churn_mp = _load_hack("churn_mp")
+        assert self._frag_missing(churn_mp, self._frag()) == []
+        # an error window is exempt beyond its marker
+        assert self._frag_missing(churn_mp, {"error": "boom"}) == []
+        assert "fragmentation.waves" in self._frag_missing(
+            churn_mp, {k: v for k, v in self._frag().items()
+                       if k != "waves"})
+        assert "fragmentation.score:not-improved" in self._frag_missing(
+            churn_mp, self._frag(score_after=100))
+        assert "fragmentation.score_regressions:nonzero" in \
+            self._frag_missing(churn_mp, self._frag(score_regressions=1))
+        assert "fragmentation.cordoned_drained_ok:false" in \
+            self._frag_missing(churn_mp,
+                               self._frag(cordoned_drained_ok=False))
+        assert "fragmentation.unbound_after:nonzero" in \
+            self._frag_missing(churn_mp, self._frag(unbound_after=2))
+
+    def test_perfgate_shape_key_isolates_fragment_storms(self):
+        pg = _load_hack("perfgate")
+        assert pg.shape_key({"config": "c"}) == "c"
+        assert pg.shape_key({"config": "c",
+                             "fragmentation": {"waves": 1}}) == \
+            "c+fragmentstorm"
+
+
+class TestCmdParser:
+    def test_flags_map_onto_the_config(self):
+        from kubernetes_tpu.cmd.descheduler import (build_descheduler,
+                                                    build_parser)
+        opts = build_parser().parse_args([
+            "--qps", "1.5", "--burst", "3", "--max-moves", "7",
+            "--source-max-permille", "600",
+            "--protected-namespaces", "kube-system,infra",
+            "--always-defrag"])
+        d = build_descheduler(opts)
+        assert d.config.qps == 1.5 and d.config.burst == 3
+        assert d.config.decline_on_pending is False
+        assert d.config.defrag.max_moves == 7
+        assert d.config.defrag.source_max_permille == 600
+        assert d.config.defrag.protected_namespaces == \
+            ("kube-system", "infra")

@@ -300,7 +300,10 @@ def test_policy_round_trip():
         pod_lister=FakePodLister([]), service_lister=FakeServiceLister([]),
         node_lister=FakeMinionLister(nodes), node_info=FakeNodeInfo(nodes))
     pred_map = schedplugins.predicates_from_policy(policy, args)
-    assert set(pred_map) == {"PodFitsPorts", "ZoneAffinity", "RequireRegion"}
+    # Schedulable is structural (kubectl cordon), injected regardless of
+    # the policy vocabulary
+    assert set(pred_map) == {"PodFitsPorts", "ZoneAffinity", "RequireRegion",
+                             "Schedulable"}
     prio_list = schedplugins.priorities_from_policy(policy, args)
     assert [c.weight for c in prio_list] == [2, 1, 3]
 
