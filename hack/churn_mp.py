@@ -26,6 +26,7 @@ import re
 import struct
 import subprocess
 import sys
+import tempfile
 import time
 import urllib.request
 
@@ -464,6 +465,50 @@ def _scrape_solverd_mesh(raw: str):
     out["single_device_probes"] = int(s_count)
     out["single_device_p50_ms"] = round(
         _hist_quantile(s_buckets, s_count, 0.5) * 1000, 2) if s_count else 0.0
+    sub = _scrape_solverd_submesh(raw)
+    if sub is not None:
+        out["submesh"] = sub
+    return out
+
+
+def _scrape_solverd_submesh(raw: str):
+    """The solverd_submesh_* family (models/submesh.py via MeshExecutor):
+    kube-horizon's active sub-mesh solve — how many waves ran on a
+    compacted node axis, the kept fraction (the compression the keep
+    rule actually bought), host-side planning cost, and the live
+    compacted-vs-full bit-identity probe. None only when the daemon
+    predates the family; a mesh run that never engaged still discloses
+    waves 0 / full_waves N (required from r17 on)."""
+    keys = {"solverd_submesh_waves_total",
+            "solverd_submesh_full_waves_total",
+            "solverd_submesh_nodes_kept_total",
+            "solverd_submesh_nodes_total",
+            "solverd_submesh_parity_checks_total",
+            "solverd_submesh_parity_divergent_total"}
+    vals = {}
+    for line in raw.splitlines():
+        key, _, val = line.rpartition(" ")
+        if key in keys:
+            vals[key] = float(val)
+    if "solverd_submesh_waves_total" not in vals:
+        return None
+    kept = int(vals.get("solverd_submesh_nodes_kept_total", 0))
+    total = int(vals.get("solverd_submesh_nodes_total", 0))
+    out = {
+        "waves": int(vals["solverd_submesh_waves_total"]),
+        "full_waves": int(vals.get("solverd_submesh_full_waves_total", 0)),
+        "nodes_kept": kept,
+        "nodes_total": total,
+        "kept_fraction": round(kept / total, 3) if total else 0.0,
+        "parity_checks": int(
+            vals.get("solverd_submesh_parity_checks_total", 0)),
+        "parity_divergent": int(
+            vals.get("solverd_submesh_parity_divergent_total", 0)),
+    }
+    c_sum, c_count, c_buckets = _parse_hist(
+        raw, "solverd_submesh_compact_seconds")
+    out["compact_p50_ms"] = round(
+        _hist_quantile(c_buckets, c_count, 0.5) * 1000, 2) if c_count else 0.0
     return out
 
 
@@ -500,47 +545,72 @@ def _hist_quantile(buckets, count: float, q: float) -> float:
     return prev_le
 
 
+def _merge_hist(raws, base: str):
+    """_parse_hist merged across worker scrapes: sums and counts add,
+    and the cumulative bucket counts add le-wise (every worker ships
+    identical bucket bounds)."""
+    total = count = 0.0
+    bmap: dict = {}
+    for raw in raws:
+        s, c, buckets = _parse_hist(raw, base)
+        total += s
+        count += c
+        for le, n in buckets:
+            bmap[le] = bmap.get(le, 0.0) + n
+    return total, count, sorted(bmap.items())
+
+
 def _scrape_apiserver(master: str) -> dict:
     """The apiserver_* hot-path evidence from the server's /metrics:
     frame-cache effectiveness, fan-out write batching, lag drops, and the
     batch-bind size/latency envelope (docs/design/apiserver-hotpath.md)."""
     raw = urllib.request.urlopen(f"{master}/metrics", timeout=5
                                  ).read().decode()
-    vals = {}
-    for key in ("apiserver_watch_frame_cache_hits_total",
-                "apiserver_watch_frame_cache_misses_total",
-                "apiserver_watch_frame_seeds_total",
-                "apiserver_watch_lag_drops_total",
-                "watch_events_coalesced_total",
-                "watch_events_dropped_total",
-                "watch_lag_resyncs_total"):
+    return _parse_apiserver([raw])
+
+
+def _parse_apiserver(raws) -> dict:
+    """One record ``apiserver`` section from one or more /metrics
+    scrapes — with an SO_REUSEPORT fleet, one raw text per WORKER, so
+    counters sum and histograms merge into fleet-wide quantiles."""
+    keys = ("apiserver_watch_frame_cache_hits_total",
+            "apiserver_watch_frame_cache_misses_total",
+            "apiserver_watch_frame_seeds_total",
+            "apiserver_watch_lag_drops_total",
+            "watch_events_coalesced_total",
+            "watch_events_dropped_total",
+            "watch_lag_resyncs_total")
+    vals = {k: 0.0 for k in keys}
+    for raw in raws:
         for line in raw.splitlines():
-            if line.startswith(key + " "):
-                vals[key] = float(line.rsplit(None, 1)[1])
-    hits = vals.get("apiserver_watch_frame_cache_hits_total", 0.0)
-    misses = vals.get("apiserver_watch_frame_cache_misses_total", 0.0)
+            for key in keys:
+                if line.startswith(key + " "):
+                    vals[key] += float(line.rsplit(None, 1)[1])
+    hits = vals["apiserver_watch_frame_cache_hits_total"]
+    misses = vals["apiserver_watch_frame_cache_misses_total"]
     out = {
         "frame_cache_hits": int(hits),
         "frame_cache_misses": int(misses),
         "frame_cache_hit_rate": round(hits / (hits + misses), 3)
         if hits + misses else 0.0,
         "frame_seeds": int(
-            vals.get("apiserver_watch_frame_seeds_total", 0.0)),
+            vals["apiserver_watch_frame_seeds_total"]),
         "watch_lag_drops": int(
-            vals.get("apiserver_watch_lag_drops_total", 0.0)),
+            vals["apiserver_watch_lag_drops_total"]),
         "watch_events_coalesced": int(
-            vals.get("watch_events_coalesced_total", 0.0)),
+            vals["watch_events_coalesced_total"]),
         "watch_events_dropped": int(
-            vals.get("watch_events_dropped_total", 0.0)),
+            vals["watch_events_dropped_total"]),
     }
-    fo_sum, fo_count, _ = _parse_hist(raw, "apiserver_watch_fanout_seconds")
-    wf_sum, wf_count, _ = _parse_hist(raw, "apiserver_watch_write_frames")
+    fo_sum, fo_count, _ = _merge_hist(raws, "apiserver_watch_fanout_seconds")
+    wf_sum, wf_count, _ = _merge_hist(raws, "apiserver_watch_write_frames")
     out["fanout_seconds"] = round(fo_sum, 2)
     out["fanout_writes"] = int(fo_count)
     if wf_count:
         out["frames_per_write"] = round(wf_sum / wf_count, 2)
-    sz_sum, sz_count, _ = _parse_hist(raw, "apiserver_batch_bind_size")
-    s_sum, s_count, s_buckets = _parse_hist(raw, "apiserver_batch_bind_seconds")
+    sz_sum, sz_count, _ = _merge_hist(raws, "apiserver_batch_bind_size")
+    s_sum, s_count, s_buckets = _merge_hist(raws,
+                                            "apiserver_batch_bind_seconds")
     out["batch_bind_requests"] = int(sz_count)
     out["batch_bind_bindings"] = int(sz_sum)
     out["batch_bind_p50_ms"] = round(
@@ -550,6 +620,77 @@ def _scrape_apiserver(master: str) -> dict:
     out["bind_server_ms_per_pod"] = round(s_sum / sz_sum * 1000, 3) \
         if sz_sum else 0.0
     return out
+
+
+def _scrape_worker_raws(master: str, n_api: int) -> dict:
+    """{worker_index: /metrics text} for an SO_REUSEPORT fleet: each
+    GET lands on an arbitrary worker (keyed by the
+    ``apiserver_worker_index`` identity gauge), so the shared port is
+    hit until all N have answered or the attempt budget runs out — a
+    missed worker is DISCLOSED by the caller, never silently absent.
+    Re-scrapes of a seen worker keep the newest text."""
+    raws: dict = {}
+    for _ in range(max(8, 24 * n_api)):
+        if len(raws) >= n_api:
+            break
+        try:
+            raw = urllib.request.urlopen(f"{master}/metrics", timeout=5
+                                         ).read().decode()
+        except Exception:
+            continue
+        for line in raw.splitlines():
+            if line.startswith("apiserver_worker_index "):
+                idx = int(float(line.rsplit(None, 1)[1]))
+                if idx >= 0:
+                    raws[idx] = raw
+                break
+    return raws
+
+
+def _worker_disclosure(raws: dict, feed_s: float, pid_by_name: dict) -> list:
+    """Per-worker record rows (required at --apiservers > 1): request
+    share, frame-cache effectiveness, cross-process seed traffic, and
+    CPU seconds per worker."""
+    rows = []
+    for idx in sorted(raws):
+        raw = raws[idx]
+        requests = 0.0
+        singles = {"apiserver_worker_pid": 0.0,
+                   "apiserver_watch_frame_cache_hits_total": 0.0,
+                   "apiserver_watch_frame_cache_misses_total": 0.0,
+                   "apiserver_cache_seed_published_total": 0.0,
+                   "apiserver_cache_seed_imported_total": 0.0,
+                   "apiserver_cache_seed_hits_total": 0.0,
+                   "apiserver_cache_seed_ring_drops_total": 0.0}
+        for line in raw.splitlines():
+            if line.startswith("apiserver_request_count{"):
+                requests += float(line.rsplit(None, 1)[1])
+                continue
+            for key in singles:
+                if line.startswith(key + " "):
+                    singles[key] = float(line.rsplit(None, 1)[1])
+        pid = int(singles["apiserver_worker_pid"])
+        hits = singles["apiserver_watch_frame_cache_hits_total"]
+        misses = singles["apiserver_watch_frame_cache_misses_total"]
+        rows.append({
+            "worker": idx,
+            "pid": pid,
+            "requests": int(requests),
+            "request_rate_per_s": round(requests / feed_s, 1)
+            if feed_s else 0.0,
+            "frame_cache_hit_rate": round(hits / (hits + misses), 3)
+            if hits + misses else 0.0,
+            "cache_seed_published": int(
+                singles["apiserver_cache_seed_published_total"]),
+            "cache_seed_imported": int(
+                singles["apiserver_cache_seed_imported_total"]),
+            "cache_seed_hits": int(
+                singles["apiserver_cache_seed_hits_total"]),
+            "cache_seed_ring_drops": int(
+                singles["apiserver_cache_seed_ring_drops_total"]),
+            "cpu_s": _proc_cpu_s(pid_by_name.get(f"apiserver{idx}", pid)),
+        })
+    return rows
 
 
 def _label_of(line: str, key: str) -> str:
@@ -790,6 +931,26 @@ FRAGMENTATION_FIELDS = ("score_before", "score_after", "waves",
                         "nodes_drained", "nodes_emptied", "cordoned",
                         "cordoned_drained_ok", "unbound_after",
                         "score_regressions")
+# kube-horizon per-worker disclosure, required from r17 on whenever the
+# record claims an SO_REUSEPORT fleet (apiserver.workers_configured
+# > 1): one row per worker — request share, frame-cache effectiveness,
+# cross-process seed traffic (published / imported / cache hits /
+# ring laps), and CPU seconds — so "N workers scaled" is per-worker
+# evidence, not an aggregate assertion that one hot worker could fake.
+APISERVER_WORKER_FIELDS = ("worker", "pid", "requests",
+                           "request_rate_per_s", "frame_cache_hit_rate",
+                           "cache_seed_published", "cache_seed_imported",
+                           "cache_seed_hits", "cache_seed_ring_drops",
+                           "cpu_s")
+# kube-horizon active sub-mesh evidence, required under solverd.mesh
+# from r17 on: compacted-vs-full wave split, the kept fraction the keep
+# rule bought, host planning cost, and the compacted-vs-full bit-
+# identity probe (parity_divergent MUST read 0 — the compaction is
+# decision-preserving by construction and the probe keeps that claim
+# live, docs/design/batch-solver.md §active-sub-mesh).
+SOLVERD_SUBMESH_FIELDS = ("waves", "full_waves", "nodes_kept",
+                          "nodes_total", "kept_fraction", "compact_p50_ms",
+                          "parity_checks", "parity_divergent")
 
 
 def validate_record(rec: dict, round_no: int = 8) -> list:
@@ -849,6 +1010,44 @@ def validate_record(rec: dict, round_no: int = 8) -> list:
                     f"timeline.series:{len(series)}<{TIMELINE_MIN_SERIES}")
         if not isinstance(rec.get("alarms"), list):
             missing.append("alarms")
+    if round_no >= 17:
+        # r17 introduced kube-horizon: the apiserver section must say
+        # how many workers were configured, and a multi-worker fleet
+        # must disclose every worker's row (a missed scrape shard is a
+        # conformance failure, not a silent absence)
+        ap = rec.get("apiserver")
+        if isinstance(ap, dict) and "error" not in ap:
+            if "workers_configured" not in ap:
+                missing.append("apiserver.workers_configured")
+            elif ap["workers_configured"] > 1:
+                workers = ap.get("workers")
+                if not isinstance(workers, list):
+                    missing.append("apiserver.workers")
+                else:
+                    if len(workers) < ap["workers_configured"]:
+                        missing.append(
+                            f"apiserver.workers:{len(workers)}"
+                            f"<{ap['workers_configured']}")
+                    for i, w in enumerate(workers):
+                        missing += [f"apiserver.workers[{i}].{k}"
+                                    for k in APISERVER_WORKER_FIELDS
+                                    if k not in w]
+        # r17 also introduced the active sub-mesh solve: the mesh
+        # section must disclose the compaction split and the live
+        # parity evidence, and a divergent probe is a contract
+        # violation, not a statistic
+        mesh = (sd or {}).get("mesh") if isinstance(sd, dict) else None
+        if isinstance(mesh, dict) and "error" not in mesh:
+            subm = mesh.get("submesh")
+            if not isinstance(subm, dict):
+                missing.append("solverd.mesh.submesh")
+            else:
+                missing += [f"solverd.mesh.submesh.{k}"
+                            for k in SOLVERD_SUBMESH_FIELDS
+                            if k not in subm]
+                if subm.get("parity_divergent", 0) != 0:
+                    missing.append(
+                        "solverd.mesh.submesh.parity_divergent:nonzero")
     if round_no >= 13:
         # r13 introduced kube-explain: the unschedulable section (reason
         # histogram + explain cost + event-recorder loss disclosure) is
@@ -1478,9 +1677,11 @@ def main(argv=None) -> int:
                     "unprotected baseline — stays bounded. The record "
                     "gains overload + fairshed sections (sheds REQUIRED "
                     "and disclosed; system-flow sheds must be 0) and "
-                    "perfgate isolates the +overload shape. Requires "
-                    "--apiservers 1: backlog accounting is exact only "
-                    "when one worker sees both creates and binds.")
+                    "perfgate isolates the +overload shape. Works at "
+                    "any --apiservers N: a reuseport fleet aggregates "
+                    "its ledger through the kube-share segment "
+                    "(apiserver/share.py), keeping the governor and "
+                    "Retry-After hints exact across workers.")
     ap.add_argument("--fairshed-backlog", "--fairshed_backlog", type=int,
                     default=0,
                     help="pass through to the apiserver(s): shed "
@@ -1806,13 +2007,8 @@ def main(argv=None) -> int:
     if args.fairshed_backlog:
         api_extra += ["--fairshed-backlog", str(args.fairshed_backlog)]
     store_metrics_port = 0
+    share_seg_path = ""
     try:
-        if args.overload and args.apiservers != 1:
-            # the backlog governor's ledger (created - bound) is exact
-            # only when ONE worker serves both creates and binds; a
-            # reuseport fleet splits the signal (the cross-worker drain
-            # feed is tracked as future work in the design doc)
-            raise RuntimeError("--overload requires --apiservers 1")
         # chaos schedules may only name components this topology runs
         valid = {f"apiserver{w}" for w in range(args.apiservers)} \
             | {f"scheduler{w}" for w in range(args.schedulers)} \
@@ -1849,11 +2045,22 @@ def main(argv=None) -> int:
                 store_cmd.append("--flightrec")
             spawn("storeserver", *store_cmd,
                   ready=_tcp_ready(store_port))
+            # kube-share segment (apiserver/share.py): cross-process
+            # frame-cache seeding + the cross-worker fairshed ledger
+            # that keeps the backlog governor exact at N workers
+            from kubernetes_tpu.apiserver.share import ShareSegment
+            share_dir = "/dev/shm" if os.path.isdir("/dev/shm") \
+                else tempfile.gettempdir()
+            share_seg_path = os.path.join(
+                share_dir, f"ktpu-share-{os.getpid()}.seg")
+            ShareSegment.create(share_seg_path, args.apiservers).close()
             for w in range(args.apiservers):
                 spawn(f"apiserver{w}", PY, "-m",
                       "kubernetes_tpu.cmd.apiserver",
                       "--port", str(args.port), "--reuse-port",
                       "--store-server", f"127.0.0.1:{store_port}",
+                      "--share-seg", share_seg_path,
+                      "--share-worker", str(w),
                       *api_extra,
                       ready=_http_ready(f"{master}/healthz/ping"))
         else:
@@ -2514,9 +2721,20 @@ def main(argv=None) -> int:
         }
         # the apiserver hot-path evidence (encode-once fan-out + batch
         # bind): scraped from the live server, plus the live per-bind
-        # cost derived from the scheduler's commit-wave quantiles
+        # cost derived from the scheduler's commit-wave quantiles. A
+        # reuseport fleet is scraped per-worker (identity gauges route
+        # the shards) and merged into fleet-wide counters/quantiles,
+        # with the per-worker disclosure rows riding alongside.
         try:
-            ap = _scrape_apiserver(master)
+            if args.apiservers > 1:
+                worker_raws = _scrape_worker_raws(master, args.apiservers)
+                ap = _parse_apiserver(list(worker_raws.values()))
+                ap["workers"] = _worker_disclosure(
+                    worker_raws, feed_s,
+                    {name: p.pid for name, p in procs})
+            else:
+                ap = _scrape_apiserver(master)
+            ap["workers_configured"] = args.apiservers
         except Exception as e:
             ap = {"error": f"scrape failed: {e}"}
         commit = wave_stats.get("commit") if isinstance(wave_stats, dict) \
@@ -2705,7 +2923,7 @@ def main(argv=None) -> int:
                       f"(must be 0)", file=sys.stderr, flush=True)
         _chaos_record_sections(record)
         flush_flightrec(record)
-        missing = validate_record(record, round_no=16)
+        missing = validate_record(record, round_no=17)
         if missing:
             print(f"[churn-mp] WARNING: record missing contract fields: "
                   f"{missing}", file=sys.stderr, flush=True)
@@ -2738,6 +2956,11 @@ def main(argv=None) -> int:
             for _name, p in procs:
                 if p.poll() is None:
                     p.kill()
+        if share_seg_path:
+            try:
+                os.unlink(share_seg_path)
+            except OSError:
+                pass
 
 
 if __name__ == "__main__":

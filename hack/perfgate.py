@@ -51,6 +51,9 @@ KEYS: Tuple[Tuple[str, str, str, float, bool], ...] = (
     ("frame_cache_hit_rate", "apiserver.frame_cache_hit_rate", "higher",
      0.02, True),
     ("solve_p50_ms", "scheduler_waves.solve.p50_ms", "lower", 0.35, False),
+    # the device-solve leg alone (kube-horizon active sub-mesh): advisory
+    # because it trades against solve_p50_ms's host legs between rounds
+    ("mesh_solve_p50_ms", "solverd.mesh.solve_p50_ms", "lower", 0.35, False),
     ("per_bind_ms_live", "apiserver.per_bind_ms_live", "lower", 0.35, False),
     ("apiserver_cpu_s", "cpu_budget_s.apiserver", "lower", 0.35, False),
     ("e2e_p50_s", "latency.e2e_p50_s", "lower", 0.35, False),
@@ -96,6 +99,13 @@ def shape_key(rec: dict) -> str:
         # include deliberate rescheduling churn the clean series
         # never pays
         suffix += "+fragmentstorm"
+    if isinstance(ap, dict) and (ap.get("workers_configured") or 1) > 1:
+        # kube-horizon SO_REUSEPORT fleets split the apiserver CPU and
+        # cache figures across processes: an N-worker record gates only
+        # against the N-worker series, never baselines the single-worker
+        # one (committed pre-r17 records carry no workers_configured and
+        # keep their suffix-less shape)
+        suffix += f"+workers{ap['workers_configured']}"
     return cfg + suffix
 
 

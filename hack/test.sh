@@ -53,7 +53,8 @@ if [[ "$RACE" == 1 ]]; then
             tests/test_vet.py tests/test_preempt.py
             tests/test_explain.py tests/test_record.py
             tests/test_chaos.py tests/test_fairshed.py
-            tests/test_defrag.py)
+            tests/test_defrag.py tests/test_share.py
+            tests/test_submesh.py)
     rc=0
     for ((i = 1; i <= ROUNDS; i++)); do
         echo "=== race round ${i}/${ROUNDS} (switchinterval=1e-6) ==="
@@ -78,7 +79,7 @@ done
 echo "=== tier-2: solver suites under xla_force_host_platform_device_count=8 ==="
 XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
     python -m pytest tests/test_parallel.py tests/test_solverd.py \
-    tests/test_batch_solver.py -q "$@" || rc=$?
+    tests/test_batch_solver.py tests/test_submesh.py -q "$@" || rc=$?
 
 # perfgate: every committed CHURN_MP record from r08 on must still gate
 # green against its own best prior — the sustained-rate trajectory

@@ -89,8 +89,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "with Retry-After derived from the measured "
                         "bind drain rate — bounds the invisible e2e "
                         "backlog queue under overload. 0 disables. "
-                        "Exact accounting needs one worker serving "
-                        "both creates and binds (see the design doc).")
+                        "Exact at one worker by construction; an "
+                        "SO_REUSEPORT fleet stays exact through the "
+                        "--share-seg cross-worker ledger.")
+    p.add_argument("--share-seg", "--share_seg", default="",
+                   help="path to a kube-share segment file "
+                        "(apiserver/share.py), created by the parent/"
+                        "harness with one block per worker: cross-"
+                        "process frame-cache seeding + the cross-worker "
+                        "fairshed backlog ledger. Empty disables.")
+    p.add_argument("--share-worker", "--share_worker", type=int, default=-1,
+                   help="this worker's block index in --share-seg "
+                        "(0-based; required with --share-seg)")
     p.add_argument("--trace", action="store_true",
                    help="kube-trace: record handler/store spans for "
                         "requests carrying an X-KTPU-Trace header (a "
@@ -157,17 +167,24 @@ def build_server(opts, ready_event: Optional[threading.Event] = None):
     ))
     cors = [o for o in
             getattr(opts, "cors_allowed_origins", "").split(",") if o]
+    share = ledger = None
+    if getattr(opts, "share_seg", ""):
+        from kubernetes_tpu.apiserver.share import ShareSegment, SharedLedger
+        share = ShareSegment(opts.share_seg,
+                             worker_index=getattr(opts, "share_worker", -1))
+        ledger = SharedLedger(share)
     fs = None
     if getattr(opts, "fairshed", True):
         from kubernetes_tpu.apiserver.fairshed import FairShed
-        fs = FairShed(backlog_limit=getattr(opts, "fairshed_backlog", 0))
+        fs = FairShed(backlog_limit=getattr(opts, "fairshed_backlog", 0),
+                      ledger=ledger)
     srv = APIServer(master, host=opts.address, port=opts.port,
                     authenticator=authenticator,
                     kubelet_port=opts.kubelet_port,
                     reuse_port=getattr(opts, "reuse_port", False),
                     cors_allowed_origins=cors,
                     watch_lag_limit=getattr(opts, "watch_lag_limit", 65536),
-                    fairshed=fs)
+                    fairshed=fs, share=share)
     ro_port = getattr(opts, "read_only_port", 0)
     if ro_port:
         # the kubernetes-ro companion (ref: cmd server.go:267-276):

@@ -394,10 +394,11 @@ def _unpack_device(buf: jnp.ndarray, spec) -> tuple:
 
 @functools.partial(jax.jit,
                    static_argnames=("w_lr", "w_spread", "w_equal", "unroll",
-                                    "pol", "gangs"))
+                                    "pol", "gangs", "zone_bf16"))
 def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
               w_equal: int = 0, unroll: int = 1,
-              pol: Optional[BatchPolicy] = None, gangs: bool = False
+              pol: Optional[BatchPolicy] = None, gangs: bool = False,
+              zone_bf16: bool = False
               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Solve one wave. Returns (chosen_node_idx[P] int32 — -1 unschedulable,
     scores[P] int32 — the winning combined score, -1 if unschedulable).
@@ -411,7 +412,16 @@ def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
     failed group never placed — and blocks the run's remaining members.
     Callers then drop the failed runs' earlier tentative choices with
     gang.apply_all_or_nothing. Off by default: the checkpoint copy doubles
-    the carry, so waves without gangs compile the original program."""
+    the carry, so waves without gangs compile the original program.
+
+    ``zone_bf16`` stores the anti-affinity zone scatter basis and the
+    per-step infeasible-peer contraction in bfloat16 instead of float32.
+    Exact — hence still bit-identical to the serial oracle — ONLY under
+    the caller-checked bound that every peer count the contraction can
+    see stays <= 256 (integers through 256 are exact in bf16's 8-bit
+    significand; the f32 accumulator keeps the sums exact). Gated by
+    models/submesh.zone_bf16_ok and proven live by the submesh parity
+    probe; never flipped on the default path."""
     if pol is None:
         pol = BatchPolicy(w_lr=w_lr, w_spread=w_spread, w_equal=w_equal)
     N, R = inp.cap.shape
@@ -440,13 +450,14 @@ def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
     # ---- batched Filter pre-pass (MXU) -----------------------------------
     static_mask = jnp.broadcast_to(inp.node_extra_ok[None, :], (P, N))
     if pol.use_selector:
-        # selector violations: required pairs the node lacks. HIGHEST keeps
-        # the f32 accumulation exact on TPU (default MXU precision rounds
-        # inputs to bf16 — harmless for these 0/1 planes, but pinned so the
-        # decision path never depends on backend default precision).
-        violations = jnp.dot(inp.pod_sel.astype(jnp.float32),
-                             (~inp.node_sel).astype(jnp.float32).T,
-                             precision=jax.lax.Precision.HIGHEST)  # [P, N]
+        # selector violations: required pairs the node lacks. int8 inputs
+        # with an int32 accumulator — integer arithmetic, exact at any
+        # vocabulary width (counts bound by the [S] axis << 2^31), and the
+        # narrowest MXU-native operand dtype: a quarter the f32 plane
+        # bytes the former HIGHEST-precision float path streamed.
+        violations = jnp.dot(inp.pod_sel.astype(jnp.int8),
+                             (~inp.node_sel).astype(jnp.int8).T,
+                             preferred_element_type=jnp.int32)  # [P, N]
         static_mask = static_mask & (violations == 0)
     if pol.use_host:
         host_ok = (inp.pod_host_idx[:, None] == -1) | \
@@ -482,31 +493,59 @@ def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
     if pol.anti_affinity:
         # scan-invariant zone scatter basis, derived on device once per
         # wave (XLA hoists it out of the scan): the wire/encoder ship only
-        # the compact [A, N] index plane
+        # the compact [A, N] index plane. Under the zone_bf16 gate the
+        # basis (0/1 — exact in any float dtype) and the peer-count
+        # operand ride in bf16; the f32 accumulator keeps sums exact.
+        _zdt = jnp.bfloat16 if zone_bf16 else jnp.float32
         zone_onehot = (inp.zone_idx[:, :, None] ==
                        jnp.arange(V, dtype=jnp.int32)[None, None, :]
-                       ).astype(jnp.float32)                 # [A, N, V]
+                       ).astype(_zdt)                        # [A, N, V]
     init = Carry(inp.fit_used, inp.score_used,
                  inp.node_ports, inp.node_pds, inp.group_counts,
                  inp.anchor_vals0, inp.has_anchor0, inp.zone_counts0,
                  inp.evict_cap, inp.evict_cnt)
 
+    # Per-node LeastRequested reciprocal magics, one [N, R] integer-divide
+    # pass per WAVE instead of one per STEP: for d = safe_cap and
+    # M = floor(2^32 / d), floor(x / d) differs from (x * M) >> 32 by at
+    # most one for every 0 <= x <= 10d when d < 2^28 (the error term is
+    # x * (2^32 - M * d) / (d * 2^32) <= 10d / 2^32 < 1), so a single
+    # compare-and-increment fixup recovers the exact quotient with only
+    # vectorizable multiplies — XLA CPU cannot vectorize the integer
+    # divides the scan otherwise pays at [N, R] per step. Applied only to
+    # int32 resource planes, whose encoder contract (cap * 10 fits the
+    # dtype) bounds d under the 2^28 proof bound.
+    lr_magic = bool(pol.w_lr) and rdt == jnp.int32
+    if lr_magic:
+        safe_cap = jnp.where(inp.cap == 0, 1, inp.cap).astype(jnp.int64)
+        cap_magic = (jnp.int64(1) << 32) // safe_cap           # [N, R]
+
     def step(carry: Carry, xs, blocked=None):
         (static_row, req, pod_ports, pod_pds,
-         tie_hi, tie_lo, gid, member, aff_static, prio, can_p) = xs
+         tie_hi, tie_lo, gid, member, aff_static, prio, can_p) = xs[:11]
 
         feasible = static_row
         if blocked is not None:
             # remaining members of an already-failed gang place nowhere
             feasible = feasible & ~blocked
         if pol.use_ports:
-            # Filter: host ports (predicates.go:326-338) — packed-word AND
-            feasible = feasible & \
-                ~jnp.any(carry.ports & pod_ports[None, :] != 0, axis=1)
+            # Filter: host ports (predicates.go:326-338) — packed-word AND,
+            # branched out entirely for the (common) portless pod: ANDing
+            # an all-zero word is the identity, so the taken branch is a
+            # constant all-True row and the [N, Wp] plane never streams
+            feasible = feasible & jax.lax.cond(
+                jnp.any(pod_ports != 0),
+                lambda: ~jnp.any(carry.ports & pod_ports[None, :] != 0,
+                                 axis=1),
+                lambda: jnp.ones(N, bool))
         if pol.use_disk:
-            # Filter: GCE PD exclusivity (predicates.go:68-83)
-            feasible = feasible & \
-                ~jnp.any(carry.pds & pod_pds[None, :] != 0, axis=1)
+            # Filter: GCE PD exclusivity (predicates.go:68-83) — same
+            # zero-word branch as ports
+            feasible = feasible & jax.lax.cond(
+                jnp.any(pod_pds != 0),
+                lambda: ~jnp.any(carry.pds & pod_pds[None, :] != 0,
+                                 axis=1),
+                lambda: jnp.ones(N, bool))
         if pol.has_affinity:
             # anchor-derived constraints (predicates.go:256-276): apply for
             # labels the selector didn't pin, once the group has a peer
@@ -536,7 +575,6 @@ def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
             feasible = feasible & \
                 (zero_req | (~inp.fit_exceeded & res_ok))
 
-        counts_row = carry.counts[jnp.maximum(gid, 0)]         # [N+1]
         score = jnp.zeros(N, jnp.int32)
         if pol.w_lr:
             # Score: LeastRequested (priorities.go:41-75 — all-pods usage),
@@ -544,19 +582,49 @@ def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
             # n_dyn == the reference's (cpu+mem)/2 when only cpu+memory are
             # advertised; dims advertised by no feasible node score 0 on
             # every node, so only the divisor varies with the filter)
-            total = carry.score_used + req[None, :]
             n_dyn = (jnp.asarray(2, rdt) +
                      jnp.sum((adv_extra & feasible[:, None]).any(axis=0)
                              ).astype(rdt))
-            lr = (_calculate_score(total, inp.cap).sum(axis=1)
-                  // n_dyn).astype(jnp.int32)
+            total = carry.score_used + req[None, :]
+            if lr_magic:
+                # magic-multiply twin of _calculate_score (proof at
+                # cap_magic): identical values lane-for-lane — discarded
+                # lanes are pinned to 0 by the same zero/exceeded rule
+                x = jnp.maximum((inp.cap - total) * jnp.asarray(10, rdt),
+                                0).astype(jnp.int64)
+                q = (x * cap_magic) >> 32
+                q = q + (x - (q + 1) * safe_cap >= 0)
+                cs = jnp.where((inp.cap == 0) | (total > inp.cap),
+                               0, q).astype(rdt)
+                raw = cs.sum(axis=1)
+            else:
+                raw = _calculate_score(total, inp.cap).sum(axis=1)
+            if R <= 256:
+                # raw is a sum of R per-dim scores each in [0, 10], so
+                # raw <= 10R and n_dyn <= R: floor(raw / n_dyn) ==
+                # (raw * (2^20 // n_dyn + 1)) >> 20 exactly (magic
+                # error e <= n_dyn needs raw * e < 2^20 — 10R * R fits
+                # for R <= 256, and the product stays under 2^31).
+                # One scalar divide per step instead of an [N] integer-
+                # divide pass, which XLA CPU cannot vectorize
+                magic = jnp.asarray(1 << 20, rdt) // n_dyn + 1
+                lr = ((raw * magic) >> 20).astype(jnp.int32)
+            else:
+                lr = (raw // n_dyn).astype(jnp.int32)
             score = score + lr * pol.w_lr
         if pol.w_spread:
-            # Score: ServiceSpreading (spreading.go:37-86)
-            max_count = jnp.max(counts_row)
-            spread = _spread_score(max_count, counts_row[:N])
-            spread = jnp.where(gid >= 0, spread, jnp.int32(10))
+            # Score: ServiceSpreading (spreading.go:37-86) — branched out
+            # entirely for the serviceless pod, whose score is the
+            # constant 10 on every node (spreading.go:42-44)
+            def _spread_on():
+                counts_row = carry.counts[jnp.maximum(gid, 0)]  # [N+1]
+                return _spread_score(jnp.max(counts_row), counts_row[:N])
+            spread = jax.lax.cond(
+                gid >= 0, _spread_on,
+                lambda: jnp.full((N,), jnp.int32(10)))
             score = score + spread * pol.w_spread
+        if pol.anti_affinity:
+            counts_row = carry.counts[jnp.maximum(gid, 0)]     # [N+1]
         for a, (_label, w) in enumerate(pol.anti_affinity):
             # Score: ServiceAntiAffinity (spreading.go:104-168). The serial
             # path scores over the FILTERED node list, so per-zone counts
@@ -577,12 +645,15 @@ def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
                              carry.zone_counts[a, jnp.maximum(gid, 0)],
                              jnp.int32(0))                          # [V]
             # peers on infeasible labeled nodes, folded per zone: one
-            # [N, V] contraction (HIGHEST: exact for integers < 2^24);
+            # [N, V] contraction (f32: HIGHEST, exact for integers <
+            # 2^24; bf16 under the gated <= 256 peer bound — either way
+            # accumulated in f32, so the fold is exact integer math);
             # unlabeled nodes have an all-zero one-hot row
-            c_inf = (counts_eff[:N] * ~feasible).astype(jnp.float32)
+            c_inf = (counts_eff[:N] * ~feasible).astype(_zdt)
             zc = zrow - jnp.matmul(
                 zone_onehot[a].T, c_inf,
-                precision=jax.lax.Precision.HIGHEST).astype(jnp.int32)
+                precision=jax.lax.Precision.HIGHEST,
+                preferred_element_type=jnp.float32).astype(jnp.int32)
             cnt = jnp.where(labeled, jnp.take(zc, safe_zi),
                             jnp.int32(0))                           # [N]
             s = _spread_score(num, cnt)
@@ -662,8 +733,13 @@ def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
             evicted = jnp.zeros((B,), bool)
             freed_sel = jnp.zeros((R,), rdt)
 
-        # commit: one-hot update of every accumulator at the chosen node
-        onehot = (arange_n == chosen)                # [N] (all-False if -1)
+        # commit: dynamic-row scatter of every accumulator at the chosen
+        # node. The former one-hot mul-add streamed every [N, R]/[N, W]
+        # carry plane through memory per step; the scatter touches ONE
+        # row (exact: the delta is zero off-row, and an unplaced pod
+        # adds an all-zero row at index 0 — integer + 0 is the identity)
+        safe_row = jnp.maximum(chosen, 0)
+        placed = chosen >= 0
         if pol.has_affinity:
             committed = chosen >= 0
             chosen_vals = inp.node_aff_vals[jnp.maximum(chosen, 0)]  # [L]
@@ -689,23 +765,27 @@ def solve_jit(inp: SolverInputs, w_lr: int = 1, w_spread: int = 1,
         # preemption eviction lands with the commit: the chosen node's
         # evicted-band capacity leaves both accumulators and the evictable
         # planes zero out there — later pods see the post-eviction cluster
-        delta = onehot[:, None] * (req[None, :] - freed_sel[None, :])
-        emask = (onehot[:, None] & evicted[None, :])          # [N, B]
+        row_delta = jnp.where(placed, req - freed_sel, jnp.zeros_like(req))
         carry = Carry(
-            fit_used=carry.fit_used + delta,
-            score_used=carry.score_used + delta,
-            ports=carry.ports | jnp.where(onehot[:, None], pod_ports[None, :],
-                                          jnp.uint32(0)),
-            pds=carry.pds | jnp.where(onehot[:, None], pod_pds[None, :],
-                                      jnp.uint32(0)),
-            counts=carry.counts + (member[:, None]
-                                   * jnp.pad(onehot, (0, 1)).astype(jnp.int32)[None, :]),
+            fit_used=carry.fit_used.at[safe_row].add(row_delta),
+            score_used=carry.score_used.at[safe_row].add(row_delta),
+            ports=carry.ports.at[safe_row].set(
+                carry.ports[safe_row]
+                | jnp.where(placed, pod_ports, jnp.uint32(0))),
+            pds=carry.pds.at[safe_row].set(
+                carry.pds[safe_row]
+                | jnp.where(placed, pod_pds, jnp.uint32(0))),
+            counts=carry.counts.at[:, safe_row].add(
+                (member & placed).astype(jnp.int32)),
             anchor_vals=anchor_vals,
             has_anchor=has_anchor,
             zone_counts=zone_counts,
-            evict_cap=jnp.where(emask[:, :, None],
-                                jnp.zeros((), rdt), carry.evict_cap),
-            evict_cnt=jnp.where(emask, jnp.int32(0), carry.evict_cnt),
+            evict_cap=carry.evict_cap.at[safe_row].set(
+                jnp.where(evicted[:, None], jnp.zeros((), rdt),
+                          carry.evict_cap[safe_row])),
+            evict_cnt=carry.evict_cnt.at[safe_row].set(
+                jnp.where(evicted, jnp.int32(0),
+                          carry.evict_cnt[safe_row])),
         )
         return carry, (chosen, win_score)
 

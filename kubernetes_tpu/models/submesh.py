@@ -1,0 +1,289 @@
+"""Active sub-meshing — per-wave node-axis compaction for the dense scan.
+
+The sequential-commit scan (models/batch_solver.solve_jit) does O(N)
+vector work per pod step; at the 50k-pods/10k-nodes contract shape that
+scan is the solve wall (CHURN_MP_r15: mesh solve p50 762 ms/wave on the
+measured single-device layout). But late-churn waves see a cluster where
+most nodes are full: a node that cannot possibly place ANY pod of the
+wave contributes nothing to the answer, only to the per-step arithmetic.
+This module drops those nodes BEFORE the scan and maps the decisions
+back, bit-identically.
+
+**The keep rule.** A node survives compaction iff any of:
+
+- ``pinned``: some pod's ``pod_host_idx`` names it (dropping it would
+  turn a host pin into "anywhere");
+- ``peers``: any group holds a committed peer on it (its counts feed the
+  spread max / anti-affinity zone sums every step — keeping those
+  bookkeeping planes exact is cheaper than re-deriving them);
+- ``possible``: it is statically allowed (``node_extra_ok``), not
+  pre-exceeded (``fit_exceeded``), and its MAXIMUM achievable headroom
+  fits the wave's componentwise-minimum request:
+  ``headmax = cap - fit_used + sum_{b reachable} evict_cap[:, b, :]``
+  (a band is reachable when its priority sits strictly below some real
+  pod's — the most preemption could ever free this wave) and
+  ``all_r (unconstrained[n, r] or headmax[n, r] >= minreq[r])`` with
+  ``minreq`` the per-dimension min over REAL pods (padding rows,
+  ``pod_host_idx == -2``, excluded).
+
+**Why dropped nodes are decision-invisible** (the bit-identity argument,
+mirrored in docs/design/batch-solver.md):
+
+- during the scan, ``fit_used[n]`` can only fall below its initial value
+  by preemption commits, which free at most the node's evictable
+  capacity in reachable bands (a threshold is always strictly below the
+  preemptor's priority) — so per-step headroom never exceeds
+  ``headmax``. A node
+  failing ``headmax >= minreq`` on a constrained dimension fails the
+  resource predicate for EVERY pod at EVERY step, on both the normal and
+  the preemption branch (whose freed capacity is a subset of the same
+  total). With ``fit_exceeded`` and ``node_extra_ok`` static, a dropped
+  node is infeasible and un-preemptable for the whole wave;
+- infeasible nodes influence nothing global: they are NEG-masked out of
+  ``masked_top_count`` (so the tie-break count ``cnt`` ignores them),
+  excluded from the LeastRequested divisor (``adv_extra & feasible``),
+  and — because dropped nodes hold no group peers — contribute zero to
+  the spread max/num and the per-zone peer totals, and their zone rows
+  subtract nothing in the anti-affinity infeasible-peer correction;
+- compaction preserves node list order, so ``select_kth_true`` picks the
+  same surviving node for the same ``k``.
+
+Two shapes invalidate the rule and force the full solve: a REAL pod
+requesting zero of everything (the ``zero_req`` branch makes resources
+moot), and a policy without the resource predicate (``use_resources``
+False). Both return ``keep=None``.
+
+**Residency-preserving gather.** The daemon's device-resident planes
+stay [N]-shaped; compaction is a gather ON DEVICE (``compact_inputs``,
+inside the jitted program) driven by a tiny host-computed
+``keep_idx [Ncb] int32`` + ``valid [Ncb] bool`` pair — the identity
+chain and the delta scatter path in solver/mesh_exec.py are untouched.
+``Ncb`` is the kept count padded to a two-buckets-per-octave size
+(``padded_size``) so the per-shape compile count stays O(log N); pad
+rows gather node 0 but are forced infeasible (``node_extra_ok &=
+valid``) and zeroed out of every global aggregate (counts, zone labels,
+advertised dims). Engagement requires the padded size to clear the
+``KEEP_ENGAGE`` fraction of N — a marginal compaction is not worth a
+second compiled program.
+
+``KTPU_SUBMESH``: ``auto`` (default — engage per the rule above),
+``off`` (never compact), ``force`` (compact whenever any node is
+droppable, ignoring the engage threshold; tests and A/B runs).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import numpy as np
+
+from kubernetes_tpu.models.batch_solver import SolverInputs, solve_jit
+
+__all__ = ["keep_mask", "padded_size", "plan_wave", "compact_inputs",
+           "submesh_program", "remap_pod_host_idx", "SubmeshPlan",
+           "KEEP_ENGAGE"]
+
+# A compaction must shrink the padded node axis below this fraction of
+# the resident N to engage; above it the full program is already
+# compiled and the marginal per-step saving does not buy a new compile.
+KEEP_ENGAGE = 0.75
+
+
+def submesh_mode() -> str:
+    mode = os.environ.get("KTPU_SUBMESH", "auto").strip().lower()
+    if mode not in ("auto", "off", "force"):
+        raise ValueError(
+            f"KTPU_SUBMESH={mode!r}: expected auto|off|force")
+    return mode
+
+
+def keep_mask(inp: SolverInputs, pol=None) -> Optional[np.ndarray]:
+    """bool [N] keep mask, or None when the rule cannot apply (zero-req
+    real pod, resource predicate disabled, or no real pods). Host-side
+    numpy over the reconstructed wave — O(N*R + P*R)."""
+    if pol is not None and not pol.use_resources:
+        return None  # feasibility never consults resources: rule invalid
+    ph = np.asarray(inp.pod_host_idx)
+    real = ph != -2
+    if pol is not None and not pol.use_host and not real.all():
+        # pod-axis padding rows are "never feasible" only through the
+        # HostName predicate (pinned to host -2); without it a zero-req
+        # padding row schedules somewhere — possibly a dropped node —
+        # and the chosen/scores planes would differ from the full solve
+        return None
+    if not real.any():
+        return None
+    req = np.asarray(inp.req)
+    rreq = req[real]
+    if (rreq == 0).all(axis=1).any():
+        # a zero-request pod fits every non-exceeded allowed node
+        # regardless of headroom — the resource test is moot for it
+        return None
+    minreq = rreq.min(axis=0)                                 # [R]
+    cap = np.asarray(inp.cap)
+    N, R = cap.shape
+    headmax = cap - np.asarray(inp.fit_used)
+    evict_cap = np.asarray(inp.evict_cap)
+    if evict_cap.size:
+        # only bands strictly below SOME pod's priority can ever evict
+        # (models/preempt.py threshold rule); the max real priority
+        # bounds every pod's reach, and BAND_EMPTY slots sit above every
+        # legal priority so they fall out automatically
+        maxprio = np.asarray(inp.pod_prio)[real].max()
+        reachable = np.asarray(inp.band_prio) < maxprio       # [B]
+        headmax = headmax + (evict_cap
+                             * reachable[None, :, None]).sum(axis=1)
+    unconstrained = (cap == 0) & (np.arange(R) < 2)[None, :]
+    res_ok = (unconstrained | (headmax >= minreq[None, :])).all(axis=1)
+    possible = (np.asarray(inp.node_extra_ok)
+                & ~np.asarray(inp.fit_exceeded) & res_ok)
+    pinned = np.zeros(N, bool)
+    targets = ph[real]
+    targets = targets[(targets >= 0) & (targets < N)]
+    pinned[targets] = True
+    peers = np.asarray(inp.group_counts)[:, :N].any(axis=0)
+    return possible | pinned | peers
+
+
+def padded_size(nc: int) -> int:
+    """Two size buckets per octave (2^k and 3*2^(k-1)), floored at 256
+    so tiny kept-sets don't fan out compiles."""
+    if nc <= 256:
+        return 256
+    k = (nc - 1).bit_length()
+    p15 = 3 << (k - 2)
+    return p15 if p15 >= nc else 1 << k
+
+
+class SubmeshPlan:
+    """One wave's compaction decision: the padded keep indices + valid
+    mask to ship, and the inverse map for pod pins."""
+
+    __slots__ = ("keep_idx", "valid", "inv", "n_kept", "n_total")
+
+    def __init__(self, keep_idx: np.ndarray, valid: np.ndarray,
+                 inv: np.ndarray, n_kept: int, n_total: int):
+        self.keep_idx = keep_idx   # [Ncb] i32 original node indices
+        self.valid = valid         # [Ncb] bool (False = pad row)
+        self.inv = inv             # [N] i32 original -> compact (-1 gone)
+        self.n_kept = n_kept
+        self.n_total = n_total
+
+
+def plan_wave(inp: SolverInputs, pol=None,
+              mode: Optional[str] = None) -> Optional[SubmeshPlan]:
+    """Decide compaction for one wave -> SubmeshPlan, or None for the
+    full solve."""
+    mode = submesh_mode() if mode is None else mode
+    if mode == "off":
+        return None
+    keep = keep_mask(inp, pol)
+    if keep is None:
+        return None
+    n = keep.shape[0]
+    nc = int(keep.sum())
+    if nc == n:
+        return None
+    ncb = padded_size(nc)
+    if ncb >= n or (mode != "force" and ncb > KEEP_ENGAGE * n):
+        return None
+    kept = np.flatnonzero(keep).astype(np.int32)              # sorted
+    keep_idx = np.zeros(ncb, np.int32)
+    keep_idx[:nc] = kept
+    valid = np.zeros(ncb, bool)
+    valid[:nc] = True
+    inv = np.full(n, -1, np.int32)
+    inv[kept] = np.arange(nc, dtype=np.int32)
+    return SubmeshPlan(keep_idx, valid, inv, nc, n)
+
+
+def remap_pod_host_idx(pod_host_idx: np.ndarray,
+                       plan: SubmeshPlan) -> np.ndarray:
+    """Pod host pins in original node indices -> compact indices.
+    Sentinels (-1 unpinned, -2 padding) pass through; pinned nodes are
+    kept by construction, so the map never loses a pin."""
+    ph = np.asarray(pod_host_idx)
+    out = np.where(ph >= 0, plan.inv[np.maximum(ph, 0)], ph)
+    return out.astype(ph.dtype)
+
+
+def compact_inputs(inp: SolverInputs, keep_idx, valid) -> SolverInputs:
+    """Gather the node-axis planes down to the compact axis — traced
+    jnp, runs inside the jitted submesh program on device. Pad rows
+    (valid False) duplicate node 0's planes but are forced infeasible
+    and zeroed out of every globally-aggregated plane (group counts,
+    zone labels, advertised dims); ``pod_host_idx`` arrives already
+    remapped (remap_pod_host_idx, host-side)."""
+    import jax.numpy as jnp
+
+    def g(a):
+        return jnp.take(a, keep_idx, axis=0)
+
+    gc = jnp.take(inp.group_counts[:, :-1], keep_idx, axis=1)
+    gc = jnp.where(valid[None, :], gc, 0)
+    # the off-list slot stays the LAST column at the compact width
+    gc = jnp.concatenate([gc, inp.group_counts[:, -1:]], axis=1)
+    zi = jnp.take(inp.zone_idx, keep_idx, axis=1)
+    zi = jnp.where(valid[None, :], zi, -1)
+    return inp._replace(
+        cap=g(inp.cap),
+        advertises=g(inp.advertises) & valid[:, None],
+        fit_used=g(inp.fit_used),
+        fit_exceeded=g(inp.fit_exceeded) | ~valid,
+        score_used=g(inp.score_used),
+        node_ports=g(inp.node_ports),
+        node_sel=g(inp.node_sel),
+        node_pds=g(inp.node_pds),
+        node_extra_ok=g(inp.node_extra_ok) & valid,
+        group_counts=gc,
+        score_static=g(inp.score_static),
+        node_aff_vals=g(inp.node_aff_vals),
+        zone_idx=zi,
+        evict_cap=g(inp.evict_cap),
+        evict_cnt=g(inp.evict_cnt),
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def submesh_program(pol, gangs: bool, zone_bf16: bool = False):
+    """One jitted gather-compact-solve-remap program family per
+    (policy, gangs, zone precision); XLA's shape cache handles the
+    two-per-octave Ncb buckets. Signature mirrors
+    parallel.mesh.sharded_program — ``fn(resident, wave, keep_idx,
+    valid) -> (chosen, scores)`` with decisions already mapped back to
+    ORIGINAL node indices, so callers (and parity probes) compare
+    directly against the full-plane answer."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubernetes_tpu.parallel.mesh import RESIDENT_FIELDS, WAVE_FIELDS
+
+    def run(resident, wave, keep_idx, valid):
+        kw = dict(zip(RESIDENT_FIELDS, resident))
+        kw.update(zip(WAVE_FIELDS, wave))
+        comp = compact_inputs(SolverInputs(**kw), keep_idx, valid)
+        chosen, scores = solve_jit(comp, pol=pol, gangs=gangs,
+                                   zone_bf16=zone_bf16)
+        chosen = jnp.where(chosen >= 0,
+                           jnp.take(keep_idx, jnp.maximum(chosen, 0)),
+                           chosen)
+        return chosen, scores
+
+    return jax.jit(run)
+
+
+def zone_bf16_ok(inp: SolverInputs, pol) -> bool:
+    """Gate for the reduced-precision (bf16) anti-affinity zone planes:
+    every value the contraction sums is an integer peer count bounded by
+    the initial per-group peer total PLUS the wave's pod count (every
+    commit can add one peer). Integers through 256 are exact in bf16
+    (8-bit significand), so under this bound the bf16 program is
+    bit-identical to the f32-HIGHEST one — proven live by the submesh
+    parity probe, not assumed."""
+    if pol is None or not getattr(pol, "anti_affinity", ()):
+        return False
+    gc = np.asarray(inp.group_counts)
+    bound = int(gc.sum(axis=1).max()) if gc.size else 0
+    return bound + int(inp.req.shape[0]) <= 256

@@ -173,7 +173,9 @@ class MeshExecutor:
         self._cal: Dict[str, dict] = {}
         self._cal_lock = threading.Lock()
         self._probed_once = False
+        self._submesh_probed = False
         self._m = metrics.solverd_mesh_metrics()
+        self._sm = metrics.solverd_submesh_metrics()
         self._m.devices.set(jax.device_count())
         self._m.pods_axis.set(pods_axis)
         self._load_cal()
@@ -181,6 +183,8 @@ class MeshExecutor:
         self.mesh_waves = 0
         self.parity_checks = 0
         self.parity_divergent = 0
+        self.submesh_waves = 0
+        self.submesh_parity_divergent = 0
 
     # -- calibration persistence (warm start, keyed by mesh shape) ---------
     def _load_cal(self) -> None:
@@ -429,9 +433,37 @@ class MeshExecutor:
             self._m.transfer_bytes.inc(by=transfer)
             self._m.reshard_bytes.inc(by=reshard)
             return probed
+        # kube-horizon active sub-mesh (models/submesh.py): on the
+        # single-device layout — the measured winner at the contract
+        # shape (r15: node_shards 1) — compact the node axis to the
+        # nodes that could possibly place this wave before the dense
+        # scan. Bit-identical by the keep-rule argument in the module
+        # docstring, and probed live against the full plane below. The
+        # gather runs ON DEVICE over the same resident planes, so
+        # residency and the delta identity chain are untouched.
+        plan = None
+        zone_bf16 = False
+        if int(mesh.shape["nodes"]) == 1:
+            from kubernetes_tpu.models import submesh as sm
+            t_k0 = time.perf_counter()
+            plan = sm.plan_wave(inp, pol)
+            if plan is not None:
+                self._sm.compact_s.observe(time.perf_counter() - t_k0)
+                self._sm.waves.inc()
+                self._sm.nodes_kept.inc(by=plan.n_kept)
+                self._sm.nodes_total.inc(by=plan.n_total)
+                self.submesh_waves += 1
+                zone_bf16 = sm.zone_bf16_ok(inp, pol)
+            else:
+                self._sm.full_waves.inc()
         wave_dev = []
         for name in pm.WAVE_FIELDS:
             arr = getattr(inp, name)
+            if plan is not None and name == "pod_host_idx":
+                # host pins move to compact indices host-side (pinned
+                # nodes are kept by construction, so no pin is lost)
+                from kubernetes_tpu.models import submesh as sm
+                arr = sm.remap_pod_host_idx(arr, plan)
             wave_dev.append(jax.device_put(np.ascontiguousarray(arr),
                                            getattr(sh, name)))
             transfer += arr.nbytes
@@ -447,23 +479,57 @@ class MeshExecutor:
         # allocator and corrupts the native heap (the malloc() abort that
         # killed the daemon mid-churn until flightrec pinned the timing).
         # The wave planes are [P]-scale; forgoing their reuse costs ~KBs.
-        fn = pm.sharded_program(mesh, pol, gangs, donate=False)
-        with _donation_warnings_scoped():
-            chosen, scores = fn(tuple(resident_dev), tuple(wave_dev))
+        if plan is not None:
+            from kubernetes_tpu.models import submesh as sm
+            fn = sm.submesh_program(pol, gangs, zone_bf16)
+            chosen, scores = fn(tuple(resident_dev), tuple(wave_dev),
+                                plan.keep_idx, plan.valid)
             both = np.asarray(jnp.stack([chosen, scores]))
+        else:
+            fn = pm.sharded_program(mesh, pol, gangs, donate=False)
+            with _donation_warnings_scoped():
+                chosen, scores = fn(tuple(resident_dev), tuple(wave_dev))
+                both = np.asarray(jnp.stack([chosen, scores]))
         if tctx is not None:
             tracing.record("mesh.device_solve", t_dv0, time.monotonic_ns(),
                            parent=tctx,
-                           node_shards=int(mesh.shape["nodes"]))
+                           node_shards=int(mesh.shape["nodes"]),
+                           submesh=plan.n_kept if plan is not None else 0)
         self._m.transfer_bytes.inc(by=transfer)
         self._m.reshard_bytes.inc(by=reshard)
         self._m.solve_s.observe(time.perf_counter() - t_wave)
         out = (both[0], both[1])
+        if plan is not None and (self.probe == "all"
+                                 or not self._submesh_probed):
+            self._submesh_probed = True
+            self._submesh_parity_probe(inp, pol, gangs, mesh, out)
         if self.probe == "all" or (self.probe == "first"
                                    and not self._probed_once):
             self._probed_once = True
             self._parity_probe(inp, pol, gangs, mesh, out)
         return out
+
+    def _submesh_parity_probe(self, inp, pol, gangs, mesh, out) -> None:
+        """Re-solve a compacted wave on the FULL node plane (same mesh,
+        no compaction) and compare bitwise — the live evidence that the
+        keep rule, the index remap, and any gated precision downgrade
+        (zone_bf16) changed the layout and nothing else. Runs on the
+        first submesh wave of a run, every wave under probe='all';
+        never under probe='off'."""
+        if self.probe == "off":
+            return
+        try:
+            res, _t = self._time_layout(mesh, inp, pol, gangs)
+        except Exception as e:  # noqa: BLE001 — a probe must never kill a wave
+            _log.warning("submesh parity probe failed to run: %s", e)
+            return
+        self._sm.parity_checks.inc()
+        if not (np.array_equal(res[0], out[0])
+                and np.array_equal(res[1], out[1])):
+            self.submesh_parity_divergent += 1
+            self._sm.parity_divergent.inc()
+            _log.error("submesh parity probe DIVERGED: compacted vs full "
+                       "plane — keep rule or remap violated bit-identity")
 
     def _parity_probe(self, inp, pol, gangs, active_mesh, out) -> None:
         """Re-solve the same wave in the OTHER layout (single-device

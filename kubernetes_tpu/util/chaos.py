@@ -35,9 +35,10 @@ import time
 from typing import Dict, Optional
 
 __all__ = ["SimulatedCrash", "inject_crash", "inject_error",
-           "inject_delay", "inject_flag", "crash_if_armed",
-           "error_if_armed", "delay_if_armed", "take_flag", "armed",
-           "clear", "parse_duration"]
+           "inject_delay", "inject_flag", "inject_gate", "release_gate",
+           "crash_if_armed", "error_if_armed", "delay_if_armed",
+           "take_flag", "gate_if_armed", "armed", "clear",
+           "parse_duration"]
 
 
 _DURATION_RE = re.compile(r"^\s*(\d+(?:\.\d+)?)\s*(us|ms|s|m)?\s*$")
@@ -107,6 +108,24 @@ def inject_flag(point: str, skip: int = 0, times: int = 1) -> None:
     _arm(point, "flag", skip, times)
 
 
+def inject_gate(point: str) -> None:
+    """Arm ``point`` as a blocking gate: the next thread reaching it
+    parks until ``release_gate(point)`` (or ``clear()``) — the
+    deterministic replacement for "hope the reader is slow enough". A
+    gated watch writer, for example, stops draining its fan-out queue so
+    the producer-side lag machinery (coalesce / drop-to-resync) fires on
+    exact queue depth instead of on kernel socket-buffer luck."""
+    _arm(point, "gate", 0, 1, payload=threading.Event())
+
+
+def release_gate(point: str) -> None:
+    """Open an armed gate; no-op if nothing (or a non-gate) is armed."""
+    with _lock:
+        a = _arms.pop(point, None)
+    if a is not None and a.kind == "gate":
+        a.payload.set()
+
+
 def _take(point: str, kind: str) -> Optional[_Arm]:
     """Consume one action at ``point`` if an arm of ``kind`` is due."""
     with _lock:
@@ -157,6 +176,21 @@ def take_flag(point: str) -> bool:
     return _take(point, "flag") is not None
 
 
+def gate_if_armed(point: str, timeout: float = 30.0) -> None:
+    """Park on an armed gate until released. The wait happens outside
+    the registry lock (release/clear must be able to run), with a safety
+    timeout so a test that forgets to release cannot hang a suite."""
+    if not _arms:
+        return
+    with _lock:
+        a = _arms.get(point)
+        if a is None or a.kind != "gate":
+            return
+        a.hits += 1
+        ev = a.payload
+    ev.wait(timeout)
+
+
 def armed(point: str) -> Optional[dict]:
     """Introspection for tests: {'kind', 'skip', 'times', 'hits'} or
     None."""
@@ -170,4 +204,7 @@ def armed(point: str) -> Optional[dict]:
 
 def clear() -> None:
     with _lock:
+        for a in _arms.values():
+            if a.kind == "gate":
+                a.payload.set()  # wake parked seams before forgetting them
         _arms.clear()

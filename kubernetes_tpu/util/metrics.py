@@ -18,12 +18,14 @@ __all__ = ["Counter", "Gauge", "Histogram", "Registry", "default_registry",
            "DEFAULT_BUCKETS", "APISERVER_BUCKETS", "POD_E2E_BUCKETS",
            "SolverdDeltaMetrics", "solverd_delta_metrics",
            "SolverdMeshMetrics", "solverd_mesh_metrics",
+           "SolverdSubmeshMetrics", "solverd_submesh_metrics",
            "PodLatencyMetrics", "pod_latency_metrics",
            "ExplainMetrics", "explain_metrics",
            "EventRecorderMetrics", "event_recorder_metrics",
            "StoreWalMetrics", "store_wal_metrics",
            "ChaosMetrics", "chaos_metrics",
            "FairshedMetrics", "fairshed_metrics",
+           "FairshedLedgerMetrics", "fairshed_ledger_metrics",
            "FlightRecorder", "flightrec_arm", "flightrec_disarm",
            "flightrec_armed", "flightrec_watch", "flightrec_vars",
            "flightrec_sample_now", "flightrec"]
@@ -398,6 +400,52 @@ def solverd_mesh_metrics() -> SolverdMeshMetrics:
     return SolverdMeshMetrics._singleton
 
 
+class SolverdSubmeshMetrics:
+    """The ``solverd_submesh_*`` family — active sub-meshing
+    (models/submesh.py): per-wave compaction of the node axis to the
+    nodes that can possibly place the wave, before the dense scan. The
+    kept/total counters disclose how much of the mesh each wave really
+    touched; the parity counters keep the submesh-vs-full bit-identity
+    evidence live in every run (divergence must stay 0 — the compaction
+    is decision-preserving by construction, and the probe checks it)."""
+
+    _singleton = None
+
+    def __init__(self, registry: Optional[Registry] = None):
+        reg = registry or default_registry()
+        self.waves = reg.counter(
+            "solverd_submesh_waves_total",
+            "Waves solved on a compacted node axis (vs full-plane)")
+        self.full_waves = reg.counter(
+            "solverd_submesh_full_waves_total",
+            "Waves where compaction was skipped (kept fraction past the "
+            "engage threshold, zero-req pods, or KTPU_SUBMESH=off)")
+        self.nodes_kept = reg.counter(
+            "solverd_submesh_nodes_kept_total",
+            "Nodes surviving the keep mask, summed over submesh waves")
+        self.nodes_total = reg.counter(
+            "solverd_submesh_nodes_total",
+            "Candidate nodes before compaction, summed over submesh waves")
+        self.parity_checks = reg.counter(
+            "solverd_submesh_parity_checks_total",
+            "Submesh waves re-solved on the full plane and compared "
+            "decision-for-decision")
+        self.parity_divergent = reg.counter(
+            "solverd_submesh_parity_divergent_total",
+            "Submesh parity probes whose decisions diverged (must stay 0)")
+        self.compact_s = reg.histogram(
+            "solverd_submesh_compact_seconds",
+            "Host-side keep-mask + plane-gather time per submesh wave",
+            buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                     0.25, 0.5))
+
+
+def solverd_submesh_metrics() -> SolverdSubmeshMetrics:
+    if SolverdSubmeshMetrics._singleton is None:
+        SolverdSubmeshMetrics._singleton = SolverdSubmeshMetrics()
+    return SolverdSubmeshMetrics._singleton
+
+
 class PodLatencyMetrics:
     """Pod-lifecycle latency — the causal, per-pod view of where the
     1000/s contract's latency goes (docs/design/observability.md).
@@ -765,6 +813,43 @@ def fairshed_metrics() -> FairshedMetrics:
     if FairshedMetrics._singleton is None:
         FairshedMetrics._singleton = FairshedMetrics()
     return FairshedMetrics._singleton
+
+
+class FairshedLedgerMetrics:
+    """The ``fairshed_ledger_*`` family — the cross-worker drain feed
+    (apiserver/share.SharedLedger): this worker's contributions to the
+    shared created/bound/deleted counters plus the GLOBAL backlog the
+    governor actually gates on. Only registered on servers wired with a
+    share segment; single-worker servers keep the local
+    ``fairshed_backlog_depth`` ledger alone."""
+
+    _singleton = None
+
+    def __init__(self, registry: Optional[Registry] = None):
+        reg = registry or default_registry()
+        self.creates = reg.counter(
+            "fairshed_ledger_creates_total",
+            "Pod creates this worker published into the shared ledger")
+        self.binds = reg.counter(
+            "fairshed_ledger_binds_total",
+            "Pod binds this worker published into the shared ledger")
+        self.deletes = reg.counter(
+            "fairshed_ledger_deletes_total",
+            "Pending-pod deletes this worker published into the shared "
+            "ledger (bound-pod deletes are clamped out, as locally)")
+        self.backlog = reg.gauge(
+            "fairshed_ledger_backlog_depth",
+            "GLOBAL workload backlog (sum of created minus bound minus "
+            "pending-deleted across every worker's ledger block)")
+        self.workers = reg.gauge(
+            "fairshed_ledger_workers",
+            "Worker blocks in the attached share segment")
+
+
+def fairshed_ledger_metrics() -> FairshedLedgerMetrics:
+    if FairshedLedgerMetrics._singleton is None:
+        FairshedLedgerMetrics._singleton = FairshedLedgerMetrics()
+    return FairshedLedgerMetrics._singleton
 
 
 # -- kube-flightrec: continuous in-process metric time-series ---------------

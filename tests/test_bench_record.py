@@ -281,6 +281,62 @@ def test_churn_record_schema_unschedulable_section_gated_by_round():
     assert "unschedulable.events_dropped" in missing
 
 
+def _r16_complete_record(churn_mp):
+    rec = _churn_sample_record()
+    rec["solverd"]["mesh"] = {k: 1 for k in churn_mp.SOLVERD_MESH_FIELDS}
+    rec["latency"] = {k: 1 for k in churn_mp.LATENCY_FIELDS}
+    rec["timeline"] = {"sample_period_s": 1.0,
+                       "series": {f"slo:rule{i}": [[0.0, 1.0]]
+                                  for i in range(6)},
+                       "headline": [f"slo:rule{i}" for i in range(6)]}
+    rec["alarms"] = []
+    rec["unschedulable"] = {k: 0 for k in churn_mp.UNSCHEDULABLE_FIELDS}
+    return rec
+
+
+def test_churn_record_schema_horizon_sections_gated_by_round():
+    """r16 records predate kube-horizon; r17+ must disclose the
+    apiserver worker topology (workers_configured, and a full per-worker
+    row set when > 1 — a missed scrape shard is non-conformance, not
+    silence) and the active sub-mesh evidence under solverd.mesh
+    (compaction split + live parity probe; a divergent probe is a
+    contract violation)."""
+    churn_mp = _load_churn_mp()
+    rec = _r16_complete_record(churn_mp)
+    assert churn_mp.validate_record(rec, round_no=16) == []
+    missing = churn_mp.validate_record(rec, round_no=17)
+    assert "apiserver.workers_configured" in missing
+    assert "solverd.mesh.submesh" in missing
+    rec["apiserver"]["workers_configured"] = 1
+    rec["solverd"]["mesh"]["submesh"] = {
+        "waves": 40, "full_waves": 10, "nodes_kept": 80_000,
+        "nodes_total": 400_000, "kept_fraction": 0.2,
+        "compact_p50_ms": 5.0, "parity_checks": 1, "parity_divergent": 0,
+    }
+    assert churn_mp.validate_record(rec, round_no=17) == []
+    # a single-worker record needs no per-worker rows; a fleet does
+    rec["apiserver"]["workers_configured"] = 4
+    assert "apiserver.workers" in churn_mp.validate_record(rec,
+                                                           round_no=17)
+    rows = [{k: i for k in churn_mp.APISERVER_WORKER_FIELDS}
+            for i in range(4)]
+    rec["apiserver"]["workers"] = rows
+    assert churn_mp.validate_record(rec, round_no=17) == []
+    rec["apiserver"]["workers"] = rows[:3]
+    assert "apiserver.workers:3<4" in churn_mp.validate_record(
+        rec, round_no=17)
+    rec["apiserver"]["workers"] = rows
+    del rows[2]["cache_seed_ring_drops"]
+    assert "apiserver.workers[2].cache_seed_ring_drops" in \
+        churn_mp.validate_record(rec, round_no=17)
+    rows[2]["cache_seed_ring_drops"] = 0
+    # the compaction's bit-identity claim is live evidence: a divergent
+    # parity probe makes the whole record non-conformant
+    rec["solverd"]["mesh"]["submesh"]["parity_divergent"] = 1
+    assert "solverd.mesh.submesh.parity_divergent:nonzero" in \
+        churn_mp.validate_record(rec, round_no=17)
+
+
 def test_committed_churn_records_conform():
     """Every committed CHURN_MP record from r07 on must satisfy the
     schema (r08+ additionally the apiserver hot-path fields) — the

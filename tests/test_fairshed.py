@@ -242,8 +242,8 @@ class TestFairShed:
 
 
 class TestFairshedHTTP:
-    def _server(self, **fs_kw):
-        flows = {
+    def _server(self, flows=None, **fs_kw):
+        flows = flows or {
             fairshed.WORKLOAD: fairshed.FlowConfig(1, 0, 0.05),
             fairshed.SYSTEM: fairshed.FlowConfig(8, 16, 1.0),
             fairshed.BEST_EFFORT: fairshed.FlowConfig(2, 2, 0.2),
@@ -324,19 +324,50 @@ class TestFairshedHTTP:
                 s.sendall(b"GET /api/v1/pods?watch=1 HTTP/1.1\r\n"
                           b"Host: a\r\n\r\n")
                 socks.append(s)
-            time.sleep(0.2)
+            # reader-driven sync: response headers are written immediately
+            # before the ticket release, so once both header blocks have
+            # arrived the release is at most one statement away — poll the
+            # snapshot with a deadline instead of guessing a sleep
+            for s in socks:
+                f = s.makefile("rb")
+                while True:
+                    line = f.readline()
+                    assert line, "watch stream closed before headers"
+                    if line == b"\r\n":
+                        break
+            deadline = time.monotonic() + 5.0
+            while fs.snapshot()["best-effort"]["inflight"] != 0:
+                assert time.monotonic() < deadline, \
+                    "watch streams never released their admission slots"
+                time.sleep(0.01)
             # ... must not pin inflight: a plain best-effort read still
             # admits because the stream released its slot at setup
             assert urllib.request.urlopen(
                 srv.base_url + "/api/v1/pods", timeout=5).status == 200
-            assert fs.snapshot()["best-effort"]["inflight"] == 0
+            # the read's own ticket releases after its reply bytes go
+            # out, so poll back down to zero rather than racing it
+            deadline = time.monotonic() + 5.0
+            while fs.snapshot()["best-effort"]["inflight"] != 0:
+                assert time.monotonic() < deadline, \
+                    "best-effort inflight never drained back to zero"
+                time.sleep(0.01)
             for s in socks:
                 s.close()
         finally:
             srv.stop()
 
     def test_backlog_governor_end_to_end(self):
-        srv, fs = self._server(backlog_limit=2)
+        # roomy workload flow: the governor check precedes slot/queue
+        # admission, so the intended 429 still fires — but a sequential
+        # client's next POST racing the PREVIOUS response's slot release
+        # (released after the reply bytes go out) can't flake as a
+        # queue_full shed the way the 1-slot/0-queue config could
+        flows = {
+            fairshed.WORKLOAD: fairshed.FlowConfig(4, 8, 1.0),
+            fairshed.SYSTEM: fairshed.FlowConfig(8, 16, 1.0),
+            fairshed.BEST_EFFORT: fairshed.FlowConfig(2, 2, 0.2),
+        }
+        srv, fs = self._server(flows=flows, backlog_limit=2)
         try:
             for i in range(2):
                 req = urllib.request.Request(

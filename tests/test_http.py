@@ -708,14 +708,6 @@ class _RawWatch:
             pass
 
 
-def _big_pod(name, payload_kb=20):
-    """A pod whose wire frame is big enough that a stalled watcher's
-    kernel socket buffers fill after a handful of frames."""
-    p = make_pod(name)
-    p.spec.containers[0].image = "img-" + "x" * (payload_kb * 1024)
-    return p
-
-
 class TestWatchFanout:
     def test_n_watchers_identical_byte_frames_in_order(self, client, server):
         watchers = [_RawWatch(server.port) for _ in range(4)]
@@ -746,33 +738,37 @@ class TestWatchFanout:
         assert hits >= 3 * max(misses, 1)
 
     def test_slow_watcher_drops_to_resync_fast_watcher_unaffected(self):
-        import threading
+        from kubernetes_tpu.util import chaos
+        from kubernetes_tpu.util import metrics as metrics_pkg
 
         srv = APIServer(Master(MasterConfig()), watch_lag_limit=8).start()
         client = Client(HTTPTransport(srv.base_url))
+        resyncs0 = metrics_pkg.default_registry().counter(
+            "watch_lag_resyncs_total").total()
+        slow = fast = None
         try:
-            slow = _RawWatch(srv.port)      # connected, never reads
+            # the "slow" watcher is deterministically slow: its writer
+            # parks on a chaos gate before draining, so its producer-side
+            # queue grows on exact depth instead of kernel-buffer luck
+            chaos.inject_gate("apiserver.watch.write.lagger")
+            slow = _RawWatch(
+                srv.port, path="/api/v1/pods?watch=1&chaosGate=lagger")
             fast = _RawWatch(srv.port)
-            fast_frames = []
-
-            def drain_fast():
-                while True:
-                    f = fast.read_frame(timeout=30)
-                    if f is None:
-                        return
-                    fast_frames.append(f)
-                    if len(fast_frames) >= 40:
-                        return
-
-            t = threading.Thread(target=drain_fast, daemon=True)
-            t.start()
             # distinct keys -> uncoalescible ADDEDs: once the slow
-            # watcher's socket backs up and its queue passes the bound,
-            # it must drop to resync instead of queueing without bound
+            # watcher's queue passes the bound, it must drop to resync
+            # instead of queueing without bound. Watcher.send runs
+            # synchronously in the create path, so by the time these
+            # requests return the resync has already been counted.
             for i in range(40):
-                client.pods().create(_big_pod(f"lag-{i:03d}", payload_kb=64))
-            t.join(timeout=60)
-            assert len(fast_frames) == 40          # fast watcher: lossless
+                client.pods().create(make_pod(f"lag-{i:03d}"))
+            assert metrics_pkg.default_registry().counter(
+                "watch_lag_resyncs_total").total() > resyncs0
+            # fast watcher: lossless, streaming the whole time
+            fast_frames = [fast.read_frame(timeout=30) for _ in range(40)]
+            assert all(f is not None for f in fast_frames)
+            # open the gate: the slow writer wakes, finds the cleared
+            # queue, and delivers exactly ERROR + end-of-stream
+            chaos.release_gate("apiserver.watch.write.lagger")
             frames = []
             while True:
                 f = slow.read_frame(timeout=10)
@@ -788,27 +784,41 @@ class TestWatchFanout:
             # re-watches (the Reflector contract) and sees current state
             assert len(client.pods().list().items) == 40
         finally:
-            slow.close()
-            fast.close()
+            chaos.clear()
+            if slow is not None:
+                slow.close()
+            if fast is not None:
+                fast.close()
             srv.stop()
 
     def test_slow_watcher_coalesces_same_key_modifies(self):
+        from kubernetes_tpu.util import chaos
         from kubernetes_tpu.util import metrics as metrics_pkg
 
         srv = APIServer(Master(MasterConfig()), watch_lag_limit=8).start()
         client = Client(HTTPTransport(srv.base_url))
         coalesced0 = metrics_pkg.default_registry().counter(
             "watch_events_coalesced_total").total()
+        slow = None
         try:
-            slow = _RawWatch(srv.port)      # connected, never reads
-            client.pods().create(_big_pod("co-1", payload_kb=64))
+            # park the writer on a chaos gate: the queue fills to the lag
+            # bound deterministically, then same-key MODIFYs coalesce
+            chaos.inject_gate("apiserver.watch.write.stall")
+            slow = _RawWatch(
+                srv.port, path="/api/v1/pods?watch=1&chaosGate=stall")
+            client.pods().create(make_pod("co-1"))
             last_rv = ""
             for i in range(60):
                 got = client.pods().get("co-1")
                 got.metadata.labels = {"round": str(i)}
                 last_rv = client.pods().update(got).metadata.resource_version
             # one key, modify-chain events: the lagging watcher coalesces
-            # instead of resyncing, and still converges on the LATEST state
+            # instead of resyncing — counted synchronously in the update
+            # path, so this is already observable before the gate opens
+            assert metrics_pkg.default_registry().counter(
+                "watch_events_coalesced_total").total() > coalesced0
+            chaos.release_gate("apiserver.watch.write.stall")
+            # ...and still converges on the LATEST state
             frames = []
             while True:
                 f = slow.read_frame(timeout=10)
@@ -821,11 +831,11 @@ class TestWatchFanout:
             assert all(f["type"] == "MODIFIED" for f in frames[1:])
             # strictly fewer frames than updates: intermediates were merged
             assert len(frames) < 61
-            assert metrics_pkg.default_registry().counter(
-                "watch_events_coalesced_total").total() > coalesced0
             assert srv.metric_watch_lag_drops.total() == 0
         finally:
-            slow.close()
+            chaos.clear()
+            if slow is not None:
+                slow.close()
             srv.stop()
 
 

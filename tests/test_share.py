@@ -1,0 +1,221 @@
+"""kube-horizon cross-worker side channel (apiserver/share.py).
+
+The contract under test (docs/design/apiserver-hotpath.md §cross-worker):
+
+- the fairshed ledger is the EXACT feed — creates on worker A and binds
+  on worker B sum to the same global backlog from every attachment, so
+  the backlog governor fires at the same threshold on every worker of
+  an SO_REUSEPORT fleet, and the measured Retry-After hints agree;
+- the frame ring is the loss-TOLERANT feed — records a keeping-up
+  reader imports are byte-identical to what the committing worker
+  published (including across the wrap pad); a lapped reader loses
+  records to ``ring_drops`` but never imports torn bytes;
+- the live APIServer path: worker A's write-path seed publishes into
+  its ring, worker B's drain imports the exact wire JSON into its own
+  cache (the sibling never pays the encode).
+"""
+
+import json
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.apiserver import fairshed
+from kubernetes_tpu.apiserver.http import APIServer
+from kubernetes_tpu.apiserver.master import Master, MasterConfig
+from kubernetes_tpu.apiserver.share import ShareSegment, SharedLedger
+from kubernetes_tpu.client.client import Client
+from kubernetes_tpu.client.http import HTTPTransport
+
+
+def mkseg(tmp_path, nworkers=2, ring_bytes=8192):
+    """Create a segment and attach one ShareSegment per worker, the way
+    the harness parent creates and each worker process attaches."""
+    path = str(tmp_path / "share.seg")
+    segs = [ShareSegment.create(path, nworkers, ring_bytes=ring_bytes,
+                                worker_index=0)]
+    segs += [ShareSegment(path, worker_index=i) for i in range(1, nworkers)]
+    return segs
+
+
+# ---------------------------------------------------------------------------
+# segment plumbing
+# ---------------------------------------------------------------------------
+
+def test_segment_rejects_foreign_files_and_bad_index(tmp_path):
+    bogus = tmp_path / "not-a-segment"
+    bogus.write_bytes(b"\0" * 4096)
+    with pytest.raises(ValueError, match="not a kube-share segment"):
+        ShareSegment(str(bogus))
+    a, _b = mkseg(tmp_path)
+    with pytest.raises(ValueError, match="out of range"):
+        ShareSegment(a.path, worker_index=2)
+
+
+def test_ledger_counters_are_exact_across_attachments(tmp_path):
+    a, b = mkseg(tmp_path)
+    la, lb = SharedLedger(a), SharedLedger(b)
+    for _ in range(7):
+        la.note_created()
+    lb.note_bound(3)
+    # both attachments read the same global truth
+    assert la.backlog() == lb.backlog() == 4
+    # availability-safe delete clamp: deletes only count against a
+    # positive backlog (deleting a BOUND pod opens no phantom headroom)
+    for _ in range(10):
+        lb.note_deleted()
+    assert la.backlog() == lb.backlog() == 0
+    lb.note_bound(100)
+    assert la.backlog() == lb.backlog() == 0  # never negative
+
+
+# ---------------------------------------------------------------------------
+# the governor at N workers — the lifted --overload restriction
+# ---------------------------------------------------------------------------
+
+def test_governor_fires_at_same_backlog_on_every_worker(tmp_path):
+    a, b = mkseg(tmp_path)
+    now = [100.0]
+    clock = lambda: now[0]  # noqa: E731
+    fs_a = fairshed.FairShed(backlog_limit=5, clock=clock,
+                             ledger=SharedLedger(a, clock=clock))
+    fs_b = fairshed.FairShed(backlog_limit=5, clock=clock,
+                             ledger=SharedLedger(b, clock=clock))
+    # a ledger-less worker in the same fleet is the broken pre-horizon
+    # topology: it sees only its local share of the creates
+    fs_blind = fairshed.FairShed(backlog_limit=5, clock=clock)
+    for _ in range(5):
+        fs_a.note_pod_created()
+        fs_a.admit(fairshed.WORKLOAD).release()
+    # worker B served ZERO creates, yet its governor fires at the same
+    # global threshold the single-worker contract promises
+    assert fs_a.backlog == fs_b.backlog == 5
+    with pytest.raises(fairshed.Shed):
+        fs_b.admit(fairshed.WORKLOAD, pod_create=True)
+    with pytest.raises(fairshed.Shed):
+        fs_a.admit(fairshed.WORKLOAD, pod_create=True)
+    # the blind worker admits — exactly the governor bypass that forced
+    # --overload to require --apiservers 1 before the ledger existed
+    fs_blind.admit(fairshed.WORKLOAD, pod_create=True).release()
+    # binds observed by B reopen headroom for A's next create
+    fs_b.note_pods_bound(2)
+    assert fs_a.backlog == 3
+    fs_a.admit(fairshed.WORKLOAD, pod_create=True).release()
+
+
+def test_retry_after_hints_agree_across_workers(tmp_path):
+    a, b = mkseg(tmp_path)
+    now = [100.0]
+    clock = lambda: now[0]  # noqa: E731
+    la = SharedLedger(a, clock=clock)
+    lb = SharedLedger(b, clock=clock)
+    fs_a = fairshed.FairShed(backlog_limit=4, clock=clock, ledger=la)
+    fs_b = fairshed.FairShed(backlog_limit=4, clock=clock, ledger=lb)
+    # anchor both rate windows, then let A bind 50 pods over 5 seconds:
+    # the GLOBAL bind rate (10/s) is measurable from either worker
+    la.bind_rate(), lb.bind_rate()
+    now[0] += 5.0
+    la.note_bound(50)
+    assert la.bind_rate() == pytest.approx(10.0)
+    assert lb.bind_rate() == pytest.approx(10.0)
+    for _ in range(60):
+        fs_a.note_pod_created()
+    assert fs_a.backlog == fs_b.backlog == 10  # 60 created - 50 bound
+    hints = []
+    for fs in (fs_a, fs_b):
+        with pytest.raises(fairshed.Shed) as ei:
+            fs.admit(fairshed.WORKLOAD, pod_create=True)
+        assert ei.value.reason == "backlog"
+        hints.append(ei.value.retry_after_s)
+    # same global backlog / same global rate -> the same measured hint,
+    # regardless of which worker the kernel routed the create to
+    assert hints[0] == hints[1] > 0.0
+
+
+# ---------------------------------------------------------------------------
+# frame ring: exact bytes for a keeping-up reader, counted loss for a
+# lapped one
+# ---------------------------------------------------------------------------
+
+def test_frame_records_import_bit_identical(tmp_path):
+    a, b = mkseg(tmp_path)
+    pub = [(f"rv-{i}", "v1", json.dumps({"kind": "Pod", "i": i,
+                                         "pad": "é" * 20}))
+           for i in range(10)]
+    for rv, ver, wire in pub:
+        assert a.publish_frame(rv, ver, wire)
+    assert b.drain_frames() == pub       # exact tuples, publish order
+    assert b.drain_frames() == []        # cursor advanced, nothing new
+    assert a.drain_frames() == []        # own block is never self-drained
+    assert b.ring_drops == 0
+
+
+def test_frame_ring_wraps_without_loss_for_keeping_up_reader(tmp_path):
+    a, b = mkseg(tmp_path, ring_bytes=4096)
+    wire = json.dumps({"pad": "x" * 300})
+    got = []
+    for i in range(50):  # ~18 KB through a 4 KB ring
+        assert a.publish_frame(f"rv-{i}", "v1", wire)
+        got.extend(b.drain_frames())
+    assert got == [(f"rv-{i}", "v1", wire) for i in range(50)]
+    assert b.ring_drops == 0
+
+
+def test_lapped_reader_drops_are_counted_never_torn(tmp_path):
+    a, b = mkseg(tmp_path, ring_bytes=4096)
+    pub = {}
+    for i in range(60):  # laps the ring several times, reader asleep
+        rv, wire = f"rv-{i:03d}", json.dumps({"i": i, "pad": "y" * 200})
+        assert a.publish_frame(rv, "v1", wire)
+        pub[rv] = wire
+    got = b.drain_frames()
+    assert b.ring_drops >= 1
+    # whatever survives is byte-exact — a lap loses records, it never
+    # fabricates or tears one
+    for rv, ver, wire in got:
+        assert ver == "v1" and pub[rv] == wire
+
+
+def test_oversize_record_is_refused_not_published(tmp_path):
+    a, b = mkseg(tmp_path, ring_bytes=4096)
+    assert not a.publish_frame("rv-big", "v1", "z" * 3000)
+    assert a.worker_counters(0)["published"] == 0
+    assert b.drain_frames() == []
+    # read-only attachments (harness probes) can never publish
+    probe = ShareSegment(a.path, worker_index=-1)
+    assert not probe.publish_frame("rv", "v1", "{}")
+
+
+# ---------------------------------------------------------------------------
+# the live path: worker A's write seeds worker B's cache
+# ---------------------------------------------------------------------------
+
+def test_apiserver_sibling_imports_seeded_encoding(tmp_path):
+    seg_a, seg_b = mkseg(tmp_path, ring_bytes=1 << 20)
+    srv_a = APIServer(Master(MasterConfig()), share=seg_a).start()
+    srv_b = APIServer(Master(MasterConfig()), share=seg_b).start()
+    try:
+        client = Client(HTTPTransport(srv_a.base_url))
+        pod = client.pods().create(api.Pod(
+            metadata=api.ObjectMeta(name="seeded", namespace="default"),
+            spec=api.PodSpec(containers=[api.Container(name="c",
+                                                       image="img")])))
+        assert srv_a.metric_seed_published.value() >= 1
+        # worker B never served the write; its drain imports the exact
+        # bytes worker A cached at commit time
+        srv_b._drain_share_seeds()
+        assert srv_b.metric_seed_imported.value() >= 1
+        rv = pod.metadata.resource_version
+        keys = [k for k in srv_b._wire_cache if k[0] == rv]
+        assert keys, f"rv {rv} not imported"
+        for key in keys:
+            assert srv_b._wire_cache[key] == srv_a._wire_cache[key]
+        # a second drain is a no-op, not a re-import
+        imported = srv_b.metric_seed_imported.value()
+        srv_b._drain_share_seeds()
+        assert srv_b.metric_seed_imported.value() == imported
+    finally:
+        srv_a.stop()
+        srv_b.stop()
+        seg_a.close()
+        seg_b.close()

@@ -1,0 +1,358 @@
+"""kube-horizon active sub-mesh solve (models/submesh.py).
+
+The contract under test: per-wave node-axis compaction changes the
+LAYOUT of the dense scan, never its decisions. Every engaged wave must
+be bit-identical — chosen AND score planes, preempt score channel
+included — to the full-plane solve and to the serial oracle, under both
+encoders, with pinned hosts, service peers, preemption bands, and the
+gated bf16 zone-plane downgrade all exercised. The keep rule's
+fallbacks (zero-req pods, missing HostName/PodFitsResources predicates)
+must disable compaction rather than risk it.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.quantity import Quantity
+from kubernetes_tpu.models import submesh as sm
+from kubernetes_tpu.models.batch_solver import (
+    decisions_to_names,
+    ship_inputs,
+    snapshot_to_host_inputs,
+    solve_jit,
+)
+from kubernetes_tpu.models.incremental import IncrementalEncoder
+from kubernetes_tpu.models.oracle import preempt_serial, solve_serial
+from kubernetes_tpu.models.policy import batch_policy_from
+from kubernetes_tpu.models.snapshot import encode_snapshot
+from kubernetes_tpu.parallel.mesh import RESIDENT_FIELDS, WAVE_FIELDS
+from kubernetes_tpu.scheduler.plugins import load_policy
+
+# compaction floors the padded axis at 256, so engagement needs real
+# node counts; keep pod counts small to bound compile time
+N_NODES = 400
+
+
+def mknode(i, cpu="2", mem="4Gi", labels=None):
+    return api.Node(
+        metadata=api.ObjectMeta(name=f"n{i:04d}", labels=labels or {}),
+        spec=api.NodeSpec(capacity={"cpu": Quantity(cpu),
+                                    "memory": Quantity(mem)}))
+
+
+def mkpod(name, mcpu=250, mem="256Mi", host="", status_host="",
+          labels=None, prio=0, can=True, ns="default"):
+    return api.Pod(
+        metadata=api.ObjectMeta(name=name, namespace=ns, uid=f"uid-{name}",
+                                labels=labels or {}),
+        spec=api.PodSpec(
+            host=host,
+            containers=[api.Container(
+                name="c", image="i",
+                resources=api.ResourceRequirements(limits={
+                    "cpu": Quantity(f"{mcpu}m"),
+                    "memory": Quantity(mem)}))],
+            priority=prio,
+            preemption_policy=("" if can else api.PreemptNever)),
+        status=api.PodStatus(host=status_host))
+
+
+def full_cluster(n=N_NODES, n_free=70, n_pending=24, zones=0, peers=0,
+                 seed=0):
+    """Mostly-full cluster: ``n - n_free`` nodes carry a pod consuming
+    their whole cpu, so the keep rule drops them; ``peers`` of the full
+    nodes also carry a service-labeled pod (kept for bookkeeping)."""
+    rng = np.random.default_rng(seed)
+    nodes = [mknode(i, labels={"zone": f"z{i % zones}"} if zones else None)
+             for i in range(n)]
+    free = set(rng.choice(n, n_free, replace=False).tolist())
+    existing = []
+    for i in range(n):
+        if i in free:
+            continue
+        lab = {"app": "web"} if peers and i % peers == 0 else {}
+        existing.append(mkpod(f"e{i}", mcpu=2000, mem="3Gi",
+                              host=f"n{i:04d}", status_host=f"n{i:04d}",
+                              labels=lab))
+    svc = api.Service(
+        metadata=api.ObjectMeta(name="web", namespace="default"),
+        spec=api.ServiceSpec(port=80, selector={"app": "web"}))
+    pending = [mkpod(f"p{i:03d}",
+                     labels={"app": "web"} if i % 2 else {})
+               for i in range(n_pending)]
+    return nodes, existing, pending, [svc]
+
+
+def run_submesh(host, pol, gangs, plan, zone_bf16=False):
+    """Drive submesh_program exactly as MeshExecutor does: resident/wave
+    split, pod_host_idx remapped host-side, decisions already back in
+    original node indices."""
+    inp = ship_inputs(host)
+    res = tuple(getattr(inp, f) for f in RESIDENT_FIELDS)
+    wav = tuple(jnp.asarray(
+        sm.remap_pod_host_idx(getattr(host, f), plan)
+        if f == "pod_host_idx" else getattr(host, f))
+        for f in WAVE_FIELDS)
+    fn = sm.submesh_program(pol, gangs, zone_bf16)
+    c, s = fn(res, wav, plan.keep_idx, plan.valid)
+    return np.asarray(c), np.asarray(s)
+
+
+def assert_bit_identical(snap, host, serial_names):
+    pol, gangs = snap.policy, snap.has_gangs
+    plan = sm.plan_wave(host, pol)
+    assert plan is not None, "compaction should engage on this shape"
+    full_c, full_s = map(np.asarray,
+                         solve_jit(ship_inputs(host), pol=pol, gangs=gangs))
+    sub_c, sub_s = run_submesh(host, pol, gangs, plan)
+    assert np.array_equal(full_c, sub_c)
+    assert np.array_equal(full_s, sub_s)
+    assert decisions_to_names(snap, sub_c) == serial_names
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# unit pieces
+# ---------------------------------------------------------------------------
+
+def test_padded_size_buckets():
+    # floor 256, then two buckets per octave (2^k and 3*2^(k-1))
+    assert sm.padded_size(1) == 256
+    assert sm.padded_size(256) == 256
+    assert sm.padded_size(257) == 384
+    assert sm.padded_size(384) == 384
+    assert sm.padded_size(385) == 512
+    assert sm.padded_size(513) == 768
+    assert sm.padded_size(769) == 1024
+    assert sm.padded_size(6000) == 6144
+
+
+def test_remap_pod_host_idx_preserves_sentinels():
+    plan = sm.SubmeshPlan(
+        keep_idx=np.array([2, 5, 9, 0], np.int32),
+        valid=np.array([True, True, True, False]),
+        inv=np.array([-1, -1, 0, -1, -1, 1, -1, -1, -1, 2], np.int32),
+        n_kept=3, n_total=10)
+    ph = np.array([-1, -2, 5, 9, 2], np.int32)
+    out = sm.remap_pod_host_idx(ph, plan)
+    assert out.tolist() == [-1, -2, 1, 2, 0]
+    assert out.dtype == ph.dtype
+
+
+def test_submesh_mode_validates(monkeypatch):
+    monkeypatch.setenv("KTPU_SUBMESH", "banana")
+    with pytest.raises(ValueError):
+        sm.submesh_mode()
+
+
+# ---------------------------------------------------------------------------
+# keep-rule fallbacks — compaction must refuse, not risk
+# ---------------------------------------------------------------------------
+
+def test_zero_req_real_pod_falls_back():
+    nodes, existing, pending, services = full_cluster(n_pending=4)
+    # a pod requesting nothing fits every allowed node regardless of
+    # headroom — the resource-based keep rule is invalid for the wave
+    pending.append(api.Pod(
+        metadata=api.ObjectMeta(name="zero", namespace="default",
+                                uid="uid-zero"),
+        spec=api.PodSpec(containers=[api.Container(name="c", image="i")])))
+    snap = encode_snapshot(nodes, existing, pending, services)
+    host = snapshot_to_host_inputs(snap)
+    assert sm.plan_wave(host, snap.policy) is None
+
+
+def test_policy_without_hostname_falls_back():
+    # padding rows are never-feasible only THROUGH the HostName
+    # predicate; without it they could place on a dropped node and the
+    # output planes would differ from the full solve
+    policy = load_policy("""
+    {"predicates": [{"name": "PodFitsResources"}],
+     "priorities": [{"name": "LeastRequestedPriority", "weight": 1}]}
+    """)
+    nodes, existing, pending, services = full_cluster(n_pending=5)
+    bp = batch_policy_from(policy=policy)
+    # the incremental encoder pads the pod axis; encode_snapshot does not
+    enc = IncrementalEncoder(policy=bp)
+    snap = enc.encode(nodes, existing, pending, services)
+    host = snapshot_to_host_inputs(snap)
+    assert host.req.shape[0] > len(pending)  # padding rows present
+    assert sm.plan_wave(host, bp) is None
+    # without padding rows the HostName fallback is unnecessary
+    snap2 = encode_snapshot(nodes, existing, pending, services, policy=bp)
+    assert sm.plan_wave(snapshot_to_host_inputs(snap2), bp) is not None
+
+
+def test_mode_off_disables(monkeypatch):
+    nodes, existing, pending, services = full_cluster(n_pending=4)
+    snap = encode_snapshot(nodes, existing, pending, services)
+    host = snapshot_to_host_inputs(snap)
+    assert sm.plan_wave(host, snap.policy) is not None
+    monkeypatch.setenv("KTPU_SUBMESH", "off")
+    assert sm.plan_wave(host, snap.policy) is None
+
+
+def test_engage_threshold_and_force(monkeypatch):
+    # barely-full cluster: kept set pads past KEEP_ENGAGE * N, so auto
+    # declines; force engages (and must still be bit-identical)
+    nodes, existing, pending, services = full_cluster(n_free=300,
+                                                      n_pending=8)
+    snap = encode_snapshot(nodes, existing, pending, services)
+    host = snapshot_to_host_inputs(snap)
+    assert sm.plan_wave(host, snap.policy) is None
+    monkeypatch.setenv("KTPU_SUBMESH", "force")
+    serial = solve_serial(nodes, existing, pending, services)
+    plan = assert_bit_identical(snap, host, serial)
+    assert plan.n_kept > sm.KEEP_ENGAGE * plan.n_total - 256
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: full solve + serial oracle, both encoders
+# ---------------------------------------------------------------------------
+
+def test_default_policy_bit_identical_with_pins_and_peers():
+    nodes, existing, pending, services = full_cluster(peers=5)
+    # pin one pending pod to a free node (must remap, not drop)
+    free_name = next(n.metadata.name for n in nodes
+                     if not any(e.spec.host == n.metadata.name
+                                for e in existing))
+    pending[3].spec.host = free_name
+    serial = solve_serial(nodes, existing, pending, services)
+    snap = encode_snapshot(nodes, existing, pending, services)
+    host = snapshot_to_host_inputs(snap)
+    plan = assert_bit_identical(snap, host, serial)
+    assert plan.n_kept < plan.n_total
+    # every peer-carrying full node survives the keep mask (their counts
+    # feed spread bookkeeping even when resource-infeasible)
+    kept = set(plan.keep_idx[plan.valid].tolist())
+    name_to_idx = {n.metadata.name: i for i, n in enumerate(nodes)}
+    for e in existing:
+        if e.metadata.labels:
+            assert name_to_idx[e.spec.host] in kept
+
+
+def test_incremental_encoder_bit_identical():
+    nodes, existing, pending, services = full_cluster(seed=3)
+    enc = IncrementalEncoder()
+    snap = enc.encode(nodes, existing, pending, services)
+    host = snapshot_to_host_inputs(snap)
+    serial = solve_serial(nodes, existing, pending, services)
+    assert_bit_identical(snap, host, serial)
+
+
+def test_preemption_wave_bit_identical():
+    nodes = [mknode(i, cpu="1", mem="8Gi") for i in range(N_NODES)]
+    existing = []
+    # 0..299 full of prio-5000 pods: their band is unreachable for the
+    # prio-1000 wave, so the keep rule must DROP them; 300..349 carry
+    # prio-10 victims (kept); 350..399 free (kept)
+    for i in range(300):
+        existing.append(mkpod(f"hi-{i}", mcpu=1000, mem="64Mi",
+                              host=f"n{i:04d}", status_host=f"n{i:04d}",
+                              prio=5000))
+    for i in range(300, 350):
+        for j in ("a", "b"):
+            existing.append(mkpod(f"lo-{i}{j}", mcpu=500, mem="64Mi",
+                                  host=f"n{i:04d}", status_host=f"n{i:04d}",
+                                  prio=10))
+    pending = [mkpod(f"p{i:03d}", mcpu=600, mem="64Mi", prio=1000,
+                     can=(i % 5 != 0)) for i in range(30)]
+    snap = encode_snapshot(nodes, existing, pending, [])
+    assert snap.band_prio.shape[0] > 0  # preemption planes live
+    host = snapshot_to_host_inputs(snap)
+    s_names, _ = preempt_serial(nodes, existing, pending)
+    plan = assert_bit_identical(snap, host, s_names)
+    assert plan.n_kept <= 110, \
+        "unreachable-band nodes must not survive the keep mask"
+
+
+def test_anti_affinity_zone_bf16_bit_identical():
+    policy = load_policy("""
+    {"predicates": [{"name": "PodFitsResources"}, {"name": "HostName"},
+                    {"name": "MatchNodeSelector"}],
+     "priorities": [
+        {"name": "LeastRequestedPriority", "weight": 1},
+        {"name": "zone_spread", "weight": 2,
+         "argument": {"serviceAntiAffinity": {"label": "zone"}}}]}
+    """)
+    nodes, existing, pending, services = full_cluster(zones=6, peers=4,
+                                                      n_pending=32, seed=7)
+    bp = batch_policy_from(policy=policy)
+    snap = encode_snapshot(nodes, existing, pending, services, policy=bp)
+    host = snapshot_to_host_inputs(snap)
+    assert sm.zone_bf16_ok(host, bp), "gate should admit this peer bound"
+    plan = sm.plan_wave(host, bp)
+    assert plan is not None
+    full_c, full_s = map(np.asarray,
+                         solve_jit(ship_inputs(host), pol=bp, gangs=False))
+    for zbf in (False, True):
+        sub_c, sub_s = run_submesh(host, bp, False, plan, zone_bf16=zbf)
+        assert np.array_equal(full_c, sub_c), f"zone_bf16={zbf}"
+        assert np.array_equal(full_s, sub_s), f"zone_bf16={zbf}"
+    serial = solve_serial(nodes, existing, pending, services, policy=policy)
+    assert decisions_to_names(snap, sub_c) == serial
+
+
+def test_zone_bf16_gate_rejects_large_peer_bound():
+    nodes, existing, pending, services = full_cluster(zones=6, peers=4,
+                                                      n_pending=8)
+    policy = load_policy("""
+    {"predicates": [{"name": "PodFitsResources"}, {"name": "HostName"}],
+     "priorities": [{"name": "zone_spread", "weight": 1,
+                     "argument": {"serviceAntiAffinity":
+                                  {"label": "zone"}}}]}
+    """)
+    bp = batch_policy_from(policy=policy)
+    snap = encode_snapshot(nodes, existing, pending, services, policy=bp)
+    host = snapshot_to_host_inputs(snap)
+    # inflate one group's initial peer total past the 256-exactness
+    # bound: bf16 would round, so the gate must refuse
+    gc = np.array(host.group_counts)
+    gc[0, 0] = 300
+    host = host._replace(group_counts=gc)
+    assert not sm.zone_bf16_ok(host, bp)
+    # and a policy with no anti-affinity never gates bf16 on
+    assert not sm.zone_bf16_ok(snapshot_to_host_inputs(
+        encode_snapshot(nodes, existing, pending, services)),
+        encode_snapshot(nodes, existing, pending, services).policy)
+
+
+# ---------------------------------------------------------------------------
+# MeshExecutor integration — the production path
+# ---------------------------------------------------------------------------
+
+def test_mesh_executor_submesh_path_engages_and_probes():
+    from kubernetes_tpu.solver.mesh_exec import MeshExecutor
+    nodes, existing, pending, services = full_cluster(seed=11)
+    snap = encode_snapshot(nodes, existing, pending, services)
+    host = snapshot_to_host_inputs(snap)
+    pol, gangs = snap.policy, snap.has_gangs
+    full_c, full_s = map(np.asarray,
+                         solve_jit(ship_inputs(host), pol=pol, gangs=gangs))
+    me = MeshExecutor(pods_axis=1, dispatch="single", probe="first")
+    c1, s1 = me.solve(host, pol, gangs, cache_key=("w", 0))
+    c2, s2 = me.solve(host, pol, gangs, cache_key=("w", 0))
+    for c, s in ((c1, s1), (c2, s2)):
+        assert np.array_equal(c, full_c)
+        assert np.array_equal(s, full_s)
+    assert me.submesh_waves == 2
+    # first submesh wave re-solved full-plane and compared bitwise
+    assert me.submesh_parity_divergent == 0
+
+
+def test_mesh_executor_respects_submesh_off(monkeypatch):
+    from kubernetes_tpu.solver.mesh_exec import MeshExecutor
+    monkeypatch.setenv("KTPU_SUBMESH", "off")
+    nodes, existing, pending, services = full_cluster(seed=13)
+    snap = encode_snapshot(nodes, existing, pending, services)
+    host = snapshot_to_host_inputs(snap)
+    pol, gangs = snap.policy, snap.has_gangs
+    full_c, full_s = map(np.asarray,
+                         solve_jit(ship_inputs(host), pol=pol, gangs=gangs))
+    me = MeshExecutor(pods_axis=1, dispatch="single", probe="off")
+    c, s = me.solve(host, pol, gangs, cache_key=("w", 0))
+    assert np.array_equal(c, full_c) and np.array_equal(s, full_s)
+    assert me.submesh_waves == 0
