@@ -207,6 +207,22 @@ def feed(prefix: str, count: int, rate: float, master: str,
                 if not chunk:
                     break
                 buf += chunk
+                # fast path: a full recv of nothing but 2xx statuses (the
+                # steady state) is two substring counts + one rfind, no
+                # regex and no per-response Match objects. Classification
+                # needs only the FIRST status digit, so a trailing
+                # "HTTP/1.1 2" with its last digits still in flight counts
+                # now and the cut point keeps the leftover digits from
+                # ever re-matching. Any non-2xx (or a marker cut before
+                # its first digit) falls through to the exact loop below.
+                n_status = buf.count(b"HTTP/1.1 ")
+                if n_status and buf.count(b"HTTP/1.1 2") == n_status:
+                    accepted += n_status
+                    acked[0] = min(count, base + accepted)
+                    buf = buf[buf.rfind(b"HTTP/1.1 2") + 10:]
+                    if len(buf) > 16:
+                        buf = buf[-16:]
+                    continue
                 last_end, poison = 0, False
                 for m in status_re.finditer(buf):
                     code = m.group(1)
@@ -269,23 +285,34 @@ def feed(prefix: str, count: int, rate: float, master: str,
 
         rt = threading.Thread(target=reader, daemon=True)
         rt.start()
+        # Replay requests are CONTIGUOUS in the log, so a span of them is
+        # one mmap slice — one sendall (one syscall, zero copies) covers
+        # up to span_max requests instead of one each. The span never
+        # exceeds half the pipeline depth (the reader keeps draining
+        # while we sleep) and pacing charges the whole span at once:
+        # bursts of ≤span_max at the wire level, same offered rate.
+        span_max = max(1, min(32, depth // 2)) if log_mm is not None else 1
         i = base
         while i < count and not bad:
-            if log_mm is not None:
-                req = log_mv[idx[i]:idx[i + 1]]
-            else:
-                req = _render_request(prefix, i, priority_class)
             while i - acked[0] >= depth and not bad \
                     and not conn_down.is_set():
                 time.sleep(0.0005)
             if bad or conn_down.is_set():
                 break
+            if log_mm is not None:
+                j = min(count, i + span_max, acked[0] + depth)
+                if j <= i:       # acked[0] only grows; belt and braces
+                    j = i + 1
+                req = log_mv[idx[i]:idx[j]]
+            else:
+                j = i + 1
+                req = _render_request(prefix, i, priority_class)
             try:
                 sock.sendall(req)
             except OSError:
                 break
-            i += 1
-            next_t += interval
+            next_t += interval * (j - i)
+            i = j
             now = time.perf_counter()
             behind_max = max(behind_max, now - next_t)
             if next_t > now:
@@ -1048,6 +1075,12 @@ def validate_record(rec: dict, round_no: int = 8) -> list:
                 if subm.get("parity_divergent", 0) != 0:
                     missing.append(
                         "solverd.mesh.submesh.parity_divergent:nonzero")
+    if round_no >= 18:
+        # r18 introduced the kube-stripe feeder push: the record must
+        # disclose the load generator's own normalized cost — the
+        # number the coalesced-sendall/batched-ack claim is judged on
+        if "feeder_cpu_s_per_10k" not in rec:
+            missing.append("feeder_cpu_s_per_10k")
     if round_no >= 13:
         # r13 introduced kube-explain: the unschedulable section (reason
         # histogram + explain cost + event-recorder loss disclosure) is
@@ -1712,6 +1745,14 @@ def main(argv=None) -> int:
     ap.add_argument("--store-fsync", action="store_true",
                     help="kube-store --fsync (media-crash durability; "
                     "default flush-only survives process kill)")
+    ap.add_argument("--store-shards", "--store_shards", type=int,
+                    default=1,
+                    help="kube-stripe: shard the store keyspace by "
+                    "namespace hash into this many shards (power of "
+                    "two; per-shard locks, rings and watcher lists "
+                    "under one global revision counter). Passed to "
+                    "kube-store (--apiservers > 1) or the apiserver's "
+                    "in-process store. 1 = the unsharded twin.")
     ap.add_argument("--warm-max-bucket", "--warm_max_bucket", type=int,
                     default=1024,
                     help="largest pow-2 wave bucket compiled during "
@@ -2034,6 +2075,8 @@ def main(argv=None) -> int:
             store_cmd = [PY, "-m", "kubernetes_tpu.cmd.storeserver",
                          "--port", str(store_port),
                          "--metrics-port", str(store_metrics_port)]
+            if args.store_shards > 1:
+                store_cmd += ["--shards", str(args.store_shards)]
             if args.store_data_dir:
                 os.makedirs(args.store_data_dir, exist_ok=True)
                 store_cmd += ["--data-dir", args.store_data_dir,
@@ -2069,6 +2112,8 @@ def main(argv=None) -> int:
             if args.store_data_dir:
                 os.makedirs(args.store_data_dir, exist_ok=True)
                 api_cmd += ["--data-dir", args.store_data_dir]
+            if args.store_shards > 1:
+                api_cmd += ["--store-shards", str(args.store_shards)]
             spawn("apiserver0", *api_cmd,
                   ready=_http_ready(f"{master}/healthz/ping"))
         deadline = time.time() + 60
@@ -2416,10 +2461,15 @@ def main(argv=None) -> int:
               file=sys.stderr, flush=True)
         warm_total = 0
         size = args.warm_max_bucket
+        # XLA compile time for a wave bucket scales with the padded node
+        # dimension: 180 s fits the 10k-node contract shape, but planet
+        # shapes (40k+ nodes) need the window to scale. Warmup is off
+        # the record clock by design, so generous is free.
+        warm_wait = max(180.0, args.nodes * 0.05)
         while size >= 1:
             feed(f"warm{size}", size, 100000.0, master)
             warm_total += size
-            if not wait_all_bound(warm_total):
+            if not wait_all_bound(warm_total, timeout=warm_wait):
                 raise RuntimeError(f"warmup bucket {size} did not bind")
             size //= 2
 
@@ -2503,7 +2553,11 @@ def main(argv=None) -> int:
         # aborts the run with a partial record.
         stats = [None] * args.feeders
         abort_err = None
-        deadline = time.monotonic() + 600
+        # scale with shape: a planet-shape feed (200k pods at a governed
+        # rate) legitimately runs past the old flat 600 s ceiling; 1.5x
+        # the nominal feed time + 300 s slack still catches a wedged run
+        feed_deadline_s = max(600.0, args.pods / args.rate * 1.5 + 300.0)
+        deadline = time.monotonic() + feed_deadline_s
         pending_f = set(range(args.feeders))
         while pending_f and abort_err is None:
             for f in list(pending_f):
@@ -2522,7 +2576,8 @@ def main(argv=None) -> int:
                         "error", f"feeder {f} exited {rc}")
             if pending_f and abort_err is None:
                 if time.monotonic() > deadline:
-                    abort_err = "feeder deadline (600s) exceeded"
+                    abort_err = (f"feeder deadline "
+                                 f"({feed_deadline_s:.0f}s) exceeded")
                     break
                 time.sleep(0.2)
         feed_s = time.perf_counter() - t0
@@ -2696,12 +2751,15 @@ def main(argv=None) -> int:
                            "429 + Retry-After")
         budget = cpu_budget()
         budget["feeders"] = round(sum(s.get("cpu_s", 0.0) for s in stats), 2)
+        striped = (f" ({args.store_shards}-shard stripestore)"
+                   if args.store_shards > 1 else "")
         record = {
             "config": f"churn multi-process: {args.pods} pods at "
                       f"{args.rate:.0f}/s onto {args.nodes} nodes",
             "topology": (f"{args.apiservers} apiserver workers "
-                         "(SO_REUSEPORT) + kube-store + "
-                         if args.apiservers > 1 else "apiserver + ")
+                         f"(SO_REUSEPORT) + kube-store{striped} + "
+                         if args.apiservers > 1
+                         else f"apiserver{striped} + ")
                         + sched_desc + " + "
                         f"{args.feeders} replay-log feeders, separate "
                         "processes, HTTP",
@@ -2717,6 +2775,12 @@ def main(argv=None) -> int:
             # which host stage owns the core budget (utime+stime per
             # component over the whole run; feeders self-reported)
             "cpu_budget_s": budget,
+            # the load generator's own cost normalized to shape: the
+            # coalesced-sendall/batched-ack feed loop's efficiency claim
+            # in one number (kubemark principle: the feeder must stay
+            # cheap enough to never be the bottleneck it measures)
+            "feeder_cpu_s_per_10k": round(
+                budget["feeders"] / max(args.pods, 1) * 10_000, 3),
             "host_cores": os.cpu_count(),
         }
         # the apiserver hot-path evidence (encode-once fan-out + batch
@@ -2923,7 +2987,7 @@ def main(argv=None) -> int:
                       f"(must be 0)", file=sys.stderr, flush=True)
         _chaos_record_sections(record)
         flush_flightrec(record)
-        missing = validate_record(record, round_no=17)
+        missing = validate_record(record, round_no=18)
         if missing:
             print(f"[churn-mp] WARNING: record missing contract fields: "
                   f"{missing}", file=sys.stderr, flush=True)

@@ -57,7 +57,34 @@ KEYS: Tuple[Tuple[str, str, str, float, bool], ...] = (
     ("per_bind_ms_live", "apiserver.per_bind_ms_live", "lower", 0.35, False),
     ("apiserver_cpu_s", "cpu_budget_s.apiserver", "lower", 0.35, False),
     ("e2e_p50_s", "latency.e2e_p50_s", "lower", 0.35, False),
+    # kube-stripe feeder push: the load generator's own normalized cost
+    # (advisory — it trades against offered-rate headroom)
+    ("feeder_cpu_s_per_10k", "feeder_cpu_s_per_10k", "lower", 0.35, False),
 )
+
+# STOREBENCH records (hack/storebench.py) carry their own key table and
+# gate only against committed STOREBENCH priors of the same shape — a
+# store microbench never baselines a churn record or vice versa.
+# Microbench bands are wide: the host is one shared core.
+STOREBENCH_KEYS: Tuple[Tuple[str, str, str, float, bool], ...] = (
+    ("striped_create_ns", "stores.striped8.create_ns", "lower", 0.5, True),
+    ("striped_fanout_tax_ns", "stores.striped8.fanout_tax_ns", "lower",
+     0.5, True),
+    ("striped_cas_ns", "stores.striped8.cas_ns", "lower", 0.5, False),
+    ("striped_txn_item_ns", "stores.striped8.txn_item_ns", "lower",
+     0.5, False),
+    ("striped_list_ms", "stores.striped8.list_ms", "lower", 0.5, False),
+    ("memstore_fanout_tax_ns", "stores.memstore.fanout_tax_ns", "lower",
+     0.5, False),
+)
+
+
+def _is_storebench(rec: dict) -> bool:
+    return rec.get("kind") == "storebench"
+
+
+def _keys_for(rec: dict):
+    return STOREBENCH_KEYS if _is_storebench(rec) else KEYS
 
 
 def _get_path(rec: dict, path: str):
@@ -75,6 +102,8 @@ def shape_key(rec: dict) -> str:
     deliberately different regimes and must never gate against the clean
     series (a preemption storm offers into a FULL cluster — its
     sustained rate is an evict+bind number, not a clean-bind number)."""
+    if _is_storebench(rec):
+        return "storebench: " + rec.get("config", "")
     cfg = rec.get("config", "")
     ap = rec.get("apiserver") or {}
     suffix = ""
@@ -114,9 +143,11 @@ def round_of(path: str) -> int:
     return int(m.group(1)) if m else -1
 
 
-def committed_records(repo: str = _REPO) -> List[Tuple[str, dict]]:
+def committed_records(repo: str = _REPO,
+                      pattern: str = "CHURN_MP_r*.json",
+                      ) -> List[Tuple[str, dict]]:
     out = []
-    for path in sorted(glob.glob(os.path.join(repo, "CHURN_MP_r*.json"))):
+    for path in sorted(glob.glob(os.path.join(repo, pattern))):
         if path.endswith(("_trace.json", "_timeline.json")):
             continue  # kube-trace / flightrec sidecars, not churn records
         try:
@@ -128,8 +159,19 @@ def committed_records(repo: str = _REPO) -> List[Tuple[str, dict]]:
 
 
 def _eligible_baseline(rec: dict) -> bool:
+    if _is_storebench(rec):
+        return ("error" not in rec and _get_path(
+            rec, "stores.striped8.fanout_tax_ns") is not None)
     return ("error" not in rec and rec.get("all_bound")
             and isinstance(rec.get("sustained_pods_per_s"), (int, float)))
+
+
+def _baseline_score(rec: dict) -> float:
+    """Higher is better: sustained rate for churn records, negated
+    fan-out tax (the headline) for store microbenches."""
+    if _is_storebench(rec):
+        return -_get_path(rec, "stores.striped8.fanout_tax_ns")
+    return rec["sustained_pods_per_s"]
 
 
 def find_baseline(fresh: dict, fresh_round: int,
@@ -137,14 +179,15 @@ def find_baseline(fresh: dict, fresh_round: int,
     """Best committed prior record of the same shape: highest sustained
     rate among strictly-earlier rounds."""
     shape = shape_key(fresh)
+    pattern = ("STOREBENCH_r*.json" if _is_storebench(fresh)
+               else "CHURN_MP_r*.json")
     best_path, best = None, None
-    for path, rec in committed_records(repo):
+    for path, rec in committed_records(repo, pattern):
         if round_of(path) >= fresh_round and fresh_round >= 0:
             continue
         if not _eligible_baseline(rec) or shape_key(rec) != shape:
             continue
-        if best is None or rec["sustained_pods_per_s"] > \
-                best["sustained_pods_per_s"]:
+        if best is None or _baseline_score(rec) > _baseline_score(best):
             best_path, best = path, rec
     return best_path, best
 
@@ -156,7 +199,7 @@ def compare(fresh: dict, base: dict) -> dict:
     is itself a failure (evidence must not silently disappear)."""
     keys = {}
     failures, warnings = [], []
-    for name, path, direction, band, required in KEYS:
+    for name, path, direction, band, required in _keys_for(fresh):
         b = _get_path(base, path)
         f = _get_path(fresh, path)
         if b is None:
@@ -217,12 +260,14 @@ def gate(fresh_path: str, against: str = "", repo: str = _REPO) -> dict:
 
 def check_committed(repo: str = _REPO, min_round: int = 8) -> List[dict]:
     """Gate every committed record from ``min_round`` on against its own
-    best prior — the tier-1 regression test over the record trajectory."""
+    best prior — the tier-1 regression test over the record trajectory.
+    STOREBENCH records ride the same sweep (their own shape class)."""
     results = []
-    for path, rec in committed_records(repo):
-        if round_of(path) < min_round or "error" in rec:
-            continue
-        results.append(gate(path, repo=repo))
+    for pattern in ("CHURN_MP_r*.json", "STOREBENCH_r*.json"):
+        for path, rec in committed_records(repo, pattern):
+            if round_of(path) < min_round or "error" in rec:
+                continue
+            results.append(gate(path, repo=repo))
     return results
 
 

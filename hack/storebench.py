@@ -1,0 +1,233 @@
+"""storebench — the cluster store microbench (kube-stripe evidence).
+
+The churn bench measures the whole control plane; this one isolates the
+store so the kube-stripe claim is a number, not an architecture diagram:
+
+- create / CAS / txn_many ns/op under K writer threads (each thread owns
+  one namespace — the scheduler-wave access pattern: per-namespace
+  batches stay single-shard);
+- LIST over the whole keyspace (the merged-by-key heapq path on the
+  striped store vs the flat sorted index);
+- watch fan-out cost at W watchers parked on W OTHER namespaces: on the
+  unsharded store every write scans all W watcher predicates while
+  HOLDING the one global lock; the striped store scans only the owning
+  shard's list (~W/S) under that shard's lock. The per-write delta
+  against the no-watcher baseline is the lock-held fan-out tax.
+
+Three stores run the same workload: ``memstore`` (the unsharded twin),
+``striped1`` (the machinery at S=1 — its overhead is the price of the
+abstraction), ``striped8`` (the default shard count). Emits a
+schema-validated STOREBENCH record; hack/perfgate.py gates it against
+the best committed prior STOREBENCH of the same shape.
+
+Usage::
+
+    python hack/storebench.py [--writers 4] [--ops 2000] [--watchers 64]
+                              [--batch 64] [--out STOREBENCH_r18.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from typing import Callable, Dict, List
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+RECORD_FIELDS = ("kind", "config", "host_cores", "stores")
+STORE_KEYS = ("create_ns", "cas_ns", "txn_item_ns", "list_ms",
+              "fanout_write_ns", "fanout_tax_ns")
+
+
+def validate_record(rec: dict) -> List[str]:
+    """-> list of missing/malformed field paths (empty = conformant)."""
+    missing = [k for k in RECORD_FIELDS if k not in rec]
+    if rec.get("kind") != "storebench":
+        missing.append("kind:storebench")
+    stores = rec.get("stores")
+    if not isinstance(stores, dict) or not stores:
+        missing.append("stores:empty")
+        return missing
+    for name, row in stores.items():
+        if not isinstance(row, dict):
+            missing.append(f"stores.{name}")
+            continue
+        missing += [f"stores.{name}.{k}" for k in STORE_KEYS
+                    if not isinstance(row.get(k), (int, float))]
+    return missing
+
+
+def _run_threads(n: int, fn: Callable[[int], None]) -> float:
+    """K threads running fn(thread_index); -> elapsed seconds."""
+    start = threading.Barrier(n + 1)
+    done = []
+    ts = [threading.Thread(target=lambda t=t: (start.wait(), fn(t),
+                                               done.append(t)))
+          for t in range(n)]
+    for t in ts:
+        t.start()
+    start.wait()
+    t0 = time.perf_counter()
+    for t in ts:
+        t.join()
+    dt = time.perf_counter() - t0
+    assert len(done) == n
+    return dt
+
+
+def _key(ns: str, i: int) -> str:
+    return f"/registry/pods/{ns}/pod-{i:06d}"
+
+
+def bench_store(make, writers: int, ops: int, watchers: int,
+                batch: int) -> Dict[str, float]:
+    """One store through the whole workload; -> the STORE_KEYS row."""
+    store = make()
+    errs: List[BaseException] = []
+
+    def guarded(fn):
+        def run(t):
+            try:
+                fn(t)
+            except BaseException as e:  # noqa: BLE001 - rethrown below
+                errs.append(e)
+        return run
+
+    # -- create: K threads, disjoint namespaces (single-shard writes)
+    def w_create(t):
+        ns = f"bench{t:02d}"
+        for i in range(ops):
+            store.create(_key(ns, i), f"v{i}")
+    create_s = _run_threads(writers, guarded(w_create))
+
+    # -- CAS: bump every pod once per thread, guarded on the live rev
+    def w_cas(t):
+        ns = f"bench{t:02d}"
+        for i in range(ops):
+            k = _key(ns, i)
+            kv = store.get(k)
+            store.compare_and_swap(k, f"c{i}", kv.modified_index)
+    cas_s = _run_threads(writers, guarded(w_cas))
+
+    # -- txn_many: per-namespace batches (the scheduler wave's verb)
+    n_batches = max(1, ops // batch)
+
+    def w_txn(t):
+        ns = f"bench{t:02d}"
+        for b in range(n_batches):
+            items = []
+            for i in range(b * batch, min((b + 1) * batch, ops)):
+                k = _key(ns, i)
+                kv = store.get(k)
+                items.append(([(k, f"t{b}", kv.modified_index)], []))
+            store.txn_many(items)
+    txn_s = _run_threads(writers, guarded(w_txn))
+    txn_items = sum(min((b + 1) * batch, ops) - b * batch
+                    for b in range(n_batches)) * writers
+
+    # -- LIST the whole keyspace (merged across shards, key order)
+    list_iters = 5
+    t0 = time.perf_counter()
+    for _ in range(list_iters):
+        kvs, _rv = store.list("/registry/pods")
+    list_s = (time.perf_counter() - t0) / list_iters
+    assert len(kvs) == writers * ops, (len(kvs), writers * ops)
+
+    # -- watch fan-out tax: W watchers on W QUIET namespaces, then one
+    # writer stream into a hot namespace. The unsharded store runs all
+    # W match predicates per write inside its global critical section;
+    # the striped store only walks the hot shard's (near-empty) list.
+    base_writes = ops
+
+    def w_base(_t):
+        for i in range(base_writes):
+            store.create(_key("hotbase", i), "x")
+    base_s = _run_threads(1, guarded(w_base))
+
+    ws = [store.watch(f"/registry/pods/quiet{w:03d}", 0, recursive=True)
+          for w in range(watchers)]
+
+    def w_hot(_t):
+        for i in range(base_writes):
+            store.create(_key("hotpath", i), "x")
+    hot_s = _run_threads(1, guarded(w_hot))
+    for w in ws:
+        w.stop()
+
+    if errs:
+        raise errs[0]
+    per = 1e9 / (writers * ops)
+    return {
+        "create_ns": round(create_s * per, 1),
+        "cas_ns": round(cas_s * per, 1),
+        "txn_item_ns": round(txn_s * 1e9 / txn_items, 1),
+        "list_ms": round(list_s * 1e3, 3),
+        "fanout_write_ns": round(hot_s * 1e9 / base_writes, 1),
+        "fanout_tax_ns": round((hot_s - base_s) * 1e9 / base_writes, 1),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="storebench", description=__doc__.splitlines()[0])
+    ap.add_argument("--writers", type=int, default=4)
+    ap.add_argument("--ops", type=int, default=2000,
+                    help="ops per writer thread per verb")
+    ap.add_argument("--watchers", type=int, default=64)
+    ap.add_argument("--batch", type=int, default=64,
+                    help="txn_many items per call")
+    ap.add_argument("--shards", type=int, default=8)
+    ap.add_argument("--out", default="")
+    args = ap.parse_args(argv)
+
+    from kubernetes_tpu.storage.memstore import MemStore
+    from kubernetes_tpu.storage.stripestore import StripedStore
+
+    makers = (
+        ("memstore", MemStore),
+        ("striped1", lambda: StripedStore(shards=1)),
+        (f"striped{args.shards}",
+         lambda: StripedStore(shards=args.shards)),
+    )
+    stores = {}
+    for name, make in makers:
+        stores[name] = row = bench_store(
+            make, args.writers, args.ops, args.watchers, args.batch)
+        print(f"[storebench] {name:10s} " + "  ".join(
+            f"{k}={row[k]}" for k in STORE_KEYS), file=sys.stderr,
+            flush=True)
+
+    record = {
+        "kind": "storebench",
+        "config": f"storebench: {args.writers} writers x {args.ops} "
+                  f"ops, {args.watchers} watchers, txn batch "
+                  f"{args.batch}",
+        "host_cores": os.cpu_count(),
+        "stores": stores,
+    }
+    striped = stores[f"striped{args.shards}"]
+    flat = stores["memstore"]
+    if flat["fanout_tax_ns"] > 0:
+        record["fanout_tax_reduction_pct"] = round(
+            (1.0 - striped["fanout_tax_ns"]
+             / flat["fanout_tax_ns"]) * 100.0, 1)
+    missing = validate_record(record)
+    if missing:
+        print(f"[storebench] non-conformant record: {missing}",
+              file=sys.stderr)
+        return 1
+    out = json.dumps(record, indent=1)
+    print(out)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(out + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

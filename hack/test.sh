@@ -40,6 +40,7 @@ python hack/vet.py
 if [[ "$RACE" == 1 ]]; then
     ROUNDS="${RACE_ROUNDS:-3}"
     SUITES=(tests/test_contention.py tests/test_storage.py
+            tests/test_storeshard.py
             tests/test_remote_store.py tests/test_cache.py
             tests/test_http.py tests/test_apiserver.py
             tests/test_stale_wave.py

@@ -40,6 +40,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "of an in-process store (the --etcd_servers "
                         "analog); lets several apiserver workers share one "
                         "store")
+    p.add_argument("--store-shards", "--store_shards", type=int, default=1,
+                   help="kube-stripe: shard the in-process store's "
+                        "keyspace by namespace hash into this many shards "
+                        "(power of two). Ignored with --store-server (the "
+                        "kube-store process takes --shards itself); 1 = "
+                        "the unsharded twin.")
     p.add_argument("--allow-privileged", "--allow_privileged",
                    action="store_true",
                    help="if set, allow containers to request privileged "
@@ -149,12 +155,20 @@ def build_server(opts, ready_event: Optional[threading.Event] = None):
             authorizer = ABACAuthorizer.from_text(f.read())
 
     store = None
+    store_shards = getattr(opts, "store_shards", 1)
     if getattr(opts, "store_server", ""):
         from kubernetes_tpu.storage.remote import RemoteStore
         store = RemoteStore(opts.store_server)
     elif getattr(opts, "data_dir", ""):
-        from kubernetes_tpu.storage.durable import DurableStore
-        store = DurableStore(opts.data_dir)
+        if store_shards > 1:
+            from kubernetes_tpu.storage.stripestore import DurableStripedStore
+            store = DurableStripedStore(opts.data_dir, shards=store_shards)
+        else:
+            from kubernetes_tpu.storage.durable import DurableStore
+            store = DurableStore(opts.data_dir)
+    elif store_shards > 1:
+        from kubernetes_tpu.storage.stripestore import StripedStore
+        store = StripedStore(shards=store_shards)
 
     master = Master(MasterConfig(
         store=store,

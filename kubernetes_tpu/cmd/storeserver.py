@@ -41,6 +41,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--compact-every", "--compact_every", type=int,
                    default=10_000,
                    help="snapshot + truncate the WAL every N records")
+    p.add_argument("--shards", type=int, default=1,
+                   help="kube-stripe: shard the keyspace by namespace "
+                        "hash into this many shards (power of two; per-"
+                        "shard locks, rings, and watcher lists under one "
+                        "global revision counter). 1 = the unsharded "
+                        "MemStore/DurableStore twin.")
     p.add_argument("--max-inflight", "--max_inflight", type=int, default=0,
                    help="kube-fairshed overload valve: shed ops past "
                         "this many concurrent dispatches with a "
@@ -69,7 +75,16 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     from kubernetes_tpu.storage.remote import StoreServer
 
-    if opts.data_dir:
+    if opts.shards > 1:
+        if opts.data_dir:
+            from kubernetes_tpu.storage.stripestore import DurableStripedStore
+            store = DurableStripedStore(
+                opts.data_dir, shards=opts.shards, fsync=opts.fsync,
+                compact_every=opts.compact_every)
+        else:
+            from kubernetes_tpu.storage.stripestore import StripedStore
+            store = StripedStore(shards=opts.shards)
+    elif opts.data_dir:
         from kubernetes_tpu.storage.durable import DurableStore
         store = DurableStore(opts.data_dir, fsync=opts.fsync,
                              compact_every=opts.compact_every)

@@ -40,8 +40,8 @@ import traceback
 import weakref
 from typing import Dict, List, Optional, Tuple
 
-__all__ = ["arm", "disarm", "armed", "reports", "clear", "assert_clean",
-           "wrap", "TrackedLock", "TrackedRLock"]
+__all__ = ["arm", "disarm", "armed", "reports", "clear", "forget_named",
+           "assert_clean", "wrap", "TrackedLock", "TrackedRLock"]
 
 _REAL_LOCK = threading.Lock
 _REAL_RLOCK = threading.RLock
@@ -312,6 +312,29 @@ def clear() -> None:
         _edges.clear()
         _cycles.clear()
         _cycle_sigs.clear()
+
+
+def forget_named(*names: str) -> None:
+    """Surgically drop graph state touching the named locks.
+
+    For tests that inject an inversion on purpose: ``clear()`` would
+    wipe the WHOLE session's order graph — including the
+    KTPU_LOCK_EDGES aggregate every suite before this one recorded —
+    so the sessionfinish edge dump would only show whatever ran after
+    the wipe. This removes only the named locks' nodes, edges, and
+    cycle reports.
+    """
+    doomed = set(names)
+    with _state_lock:
+        for key in [k for k in _edges if k[1] in doomed]:
+            del _edges[key]
+        for succs in _edges.values():
+            for key in [k for k in succs if k[1] in doomed]:
+                del succs[key]
+        _cycles[:] = [rep for rep in _cycles
+                      if not (set(rep["locks"]) & doomed)]
+        for sig in [s for s in _cycle_sigs if s & doomed]:
+            _cycle_sigs.discard(sig)
 
 
 def format_report(rep: dict) -> str:

@@ -729,6 +729,36 @@ def store_wal_metrics() -> StoreWalMetrics:
     return StoreWalMetrics._singleton
 
 
+class StoreShardMetrics:
+    """kube-stripe: the ``store_shard_*`` family — keyspace-sharding
+    evidence from storage/stripestore.StripedStore, exported wherever
+    the store lives. The numbers to read: a balanced ``shard`` label
+    distribution on ``store_shard_ops_total`` means the namespace hash
+    is spreading load; a skewed one means one tenant owns the cluster
+    and the sharding buys nothing (which the record must disclose, not
+    hide). Incremented OUTSIDE the shard/rev critical sections — the
+    counter mutex must never appear inside a store lock's edge set."""
+
+    _singleton = None
+
+    def __init__(self, registry: Optional[Registry] = None):
+        reg = registry or default_registry()
+        self.ops = reg.counter(
+            "store_shard_ops_total",
+            "Store mutations committed, by owning shard id ('cross' "
+            "for multi-shard batched verbs)", ("shard",))
+        self.shard_count = reg.gauge(
+            "store_shards",
+            "Configured shard count of the live striped store (absent/"
+            "0 means the unsharded MemStore twin)")
+
+
+def store_shard_metrics() -> StoreShardMetrics:
+    if StoreShardMetrics._singleton is None:
+        StoreShardMetrics._singleton = StoreShardMetrics()
+    return StoreShardMetrics._singleton
+
+
 class ChaosMetrics:
     """kube-chaos supervisor instrumentation: component kills/respawns
     and time-to-recovery, incremented by the churn harness's supervisor

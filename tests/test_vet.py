@@ -634,7 +634,10 @@ class TestLocksmith:
             assert e["stack"], rep
         text = locksmith.format_report(rep)
         assert "test-lock-A" in text and "test-lock-B" in text
-        locksmith.clear()               # injected on purpose: not a finding
+        # injected on purpose, not a finding — but clear() would also
+        # wipe every edge earlier suites recorded into the session-wide
+        # KTPU_LOCK_EDGES aggregate, so drop only these two locks
+        locksmith.forget_named("test-lock-A", "test-lock-B")
 
     def test_clean_ordering_passes(self):
         a = locksmith.wrap("ordered-A")
