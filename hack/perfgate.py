@@ -60,6 +60,11 @@ KEYS: Tuple[Tuple[str, str, str, float, bool], ...] = (
     # kube-stripe feeder push: the load generator's own normalized cost
     # (advisory — it trades against offered-rate headroom)
     ("feeder_cpu_s_per_10k", "feeder_cpu_s_per_10k", "lower", 0.35, False),
+    # kube-slipstream: the worst single wave stall (encode or solve leg)
+    # — the inline-compile/full-resync spikes prewarm+replay exist to
+    # delete. Advisory with a wide band: one scheduler hitting one cold
+    # bucket is seconds on this key while the medians barely move.
+    ("wave_stall_max_s", "slipstream.stall_max_s", "lower", 1.0, False),
 )
 
 # STOREBENCH records (hack/storebench.py) carry their own key table and

@@ -55,7 +55,8 @@ if [[ "$RACE" == 1 ]]; then
             tests/test_explain.py tests/test_record.py
             tests/test_chaos.py tests/test_fairshed.py
             tests/test_defrag.py tests/test_share.py
-            tests/test_submesh.py)
+            tests/test_submesh.py
+            tests/test_slipstream.py)
     rc=0
     for ((i = 1; i <= ROUNDS; i++)); do
         echo "=== race round ${i}/${ROUNDS} (switchinterval=1e-6) ==="

@@ -333,6 +333,20 @@ def default_churn_rules(binds_floor: float = 50.0,
         SLORule("parity_divergence_zero",
                 ("solverd_mesh_parity_divergent_total",),
                 reduce="last", op="ceil", threshold=0.0, scope="sum"),
+        # kube-slipstream invariant: during the load window every encoder
+        # resync must ride the journal-replay path — a FULL re-encode
+        # (any reason) while load is offered is the O(cluster) stall the
+        # checkpoint+journal machinery exists to delete. Windowed rate,
+        # not last: full syncs during warmup (encoder birth has no
+        # checkpoint yet) leave the counter nonzero forever, and must
+        # not fire the alarm once the run goes active.
+        SLORule("encode_resync_full_zero",
+                ('encoder_resync_full_total{reason="no_changelog"}',
+                 'encoder_resync_full_total{reason="no_checkpoint"}',
+                 'encoder_resync_full_total{reason="window_exceeded"}',
+                 'encoder_resync_full_total{reason="planes_changed"}'),
+                reduce="rate", op="ceil", threshold=0.0, window_s=30.0,
+                service="scheduler", scope="sum", active_only=True),
         SLORule("spans_dropped_zero", ("tracing_spans_dropped",),
                 reduce="last", op="ceil", threshold=0.0, scope="sum"),
         # leak detection: any single control-plane process past the lid

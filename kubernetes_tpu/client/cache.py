@@ -126,14 +126,62 @@ class Store:
         with self._lock:
             return list(self._items.keys())
 
+    @staticmethod
+    def _same_version(prev: Any, cur: Any) -> bool:
+        """True when a relist returned the SAME object state: identical
+        identity, or same uid + same non-empty resourceVersion. Non-API
+        objects (no metadata) compare by identity only — conservative:
+        a false negative just re-logs one set event."""
+        if prev is cur:
+            return True
+        try:
+            pm, cm = prev.metadata, cur.metadata
+            return (pm.uid == cm.uid and pm.resource_version != ""
+                    and pm.resource_version == cm.resource_version)
+        except AttributeError:
+            return False
+
     def replace(self, objs: List[Any]) -> None:
-        """Atomically reset contents (ref: store.go Replace — used by relist).
-        Clears the changelog: every outstanding delta token is invalidated
-        (delta_since returns None -> consumers resync)."""
+        """Atomically reset contents (ref: store.go Replace — used by
+        relist). kube-slipstream: instead of clearing the changelog (the
+        pre-r19 contract, which made every watch 410 / stream reset cost
+        consumers a full O(all-objects) resync), the new list is DIFFED
+        against the cache and only the real changes are appended — a
+        relist that missed k events costs delta consumers O(k), and the
+        incremental encoder's journal replay rides straight through it.
+        Only when the diff itself outgrows the retained window does
+        replace fall back to the old contract (clear the log, invalidate
+        every token). Observers are still NOT notified — a relist is a
+        resync, not a delivery."""
         with self._lock:
-            self._items = {self.key_func(o): o for o in objs}
-            self._version += 1
-            self._log.clear()
+            new = {self.key_func(o): o for o in objs}
+            events: List[tuple] = []
+            for key, prev in self._items.items():
+                cur = new.get(key)
+                if cur is None:
+                    events.append(("delete", prev))
+                elif not self._same_version(prev, cur):
+                    try:
+                        uid_changed = prev.metadata.uid != cur.metadata.uid
+                    except AttributeError:
+                        uid_changed = False
+                    if uid_changed:
+                        # name reuse across the gap: the old uid must be
+                        # retired or its resources leak in the encoder
+                        events.append(("delete", prev))
+                    events.append(("set", cur))
+            for key, cur in new.items():
+                if key not in self._items:
+                    events.append(("set", cur))
+            self._items = new
+            if len(events) >= self._LOG_MAX:
+                # gap wider than the window: old contract (tokens die)
+                self._version += 1
+                self._log.clear()
+                return
+            for op, obj in events:
+                self._version += 1
+                self._log.append((self._version, op, obj))
 
     def __len__(self):
         with self._lock:
@@ -247,6 +295,9 @@ class Reflector:
         self._thread: Optional[threading.Thread] = None
         self._backoff = Backoff(base=0.05, cap=2.0)
         self.last_sync_resource_version = ""
+        # kube-slipstream: streams re-opened at the last seen rv instead
+        # of relisting (visible in tests and the debug narrative)
+        self.watch_resumes = 0
 
     def run(self) -> "Reflector":
         self._thread = threading.Thread(target=self._run_loop, daemon=True, name=self.name)
@@ -290,6 +341,7 @@ class Reflector:
                 if errors.is_resource_expired(e):
                     return  # 410 Gone: relist
                 raise
+            progressed = False
             try:
                 while not self._stop.is_set():
                     if resync_deadline and time.monotonic() >= resync_deadline:
@@ -299,7 +351,17 @@ class Reflector:
                     except Exception:
                         continue
                     if ev is None:
-                        return  # stream closed: relist
+                        # kube-slipstream: a benign stream close (idle
+                        # timeout, apiserver rotation) after at least one
+                        # rv-advancing event resumes the watch at the last
+                        # seen rv — no relist, the store changelog stays
+                        # continuous and delta consumers replay through.
+                        # A close before any progress, a 410, or an ERROR
+                        # event still relists (the old crash-only path).
+                        if progressed:
+                            self.watch_resumes += 1
+                            break  # re-open watch_fn(rv) without relist
+                        return  # stream closed cold: relist
                     if ev.type == watchpkg.ERROR:
                         return
                     obj = ev.object
@@ -313,6 +375,7 @@ class Reflector:
                     if new_rv:
                         rv = new_rv
                         self.last_sync_resource_version = rv
+                        progressed = True
             finally:
                 w.stop()
 

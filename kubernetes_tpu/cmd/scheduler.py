@@ -70,6 +70,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--pods-axis", "--pods_axis", type=int, default=1,
                    help="mesh 'pods' axis length (see kube-solverd "
                         "--pods-axis)")
+    p.add_argument("--prewarm", action="store_true",
+                   help="kube-slipstream: at boot, compile the wave-size "
+                        "bucket ladder implied by the live cluster off "
+                        "the wave loop (in-process solve path only; with "
+                        "--solver-addr the daemon's own --prewarm covers "
+                        "the shared programs). compile_prewarm_ready on "
+                        "/metrics flips to 1 when done. The fill-trigger "
+                        "prewarm thread runs regardless unless "
+                        "KTPU_PREWARM=off.")
     p.add_argument("--event-qps", "--event_qps", type=float, default=50.0,
                    help="client-side event rate limit (successor "
                         "codebases' --event-qps; 0 disables)")
@@ -249,7 +258,8 @@ def build_scheduler(opts):
                             mesh=getattr(opts, "mesh", "auto"),
                             pods_axis=getattr(opts, "pods_axis", 1),
                             solver_fallback=getattr(
-                                opts, "solver_fallback", "inprocess"))
+                                opts, "solver_fallback", "inprocess"),
+                            prewarm=getattr(opts, "prewarm", False))
     if getattr(opts, "pipeline", False) and opts.algorithm != "tpu-batch":
         print("kube-scheduler: --pipeline requires --algorithm tpu-batch; "
               "ignoring", file=sys.stderr)

@@ -74,6 +74,26 @@ def build_parser() -> argparse.ArgumentParser:
                    help="live bit-identity probe: re-solve mesh-path "
                         "waves in the other layout and compare bitwise "
                         "(first = once per daemon run)")
+    p.add_argument("--prewarm", action="store_true",
+                   help="kube-slipstream: compile the shape-bucket set "
+                        "implied by --prewarm-nodes/-pods/-batch at boot, "
+                        "off the solve path, before the first request; "
+                        "compile_prewarm_ready flips to 1 on /metrics "
+                        "when done (the churn harness gates its load "
+                        "window on it). The fill-trigger prewarm thread "
+                        "runs regardless unless KTPU_PREWARM=off.")
+    p.add_argument("--prewarm-nodes", "--prewarm_nodes", type=int,
+                   default=0,
+                   help="declared cluster node count for the boot "
+                        "prewarm set (pow-2 rounded)")
+    p.add_argument("--prewarm-pods", "--prewarm_pods", type=int,
+                   default=1024,
+                   help="top of the pod-axis bucket ladder to prewarm "
+                        "(ladder descends to 256)")
+    p.add_argument("--prewarm-batch", "--prewarm_batch", type=int,
+                   default=1,
+                   help="vmap batch axis to prewarm in addition to 1 "
+                        "(set to the expected concurrent-worker count)")
     p.add_argument("--trace", action="store_true",
                    help="kube-trace: record queue-wait + solve spans, "
                         "attached to the requesting wave's trace when the "
@@ -174,7 +194,11 @@ def solverd_server(argv: List[str],
                         mesh=opts.mesh, pods_axis=opts.pods_axis,
                         mesh_min_nodes=opts.mesh_min_nodes,
                         mesh_dispatch=opts.mesh_dispatch,
-                        mesh_probe=opts.mesh_probe)
+                        mesh_probe=opts.mesh_probe,
+                        prewarm=opts.prewarm,
+                        prewarm_nodes=opts.prewarm_nodes,
+                        prewarm_pods=opts.prewarm_pods,
+                        prewarm_batch=opts.prewarm_batch)
     if opts.flightrec:
         from kubernetes_tpu.util import metrics as metrics_pkg
         metrics_pkg.flightrec_arm("solverd",

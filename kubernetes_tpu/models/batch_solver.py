@@ -85,7 +85,7 @@ from kubernetes_tpu.ops.kernels import (
 
 __all__ = ["solve", "solve_jit", "solve_device", "SolverInputs",
            "decisions_to_names", "WaveRouter", "WavePlan", "default_router",
-           "snapshot_to_host_inputs", "ship_inputs"]
+           "snapshot_to_host_inputs", "ship_inputs", "warm_compile"]
 
 NEG = -1  # masked score sentinel (scores are always >= 0)
 
@@ -1116,6 +1116,31 @@ def solve(snap: ClusterSnapshot,
         # winning scores are as stale as their hosts
         scores = np.where(chosen < 0, np.int32(NEG), scores)
     return chosen, scores
+
+
+def warm_compile(host: SolverInputs, pol, gangs: bool,
+                 peer_bound: int = 0, mesh=None) -> None:
+    """kube-slipstream prewarm entry: run (and discard) one wave of this
+    exact shape through the same dispatch ``solve`` uses, so the compiled
+    executable — router calibration included, since calibration IS the
+    first compile of both paths — is resident in the jit cache (and the
+    util/warmstart.py persistent cache) before a live wave needs it.
+    The results are read back to host because a dispatch whose outputs
+    are never consumed may be elided wholesale; the readback is the
+    fence that forces the compile to really happen. Runs on the prewarm
+    thread — never on the wave loop."""
+    ensure_x64()
+    if mesh is not None and int(host.cap.shape[0]) >= _mesh_min_nodes():
+        from kubernetes_tpu.parallel.mesh import solve_sharded
+        chosen, scores = solve_sharded(host, mesh, pol=pol, gangs=gangs,
+                                       peer_bound=peer_bound)
+        np.asarray(chosen), np.asarray(scores)
+        return
+    plan = default_router.plan_for(host, pol, gangs, peer_bound)
+    inp = ship_inputs(host, plan.device)
+    chosen, scores = solve_device(inp, pol, gangs, peer_bound,
+                                  force_scan=plan.device is not None)
+    np.asarray(jnp.stack([chosen, scores]))
 
 
 def decisions_to_names(snap: ClusterSnapshot, chosen: np.ndarray):

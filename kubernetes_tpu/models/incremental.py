@@ -477,6 +477,114 @@ class IncrementalEncoder:
         return [ResidentPod(uid, rec.name, rec.ns, rec.host_idx, rec.prio)
                 for uid, rec in self._node_pods.get(node_idx, {}).items()]
 
+    # -- kube-slipstream checkpoint / journal replay ------------------------
+    # Everything the encoder mutates between waves, grouped by how it must
+    # be captured. Arrays mutate IN PLACE (+=/grow) and are copied; lists
+    # and dicts are reassigned or mutated and get shallow copies; _PodRec
+    # values and api objects are immutable post-construction and shared
+    # copy-on-write across every checkpoint. op_counts is deliberately NOT
+    # captured: it counts operations performed, and a restore does not
+    # un-perform them.
+    _CKPT_ARRAYS = ("_cap", "_advertised", "_score_used", "_port_cnt",
+                    "_pd_cnt", "_node_sel", "_extra_ok", "_score_static",
+                    "_node_zone", "_grp_cnt", "_zone_cnt", "_evict_cap",
+                    "_evict_cnt", "_svc_req", "_svc_reqcnt", "_svc_ns")
+    _CKPT_LISTS = ("_nodes_key", "_svc_key", "_services", "_resource_names",
+                   "_node_names", "_node_labels")
+    _CKPT_DICTS = ("_grp_rows", "_rix", "_node_index")
+    _CKPT_SCALARS = ("_N", "_band_min", "_preempt_emitted", "_zone_V")
+    _CKPT_VOCABS = ("_ports", "_sels", "_pds", "_ns", "_bands", "_svc_vocab")
+
+    def checkpoint(self) -> dict:
+        """Capture the resident planes + sticky vocabularies + per-node pod
+        registry as an opaque restore() token (kube-slipstream journal
+        replay: scheduler/tpu_batch.py restores the last checkpoint and
+        replays the modeler changelog instead of re-encoding the cluster).
+        Pod records and cluster objects are shared copy-on-write; the
+        numpy planes are memcpy'd (milliseconds at planet shape). The
+        checkpoint is immutable with respect to later encoder mutation
+        and stays restorable any number of times. Raises ValueError
+        before the first wave established resident planes."""
+        if self._nodes_key is None:
+            raise ValueError("nothing resident: encode a wave before "
+                             "checkpointing")
+        st: dict = {}
+        for a in self._CKPT_ARRAYS:
+            st[a] = getattr(self, a).copy()
+        for a in self._CKPT_LISTS:
+            st[a] = list(getattr(self, a))
+        for a in self._CKPT_DICTS:
+            st[a] = dict(getattr(self, a))
+        for a in self._CKPT_SCALARS:
+            st[a] = getattr(self, a)
+        for a in self._CKPT_VOCABS:
+            st[a] = dict(getattr(self, a).index)
+        st["_pods"] = dict(self._pods)
+        st["_node_pods"] = {i: dict(d) for i, d in self._node_pods.items()}
+        return st
+
+    def restore(self, ckpt: dict) -> None:
+        """Reset the encoder to a checkpoint() state wholesale — including
+        dropping any pods applied (speculatively or otherwise) since. The
+        checkpoint itself is re-copied, so it remains valid for further
+        restores."""
+        for a in self._CKPT_ARRAYS:
+            setattr(self, a, ckpt[a].copy())
+        for a in self._CKPT_LISTS:
+            setattr(self, a, list(ckpt[a]))
+        for a in self._CKPT_DICTS:
+            setattr(self, a, dict(ckpt[a]))
+        for a in self._CKPT_SCALARS:
+            setattr(self, a, ckpt[a])
+        for a in self._CKPT_VOCABS:
+            v = _Vocab()
+            v.index = dict(ckpt[a])
+            setattr(self, a, v)
+        self._pods = dict(ckpt["_pods"])
+        self._node_pods = {i: dict(d)
+                           for i, d in ckpt["_node_pods"].items()}
+
+    def resident_fingerprint(self) -> tuple:
+        """Order-stable digest of every resident plane + the pod registry.
+        Bit-equal states (same vocab order, same planes, same pods at the
+        same hosts) produce equal fingerprints. The KTPU_DEBUG replay gate
+        compares the fingerprint after a journal replay against the one
+        after a full diff-walk over the authoritative list: equality
+        proves the replay reconstructed the exact causal state (the walk
+        found nothing to fix)."""
+        import zlib
+        parts = []
+        for a in self._CKPT_ARRAYS:
+            arr = getattr(self, a)
+            parts.append((a, arr.shape, str(arr.dtype),
+                          zlib.crc32(np.ascontiguousarray(arr).tobytes())))
+        for a in self._CKPT_VOCABS:
+            parts.append((a, tuple(getattr(self, a).index.items())))
+        parts.append(("_pods", tuple(sorted(
+            (uid, rec.host_idx, rec.prio) for uid, rec in
+            self._pods.items()))))
+        parts.append(("_grp_rows", tuple(sorted(self._grp_rows.items()))))
+        parts.append(("scalars", self._N, self._band_min,
+                      self._preempt_emitted, self._zone_V,
+                      tuple(self._resource_names)))
+        return tuple(parts)
+
+    def fill_dims(self) -> dict:
+        """True (unpadded) occupancy of the pow-2-bucketed vocabulary
+        axes, in the axis units of the device inputs (port/pd sets pack
+        32 vocab entries per uint32 word). The prewarm fill trigger
+        (solver/prewarm.py) compares these against the compiled bucket
+        so the next bucket's program compiles BEFORE growth crosses the
+        boundary. Axes whose true occupancy the encoder does not track
+        are omitted — absent keys never trigger."""
+        return {
+            "Wp": (len(self._ports) + 31) // 32,
+            "Wd": (len(self._pds) + 31) // 32,
+            "Ks": len(self._sels),
+            "G": len(self._grp_rows),
+            "B": len(self._bands),
+        }
+
     # -- speculation support (scheduler/tpu_batch.py pipelined mode) --------
     def has_pod(self, uid: str) -> bool:
         """Whether ``uid`` already contributes to the resident planes."""

@@ -86,12 +86,18 @@ class SimpleModeler:
     def delta(self, token):
         """Events on the COMBINED (scheduled + assumed) pod set since
         ``token``: -> (upserted_pods, removed_pods, new_token), or None
-        when a store relisted / the log window was exceeded (resync via
-        list()). Consumers MUST apply upserts before removes. A delete
-        event is suppressed while the pod's key is live in either store —
-        an assumed pod disappearing because the reflector caught its
-        binding (prune) is a migration, and a delete+set pair inside one
-        window is a resurrection, not a removal."""
+        only when the log window was exceeded (resync via list()).
+        kube-slipstream: a reflector relist is NOT a window break any
+        more — Store.replace diffs the new list against the cache and
+        appends only the real changes to the changelog, so watch 410s
+        and stream resets replay through this same O(changed) path
+        (scheduler/tpu_batch.py _replay_resync) instead of forcing a
+        full re-encode; delta() returns None only when the gap truly
+        outgrew the ring. Consumers MUST apply upserts before removes. A
+        delete event is suppressed while the pod's key is live in either
+        store — an assumed pod disappearing because the reflector caught
+        its binding (prune) is a migration, and a delete+set pair inside
+        one window is a resurrection, not a removal."""
         self._prune_assumed()
         ds = self.scheduled.delta_since(token[0])
         da = self.assumed.delta_since(token[1])
@@ -190,6 +196,11 @@ class SchedulerConfig:
     # (parallel/mesh.py contract).
     mesh: str = "auto"
     pods_axis: int = 1
+    # kube-slipstream (kube-scheduler --prewarm): compile the wave-size
+    # bucket ladder implied by the live cluster at boot, off the wave
+    # loop, before the harness opens its load window (scheduler/
+    # tpu_batch.py _prewarm_boot; compile_prewarm_ready on /metrics).
+    prewarm: bool = False
 
 
 class Scheduler:
@@ -325,7 +336,8 @@ class ConfigFactory:
                recorder: Optional[EventRecorder] = None,
                solver_addr: str = "", pipeline: bool = False,
                mesh: str = "auto", pods_axis: int = 1,
-               solver_fallback: str = "inprocess") -> SchedulerConfig:
+               solver_fallback: str = "inprocess",
+               prewarm: bool = False) -> SchedulerConfig:
         """ref: factory.go:77-172 CreateFromProvider/CreateFromConfig/
         CreateFromKeys."""
         # reflector: unassigned pods -> FIFO (field selector spec.host=)
@@ -380,6 +392,7 @@ class ConfigFactory:
             pipeline=pipeline,
             mesh=mesh,
             pods_axis=pods_axis,
+            prewarm=prewarm,
         )
 
     def stop(self, join: bool = False, timeout: float = 2.0) -> bool:

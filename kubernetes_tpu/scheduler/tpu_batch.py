@@ -56,6 +56,7 @@ quorum gate needs an authoritative existing-pod list) and encode causally
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from collections import OrderedDict
@@ -66,7 +67,10 @@ from kubernetes_tpu.api import types as api
 from kubernetes_tpu.models import explain as explain_mod
 from kubernetes_tpu.models import gang
 from kubernetes_tpu.models import preempt as preempt_mod
-from kubernetes_tpu.models.batch_solver import decisions_to_names, solve
+from kubernetes_tpu.models.batch_solver import (decisions_to_names,
+                                                peer_bound_of,
+                                                snapshot_to_host_inputs,
+                                                solve, warm_compile)
 from kubernetes_tpu.models.incremental import IncrementalEncoder
 from kubernetes_tpu.models.policy import BatchPolicy, batch_policy_from
 from kubernetes_tpu.models.snapshot import encode_snapshot
@@ -78,6 +82,13 @@ from kubernetes_tpu.util import metrics, tracing
 __all__ = ["BatchScheduler"]
 
 _log = logging.getLogger("kubernetes_tpu.scheduler.tpu_batch")
+
+# KTPU_DEBUG gates the journal-replay bit-identity check (same idiom as
+# models/incremental._DEBUG_VERIFY_EVICT): after every replay resync the
+# from-scratch diff-walk re-runs and the resident fingerprint must not
+# move. Assumes a quiescent store between replay and walk (tests, debug
+# runs).
+_DEBUG_REPLAY = os.environ.get("KTPU_DEBUG", "") not in ("", "0")
 
 
 class _WaveMetrics:
@@ -114,6 +125,20 @@ class _WaveMetrics:
             "Waves committed via per-pod binder.bind because the binder "
             "lacks the bind_many seam (a mis-wired live stack pays one "
             "HTTP round-trip per pod)")
+        # kube-slipstream: a reintroduced recompile/re-encode cliff is a
+        # few multi-second waves in a sea of fast ones — quantiles average
+        # it away, the running max cannot (perfgate advisory key)
+        self.stall_max = reg.gauge(
+            "scheduler_wave_stall_max_seconds",
+            "Largest single-wave encode or solve stall since boot")
+        self._stall_lock = threading.Lock()
+        self._stall_max_v = 0.0
+
+    def note_stall(self, dt: float) -> None:
+        with self._stall_lock:
+            if dt > self._stall_max_v:
+                self._stall_max_v = dt
+                self.stall_max.set(dt)
 
 
 def _wave_metrics() -> _WaveMetrics:
@@ -262,6 +287,29 @@ class BatchScheduler:
         # modeler changelog cursor for the O(changed) wave path; None
         # until the first full sync establishes the resident planes
         self._delta_token = None
+        # kube-slipstream journal-replay resync: a cadence-gated
+        # copy-on-write checkpoint of the encoder planes, paired with the
+        # modeler token it is causal with. A resync restores the
+        # checkpoint and replays the changelog (O(missed events)) instead
+        # of re-encoding the cluster; `checkpoint_every` keeps the gap
+        # far inside the store changelog window (client/cache.Store
+        # _LOG_MAX events vs ~3 events/pod per wave).
+        self._sx = metrics.slipstream_metrics()
+        self._ckpt = None            # (encoder state, modeler token)
+        self._ckpt_waves = 0
+        self.checkpoint_every = 4
+        # kube-slipstream prewarm (solver/prewarm.py): in-process solve
+        # topologies compile the next shape bucket off the wave loop; a
+        # remote-solver worker has no local programs to warm (the daemon
+        # runs its own controller)
+        self._prewarm = None
+        self._prewarm_snap = None
+        if self.solver is None and self._using_default_solve and \
+                self._encoder is not None and \
+                os.environ.get("KTPU_PREWARM", "auto") != "off":
+            from kubernetes_tpu.solver.prewarm import PrewarmController
+            self._prewarm = PrewarmController(self._prewarm_compile,
+                                              name="sched-prewarm")
         # kube-explain: rate-limited unschedulability diagnosis over the
         # solved wave's planes (models/explain.py); only consulted when a
         # wave returns unschedulable pods, so a wave where every pod
@@ -381,7 +429,9 @@ class BatchScheduler:
             else:
                 snap = encode_snapshot(nodes, get_existing(), pending,
                                        services, policy=self.batch_policy)
-        _wave_metrics().encode.observe(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        _wave_metrics().encode.observe(dt)
+        _wave_metrics().note_stall(dt)
         return snap
 
     def _solve_snap(self, snap, n_pending: int, tctx=None):
@@ -404,9 +454,25 @@ class BatchScheduler:
         with tracing.span("wave.solve", parent=tctx, pods=n_pending):
             if self.solver is not None:
                 chosen, scores = self.solver.solve(snap)
+            elif self._prewarm is not None:
+                # the host-side encode is hoisted out of solve() so the
+                # prewarm fill trigger can read this wave's bucket at
+                # zero extra cost (solve() needs the host inputs anyway);
+                # the snap reference is the exemplar the prewarm thread
+                # pads to the queued target bucket
+                host = snapshot_to_host_inputs(snap)
+                self._prewarm_snap = snap
+                actual = {"P": n_pending}
+                if self._encoder is not None:
+                    actual.update(self._encoder.fill_dims())
+                from kubernetes_tpu.solver.service import _dims_of
+                self._prewarm.observe(actual, _dims_of(host))
+                chosen, scores = solve(snap, host=host, mesh=self._mesh)
             else:
                 chosen, scores = solve(snap, mesh=self._mesh)
-        _wave_metrics().solve.observe(time.perf_counter() - t0)
+        dt_solve = time.perf_counter() - t0
+        _wave_metrics().solve.observe(dt_solve)
+        _wave_metrics().note_stall(dt_solve)
         _wave_metrics().pods.inc(by=n_pending)
         hosts = decisions_to_names(snap, chosen)
         victims = [None] * len(hosts)
@@ -440,13 +506,18 @@ class BatchScheduler:
 
     def _encode_incremental(self, nodes, pending, services, get_existing):
         """O(changed + pending) when the modeler's changelog covers the
-        gap; full list sync otherwise (first wave, relist, node-plane
-        change, or capacity overflow — see IncrementalEncoder.encode_delta).
+        gap from the encoder's own token; otherwise kube-slipstream
+        journal replay — restore the last checkpoint and replay the
+        changelog over it, O(missed events) — and only when the journal
+        cannot cover the gap either (no checkpoint yet, window exceeded,
+        node/service planes changed) the full O(cluster) list sync, with
+        the fallback counted by reason (encoder_resync_full_total).
         The resync token is always taken BEFORE the list it pairs with
         (get_existing records its own pre-token at materialization) so an
         event racing the list is re-delivered rather than lost
         (re-applying an upsert or remove is a no-op in the encoder)."""
         modeler = self.config.modeler
+        can_replay = hasattr(modeler, "delta") and hasattr(modeler, "token")
         if self._delta_token is not None and hasattr(modeler, "delta"):
             d = modeler.delta(self._delta_token)
             if d is not None:
@@ -455,7 +526,14 @@ class BatchScheduler:
                                                   pending, services)
                 if snap is not None:
                     self._delta_token = token
+                    self._maybe_checkpoint(token)
                     return snap
+        reason = "no_changelog"
+        if can_replay:
+            snap, reason = self._replay_resync(nodes, pending, services,
+                                               get_existing)
+            if snap is not None:
+                return snap
         if hasattr(modeler, "token"):
             fallback_token = modeler.token()
             existing = get_existing()
@@ -464,7 +542,145 @@ class BatchScheduler:
             _wave_metrics().resyncs.inc()
         else:
             existing = get_existing()
-        return self._encoder.encode(nodes, existing, pending, services)
+        self._sx.resync_full.inc(reason)
+        snap = self._encoder.encode(nodes, existing, pending, services)
+        if self._delta_token is not None:
+            self._maybe_checkpoint(self._delta_token)
+        return snap
+
+    def _maybe_checkpoint(self, token) -> None:
+        """Cadence-gated encoder checkpoint at a clean, token-paired
+        state (delta success, verified speculation hit, or post-full-
+        sync). Every ``checkpoint_every`` waves keeps the replay gap a
+        few thousand events deep — far inside the store changelog window
+        — while the copy-on-write snapshot stays a per-wave rounding
+        error on the loop thread."""
+        self._ckpt_waves += 1
+        if self._ckpt is not None and \
+                self._ckpt_waves < self.checkpoint_every:
+            return
+        t0 = time.perf_counter()
+        try:
+            state = self._encoder.checkpoint()
+        except ValueError:
+            return  # nothing resident yet
+        self._sx.checkpoint_s.observe(time.perf_counter() - t0)
+        self._ckpt = (state, token)
+        self._ckpt_waves = 0
+
+    def _replay_resync(self, nodes, pending, services, get_existing):
+        """The journal-replay resync: restore the last checkpoint, then
+        replay every store event since its token (the striped store's
+        per-shard history ring is the journal backing modeler.delta) —
+        O(missed events), not O(cluster). Returns ``(snap, reason)``;
+        snap is None when the journal could not cover the gap and the
+        caller pays the full re-encode, counted under ``reason``."""
+        if self._ckpt is None:
+            return None, "no_checkpoint"
+        state, ckpt_token = self._ckpt
+        d = self.config.modeler.delta(ckpt_token)
+        if d is None:
+            return None, "window_exceeded"
+        upserted, removed, token = d
+        self._encoder.restore(state)
+        snap = self._encoder.encode_delta(nodes, upserted, removed,
+                                          pending, services)
+        if snap is None:
+            # node/service planes changed (or capacity overflow): the
+            # full diff-walk below re-establishes everything; the
+            # restored-but-stale planes are simply its starting point
+            return None, "planes_changed"
+        self._delta_token = token
+        self._sx.resync_replay.inc()
+        if _DEBUG_REPLAY:
+            self._debug_verify_replay(nodes, pending, services,
+                                      get_existing)
+        self._maybe_checkpoint(token)
+        return snap, ""
+
+    def _debug_verify_replay(self, nodes, pending, services,
+                             get_existing) -> None:
+        """KTPU_DEBUG bit-identity gate: the from-scratch diff-walk over
+        the authoritative pod list must be a NO-OP on a correctly
+        replayed state — same planes, same vocab order, same registry —
+        so the resident fingerprint must not move across it."""
+        before = self._encoder.resident_fingerprint()
+        self._encoder.encode(nodes, get_existing(), pending, services)
+        after = self._encoder.resident_fingerprint()
+        assert before == after, (
+            "kube-slipstream: journal replay diverged from the "
+            "authoritative re-encode")
+
+    # -- kube-slipstream prewarm (solver/prewarm.py) ------------------------
+    def _prewarm_compile(self, target: dict) -> None:
+        """Prewarm-thread compile of one shape-bucket target: pad the
+        latest live exemplar wave to the target and run it through the
+        exact dispatch live waves use (warm_compile). Elementwise max
+        against the exemplar's own dims keeps this pad-only when the
+        live shape grew between queue and compile."""
+        from kubernetes_tpu.solver.service import _dims_of, _pad_inputs
+        snap = self._prewarm_snap
+        if snap is None:
+            raise RuntimeError("no exemplar wave to pad from")
+        host = snapshot_to_host_inputs(snap)
+        dims = _dims_of(host)
+        t = {k: max(int(v), dims.get(k, 0)) for k, v in target.items()}
+        for k, v in dims.items():
+            t.setdefault(k, v)
+        t["N1"] = t["N"] + 1
+        warm_compile(_pad_inputs(host, t), snap.policy, snap.has_gangs,
+                     peer_bound_of(host), mesh=self._mesh)
+
+    def _prewarm_boot(self) -> None:
+        """--prewarm boot mode: wait for the node store to fill, build a
+        synthetic exemplar wave over the live cluster shape, and compile
+        the pod-axis bucket ladder up to the wave size before load
+        arrives (the harness gates its load window on the
+        compile_prewarm_ready gauge this arms)."""
+        from kubernetes_tpu.solver.prewarm import pow2_ladder
+        from kubernetes_tpu.solver.service import _dims_of
+        deadline = time.monotonic() + 600.0
+        nodes: list = []
+        while not self._stop.is_set() and time.monotonic() < deadline:
+            try:
+                nodes = self.config.minion_lister.list().items
+            except Exception:
+                nodes = []
+            if nodes:
+                break
+            time.sleep(0.5)
+        if not nodes:
+            self._prewarm.boot_set([])  # nothing to imply a shape from
+            return
+        try:
+            services = self.factory.service_store.list()
+        except Exception:
+            services = []
+        try:
+            existing = self.config.modeler.list()
+        except Exception:
+            existing = []
+        floor = min(64, self.wave_size)
+        pending = [api.Pod(metadata=api.ObjectMeta(
+            name=f"prewarm-{i}", namespace="default"))
+            for i in range(floor)]
+        try:
+            snap = encode_snapshot(nodes, existing, pending, services,
+                                   policy=self.batch_policy)
+            host = snapshot_to_host_inputs(snap)
+        except Exception:
+            _log.exception("prewarm boot: exemplar encode failed")
+            self._prewarm.boot_set([])
+            return
+        if self._prewarm_snap is None:
+            self._prewarm_snap = snap
+        dims = _dims_of(host)
+        targets = []
+        for p in pow2_ladder(self.wave_size, floor=floor):
+            t = dict(dims)
+            t["P"] = p
+            targets.append(t)
+        self._prewarm.boot_set(targets)
 
     def _gate_gang_quorum(self, pods: List[api.Pod],
                           get_existing=()
@@ -987,8 +1203,10 @@ class BatchScheduler:
             spec, predicted, outcomes)
         if not reason:
             # prediction held: wave k+1 is already solving on the exact
-            # state the causal path would have encoded
+            # state the causal path would have encoded — a clean,
+            # token-paired state, so it is also a checkpoint site
             self._delta_token = token
+            self._maybe_checkpoint(token)
             pm.hits.inc()
             pm.waves.inc()
             return _Inflight(next_fut, spec.pending, next_tctx)
@@ -1028,6 +1246,17 @@ class BatchScheduler:
 
     # -- loop ---------------------------------------------------------------
     def run(self) -> "BatchScheduler":
+        if self._prewarm is not None:
+            self._prewarm.start()
+            if getattr(self.config, "prewarm", False):
+                threading.Thread(target=self._prewarm_boot, daemon=True,
+                                 name="tpu-batch-prewarm-boot").start()
+        elif getattr(self.config, "prewarm", False):
+            # remote-solver topology: the daemon compiles (and prewarms)
+            # the solve programs; this worker has nothing local to warm,
+            # so it reports prewarm-ready immediately for the harness's
+            # readiness sweep
+            self._sx.prewarm_ready.set(1)
         t = threading.Thread(target=self._loop, daemon=True,
                              name="tpu-batch-scheduler")
         t.start()
@@ -1035,6 +1264,8 @@ class BatchScheduler:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._prewarm is not None:
+            self._prewarm.stop()
 
     def _loop(self) -> None:
         if self.pipeline:

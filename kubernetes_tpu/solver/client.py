@@ -39,7 +39,7 @@ import numpy as np
 
 from kubernetes_tpu.models.policy import BatchPolicy
 from kubernetes_tpu.solver import protocol
-from kubernetes_tpu.util import tracing
+from kubernetes_tpu.util import metrics, tracing
 from kubernetes_tpu.util.retry import Backoff
 
 __all__ = ["RemoteSolver", "SolverBusy", "SolverUnavailable"]
@@ -107,6 +107,7 @@ class RemoteSolver:
         self.delta_waves = 0
         self.full_waves = 0
         self.resync_waves = 0
+        self.resync_reasons: Dict[str, int] = {}
         self.delta_bytes_shipped = 0
         self.delta_bytes_full = 0
 
@@ -253,11 +254,16 @@ class RemoteSolver:
         pair. The mirror only advances after a successful solve reply, so
         BUSY bounces and daemon-side failures can never desync it
         silently — at worst the next delta resyncs."""
+        sx = metrics.slipstream_metrics()
         base = {
             "op": "solve", "v": protocol.PROTOCOL_VERSION,
             "fp": protocol.solver_fingerprint(pol, gangs),
             "policy": protocol.policy_to_wire(pol),
             "gangs": bool(gangs),
+            # kube-slipstream: piggyback this scheduler's encoder resync
+            # counters so solverd's /metrics mirrors cluster resync health
+            "enc": [int(sx.resync_replay.total()),
+                    int(sx.resync_full.total())],
         }
         # v3 trace context: the wave's ambient span rides the header so
         # the daemon's queue/solve spans join this trace (advisory only
@@ -291,6 +297,9 @@ class RemoteSolver:
                     a.nbytes for a in host_inputs)
                 return out
             self.resync_waves += 1
+            reason = str(resp_header.get("resync"))
+            self.resync_reasons[reason] = (
+                self.resync_reasons.get(reason, 0) + 1)
             mirrors.pop(bucket, None)
         # full frame: establish (or resync) the daemon's cache entry
         header = dict(base,

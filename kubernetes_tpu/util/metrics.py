@@ -26,6 +26,7 @@ __all__ = ["Counter", "Gauge", "Histogram", "Registry", "default_registry",
            "ChaosMetrics", "chaos_metrics",
            "FairshedMetrics", "fairshed_metrics",
            "FairshedLedgerMetrics", "fairshed_ledger_metrics",
+           "SlipstreamMetrics", "slipstream_metrics",
            "FlightRecorder", "flightrec_arm", "flightrec_disarm",
            "flightrec_armed", "flightrec_watch", "flightrec_vars",
            "flightrec_sample_now", "flightrec"]
@@ -330,6 +331,68 @@ def solverd_delta_metrics() -> SolverdDeltaMetrics:
     if SolverdDeltaMetrics._singleton is None:
         SolverdDeltaMetrics._singleton = SolverdDeltaMetrics()
     return SolverdDeltaMetrics._singleton
+
+
+class SlipstreamMetrics:
+    """The kube-slipstream family — journal-replay encoder resync and
+    ahead-of-time shape-bucket prewarm (models/incremental.py checkpoint
+    machinery, scheduler/tpu_batch.py replay path, solver/prewarm.py
+    compile thread). The churn harness scrapes these into the CHURN_MP
+    record's ``slipstream`` section and the ``encode_resync_full_zero``
+    SLO rule watches the full-re-encode counter during the load window."""
+
+    _singleton = None
+
+    def __init__(self, registry: Optional[Registry] = None):
+        reg = registry or default_registry()
+        self.resync_replay = reg.counter(
+            "encoder_resync_replay_total",
+            "Encoder resyncs served by restoring the last checkpoint and "
+            "replaying the modeler changelog (O(missed events))")
+        self.resync_full = reg.counter(
+            "encoder_resync_full_total",
+            "Encoder resyncs that fell back to a full O(cluster) "
+            "re-encode, by reason",
+            ("reason",))
+        self.checkpoint_s = reg.histogram(
+            "encoder_checkpoint_seconds",
+            "Wall time of IncrementalEncoder.checkpoint() (copy-on-write "
+            "plane snapshot)",
+            buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25))
+        self.prewarm_total = reg.counter(
+            "compile_prewarm_total",
+            "Shape-bucket programs compiled off the wave loop by the "
+            "prewarm thread (scheduler in-process or solverd)")
+        self.prewarm_s = reg.histogram(
+            "compile_prewarm_seconds",
+            "Wall time of one ahead-of-time bucket compile",
+            buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+                     120.0))
+        self.prewarm_pending = reg.gauge(
+            "compile_prewarm_pending",
+            "Prewarm compile targets queued but not yet compiled")
+        self.prewarm_ready = reg.gauge(
+            "compile_prewarm_ready",
+            "1 once the boot prewarm set has fully compiled (0 before; "
+            "the churn harness gates its load window on this)")
+        # solverd-side mirrors of the schedulers' resync counters,
+        # piggybacked on solve headers ("enc") and summed per scheduler.
+        # Deliberately NOT *_total: these are last-reported gauges, not
+        # daemon-local counters.
+        self.replay_reported = reg.gauge(
+            "solverd_encoder_resync_replay_reported",
+            "Sum of encoder_resync_replay_total last reported by each "
+            "connected scheduler in its solve headers")
+        self.full_reported = reg.gauge(
+            "solverd_encoder_resync_full_reported",
+            "Sum of encoder_resync_full_total last reported by each "
+            "connected scheduler in its solve headers")
+
+
+def slipstream_metrics() -> SlipstreamMetrics:
+    if SlipstreamMetrics._singleton is None:
+        SlipstreamMetrics._singleton = SlipstreamMetrics()
+    return SlipstreamMetrics._singleton
 
 
 class SolverdMeshMetrics:

@@ -19,6 +19,12 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")  # for any subprocesses
 # process holds the device (the round-3 wedge signature). CPU-only test
 # children must not depend on tunnel availability.
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+# kube-slipstream fill-trigger prewarm is default-ON in production; in
+# the suite it would queue background XLA compiles of doubled buckets
+# behind nearly every scheduler construction, taxing every test for
+# programs the test never uses. Tests that exercise prewarm construct
+# PrewarmController (or monkeypatch this) explicitly.
+os.environ.setdefault("KTPU_PREWARM", "off")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

@@ -432,8 +432,23 @@ def test_store_changelog_and_modeler_delta():
     events, t1 = s.delta_since(t0)
     assert [op for op, _ in events] == ["set", "set", "delete"]
     assert s.delta_since(t1) == ([], t1)
+    # kube-slipstream: a relist DIFFS against the cache instead of
+    # invalidating every token — identical contents log nothing, a
+    # vanished object logs a delete, and consumers replay through
     s.replace([b])
-    assert s.delta_since(t1) is None  # relist invalidates tokens
+    assert s.delta_since(t1) == ([], t1)
+    s.replace([])
+    events, t2 = s.delta_since(t1)
+    assert [(op, o.metadata.name) for op, o in events] == [("delete", "b")]
+    # only a diff wider than the retained window breaks tokens
+    s.add(b)
+    t3 = s.token()
+    try:
+        Store._LOG_MAX = 1
+        s.replace([mk_pod("c"), mk_pod("d")])
+    finally:
+        Store._LOG_MAX = 1 << 14
+    assert s.delta_since(t3) is None
 
     m = SimpleModeler(FIFO(), Store())
     tok = m.token()
